@@ -1,0 +1,36 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh.
+
+The distributed kernels run in Pallas TPU interpret mode on CPU devices —
+this is the single-process cluster simulator the reference lacks (its tests
+need real GPUs + torchrun; see SURVEY.md §4).
+
+The container's axon sitecustomize eagerly initializes the single-chip TPU
+backend at interpreter start, so setting JAX_PLATFORMS=cpu in the
+environment is not enough — we re-point jax at CPU and drop the cached
+backend before any test imports run.
+"""
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+_FLAG = "--xla_force_host_platform_device_count=8"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+try:
+    _xb._clear_backends()
+except Exception:
+    pass
+
+assert jax.device_count() == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()}"
+)

@@ -1,0 +1,28 @@
+"""triton_dist_tpu — a TPU-native distributed compute/communication
+overlapping framework.
+
+This package provides the capabilities of Triton-distributed (a distributed
+compiler + overlapping-kernel library for GPUs, see /root/reference) re-designed
+TPU-first on JAX/XLA/Pallas:
+
+- ``shmem``    : the ``tpushmem`` layer — symmetric buffers over a
+  ``jax.sharding.Mesh`` plus one-sided remote-DMA/semaphore primitives usable
+  inside Pallas kernels (the role NVSHMEM/pynvshmem plays in the reference,
+  cf. reference shmem/nvshmem_bind/*).
+- ``language`` : the ``dl.*`` device-language surface (rank/num_ranks/wait/
+  notify/consume_token, cf. reference python/triton_dist/language.py).
+- ``ops``      : the overlapping kernel library (AG-GEMM, GEMM-RS, MoE
+  grouped-GEMM, EP All-to-All, distributed Flash-Decode, collectives;
+  cf. reference python/triton_dist/kernels/nvidia/*).
+- ``layers``   : module layer over the kernels (cf. reference
+  python/triton_dist/layers/nvidia/*).
+- ``models``   : flagship model families wired to the distributed layers.
+- ``parallel`` : mesh/sharding helpers and tp/pp/dp/sp/ep train-step
+  composition (what jax gives beyond the reference's scope).
+- ``tools``    : distributed autotuner, perf/trace harness, AOT export
+  (cf. reference python/triton_dist/autotuner.py, tools/*).
+"""
+
+__version__ = "0.1.0"
+
+from triton_dist_tpu.shmem import ShmemContext, initialize_distributed  # noqa: F401

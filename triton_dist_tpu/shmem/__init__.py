@@ -1,0 +1,6 @@
+from triton_dist_tpu.shmem.context import (  # noqa: F401
+    ShmemContext,
+    initialize_distributed,
+    get_default_context,
+)
+from triton_dist_tpu.shmem import device  # noqa: F401

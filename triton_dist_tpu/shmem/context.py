@@ -1,0 +1,120 @@
+"""Host-side tpushmem runtime: mesh bootstrap + symmetric buffers.
+
+Role analog of the reference's ``pynvshmem`` host extension + wrapper
+(reference shmem/nvshmem_bind/pynvshmem/src/pynvshmem.cc:130-214 and
+python/pynvshmem/__init__.py:93-171), re-thought for TPU/JAX:
+
+- *bootstrap*: NVSHMEM's UID handshake over a torch process group
+  (pynvshmem/__init__.py:157-171) becomes ``jax.distributed.initialize`` +
+  ``jax.sharding.Mesh`` construction — jax is single-controller, so there is
+  no per-rank rendezvous to re-implement.
+- *symmetric heap*: ``nvshmem_create_tensor(shape)`` (same shape on every PE,
+  peer-addressable) becomes a jax Array of shape ``(n_pes, *local_shape)``
+  sharded over the mesh axis: inside ``shard_map`` every device sees an
+  identically-shaped local ref, and remote refs are addressed *by device id*
+  in ``pltpu.make_async_remote_copy`` — symmetric by construction, no
+  ``nvshmem_ptr`` pointer translation needed (cf. symm_at,
+  dialect DistributedOps.td:135-149).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_DEFAULT_CONTEXT: "ShmemContext | None" = None
+
+
+def initialize_distributed(axis_names: Sequence[str] = ("x",),
+                           mesh_shape: Sequence[int] | None = None,
+                           seed: int = 42) -> "ShmemContext":
+    """Bootstrap the distributed runtime and build the default device mesh.
+
+    Analog of the reference's ``initialize_distributed``
+    (python/triton_dist/utils.py:91-111): there it creates a NCCL process
+    group, seeds, and boots NVSHMEM off a broadcast unique id. Here:
+    multi-host jax initializes from cluster env automatically, and the
+    "symmetric heap" needs no setup beyond a Mesh.
+    """
+    global _DEFAULT_CONTEXT
+    # Multi-host bootstrap. Must happen BEFORE any backend use (so no
+    # jax.process_count()/jax.devices() in this guard). Opt-in via the
+    # standard coordinator env vars or TPU-pod env; failures are surfaced,
+    # not swallowed, so a pod never silently degrades to single-host.
+    multihost_env = any(os.environ.get(k) for k in (
+        "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+        "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_ID",
+    ))
+    if multihost_env and not jax.distributed.is_initialized():
+        jax.distributed.initialize()
+    devices = np.array(jax.devices())
+    if mesh_shape is None:
+        mesh_shape = (devices.size,) + (1,) * (len(axis_names) - 1)
+    mesh = Mesh(devices.reshape(tuple(mesh_shape)), tuple(axis_names))
+    ctx = ShmemContext(mesh=mesh)
+    _DEFAULT_CONTEXT = ctx
+    return ctx
+
+
+def get_default_context() -> "ShmemContext":
+    global _DEFAULT_CONTEXT
+    if _DEFAULT_CONTEXT is None:
+        _DEFAULT_CONTEXT = initialize_distributed()
+    return _DEFAULT_CONTEXT
+
+
+@dataclasses.dataclass(frozen=True)
+class ShmemContext:
+    """Mesh + symmetric-buffer factory. Frozen so it can live in closures of
+    jitted functions."""
+
+    mesh: Mesh
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    @property
+    def num_ranks(self) -> int:
+        return self.mesh.devices.size
+
+    def axis_size(self, axis: str | None = None) -> int:
+        if axis is None:
+            return self.num_ranks
+        return self.mesh.shape[axis]
+
+    # -- symmetric heap -----------------------------------------------------
+
+    def create_symm_tensor(self, local_shape: Sequence[int], dtype,
+                           axis: str | None = None) -> jax.Array:
+        """Symmetric buffer: one ``local_shape`` block per PE along ``axis``
+        (default: the whole mesh, flattened). Analog of
+        ``pynvshmem.nvshmem_create_tensor`` (pynvshmem/__init__.py:130-136).
+        """
+        n = self.axis_size(axis)
+        spec = P(self.axis_names if axis is None else axis)
+        shape = (n, *local_shape)
+        sharding = NamedSharding(self.mesh, spec)
+        # Allocate each shard in place (no full-array staging on device 0).
+        return jnp.zeros(shape, dtype, device=sharding)
+
+    def shard(self, x: jax.Array, spec: P) -> jax.Array:
+        return jax.device_put(x, NamedSharding(self.mesh, spec))
+
+    # -- shard_map wrapper --------------------------------------------------
+
+    def shard_map(self, f: Callable[..., Any], in_specs, out_specs,
+                  axis_names: Sequence[str] | None = None):
+        """SPMD-launch ``f`` over the mesh — the analog of "one process per
+        GPU running this kernel" in the reference's torchrun model. Pallas
+        kernels with manual DMA/semaphores do not carry varying-manual-axes
+        info, hence ``check_vma=False``."""
+        return jax.shard_map(f, mesh=self.mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)

@@ -1,0 +1,224 @@
+"""Device-side tpushmem primitives — usable *inside* Pallas TPU kernels.
+
+This is the TPU-native re-creation of the reference's portability seam
+``triton.language.extra.libshmem_device`` (reference
+patches/triton/python/triton/language/extra/libshmem_device.py — the
+vendor-neutral interface NVSHMEM/ROCSHMEM backends implement) and of its
+NVIDIA implementation ``libnvshmem_device.py`` (put/get/signal/fence/quiet/
+barrier device API, see reference SURVEY §2.2).
+
+Mapping (GPU one-sided shmem → TPU):
+
+===========================  ==============================================
+reference primitive          TPU-native equivalent here
+===========================  ==============================================
+``my_pe()`` / ``n_pes()``    mesh axis index / size (``lax.axis_index``)
+``putmem_nbi_block``         ``pltpu.make_async_remote_copy(...).start()``
+``putmem_signal_nbi_block``  remote copy; the *receiver-side DMA semaphore*
+                             is the delivery-ordered signal (hardware
+                             signals it when data lands — stronger than
+                             NVSHMEM's separate signal word)
+``signal_op(SET/ADD)``       ``pltpu.semaphore_signal`` (counting ADD only;
+                             SET has no TPU analog — protocols here are
+                             redesigned around counted arrivals)
+``signal_wait_until``        ``pltpu.semaphore_wait`` (NOTE: decrements)
+``fence``/``quiet``          wait on local send semaphores (``quiet``);
+                             per-destination ordering via semaphores
+``barrier_all``              barrier semaphore all-to-all signal + wait
+``symm_at(ptr, pe)``         not needed: remote refs are (buffer, device_id)
+                             pairs — symmetric by construction
+===========================  ==============================================
+
+All functions take mesh-axis names because the "PE space" is a (possibly
+multi-axis) jax mesh, not a flat rank list.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+from jax import lax
+from jax.experimental import pallas as pl  # noqa: F401  (re-exported for kernels)
+from jax.experimental.pallas import tpu as pltpu
+
+
+# -- PE identity ------------------------------------------------------------
+
+def my_pe(axis: str | Sequence[str]):
+    """Rank of this device along ``axis`` (or flattened over several axes,
+    major-to-minor). Analog of ``nvshmem_my_pe`` (libnvshmem_device.py:85)."""
+    if isinstance(axis, str):
+        return lax.axis_index(axis)
+    pid = lax.axis_index(axis[0])
+    for name in axis[1:]:
+        pid = pid * lax.axis_size(name) + lax.axis_index(name)
+    return pid
+
+
+def n_pes(axis: str | Sequence[str]):
+    """Number of PEs along ``axis``. Analog of ``nvshmem_n_pes``."""
+    if isinstance(axis, str):
+        return lax.axis_size(axis)
+    n = 1
+    for name in axis:
+        n = n * lax.axis_size(name)
+    return n
+
+
+def pe_at(axis_names: Sequence[str], axis: str, index):
+    """Flat LOGICAL device id of the device whose coordinate along ``axis``
+    is ``index`` and whose other mesh coordinates equal ours.
+
+    ``pltpu.make_async_remote_copy`` addresses peers by *flat* logical id
+    over the whole mesh (row-major over ``axis_names``); this computes it —
+    the role ``nvshmem_ptr``/``symm_at`` pointer translation plays on GPU
+    (reference DistributedOps.td:135-149) without any pointer math.
+    """
+    pid = 0
+    for name in axis_names:
+        coord = index if name == axis else lax.axis_index(name)
+        pid = pid * lax.axis_size(name) + coord
+    return pid
+
+
+# -- one-sided puts ---------------------------------------------------------
+
+def putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, pe,):
+    """Non-blocking one-sided put: copy ``src_ref`` (local) into ``dst_ref``
+    on device ``pe`` (flat logical id). Returns the DMA descriptor; call
+    ``.wait_send()`` (quiet) locally, receiver waits ``recv_sem``.
+
+    Analog of ``libshmem_device.putmem_nbi_block``
+    (libnvshmem_device.py put family; docs/primitives.md:22-56). The
+    receiving device's ``recv_sem`` (same scratch slot) is signaled by the
+    DMA engine when the data has fully landed — this gives the
+    "putmem_signal" delivery guarantee for free.
+    """
+    rdma = pltpu.make_async_remote_copy(
+        src_ref=src_ref,
+        dst_ref=dst_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=pe,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    rdma.start()
+    return rdma
+
+
+def putmem_block(dst_ref, src_ref, send_sem, recv_sem, pe):
+    """Blocking-at-source put: start + wait local send completion.
+    (Remote delivery is still signaled via ``recv_sem``.)"""
+    rdma = putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, pe)
+    rdma.wait_send()
+    return rdma
+
+
+# -- signals ----------------------------------------------------------------
+
+def signal_op(sem_ref, inc, pe=None):
+    """Atomically add ``inc`` to (possibly remote) semaphore. Analog of
+    ``libshmem_device.signal_op(..., NVSHMEM_SIGNAL_ADD)``
+    (low_latency_all_to_all.py:96-117 uses the SET form with call_count;
+    on TPU the counting form is native and protocols count arrivals)."""
+    if pe is None:
+        pltpu.semaphore_signal(sem_ref, inc=inc)
+    else:
+        pltpu.semaphore_signal(sem_ref, inc=inc, device_id=pe,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+
+def signal_wait_until(sem_ref, value):
+    """Block until the (REGULAR/barrier) semaphore has accumulated ``value``,
+    then *consume* it (TPU semaphores decrement on wait — unlike NVSHMEM's
+    ``signal_wait_until`` which leaves the flag set; protocols in ``ops/``
+    are designed around consumption). DMA delivery waits use ``wait_recv``.
+    """
+    pltpu.semaphore_wait(sem_ref, value)
+
+
+def wait_recv(dst_ref, recv_sem):
+    """Wait for delivery of a put into ``dst_ref`` tracked by ``recv_sem``
+    (a DMA semaphore). DMA semaphores count transferred bytes, so the wait
+    is phrased through a descriptor of the expected shape — the standard
+    same-ref trick."""
+    pltpu.make_async_copy(dst_ref, dst_ref, recv_sem).wait()
+
+
+def signal_read(sem_ref):
+    """Non-destructive read of the semaphore count (debug/poll)."""
+    return pl.semaphore_read(sem_ref)
+
+
+# -- ordering ---------------------------------------------------------------
+
+def quiet(*rdmas):
+    """Wait until our outstanding puts have left this device (local send
+    completion). Analog of ``libshmem_device.quiet``."""
+    for r in rdmas:
+        r.wait_send()
+
+
+def fence():
+    """Analog of ``libshmem_device.fence`` (ordering of puts to the same PE).
+    TPU remote DMAs carry their own completion semaphores; ordering is
+    expressed by waiting those, so ``fence`` is a no-op kept for API parity.
+    """
+    return None
+
+
+# -- barriers ---------------------------------------------------------------
+
+def barrier_all(axis_names: Sequence[str], mesh_axes: Sequence[str] | None = None):
+    """Barrier across the devices spanned by ``axis_names`` inside a kernel:
+    signal every other participant's barrier semaphore, wait for n-1
+    arrivals. Analog of ``libshmem_device.barrier_all`` /
+    ``barrier_all_intra_node_*`` (reference kernels/nvidia/common_ops.py:88-159).
+
+    ``mesh_axes`` is the full, ordered axis-name tuple of the enclosing mesh;
+    it is required when ``axis_names`` is a *subset* of a multi-axis mesh,
+    because LOGICAL device ids are flat over the whole mesh (devices outside
+    the barrier group keep their own coordinates on the other axes).
+
+    The enclosing ``pallas_call`` must set
+    ``compiler_params=pltpu.CompilerParams(collective_id=...)``.
+    """
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+    mesh_axes = tuple(mesh_axes) if mesh_axes is not None else tuple(axis_names)
+    sem = pltpu.get_barrier_semaphore()
+    npes = n_pes(axis_names)
+    me = my_pe(axis_names)
+
+    def body(i, carry):
+        # Decompose flat group index i into coordinates along axis_names
+        # (major-to-minor), then linearize over the full mesh with our own
+        # coordinates on non-participating axes.
+        rem = i
+        coords = {}
+        for name in reversed(axis_names):
+            sz = lax.axis_size(name)
+            coords[name] = lax.rem(rem, sz)
+            rem = rem // sz
+        pid = 0
+        for name in mesh_axes:
+            coord = coords.get(name, lax.axis_index(name))
+            pid = pid * lax.axis_size(name) + coord
+
+        @pl.when(i != me)
+        def _():
+            pltpu.semaphore_signal(sem, inc=1, device_id=pid,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+        return carry
+
+    lax.fori_loop(0, npes, body, 0)
+    pltpu.semaphore_wait(sem, npes - 1)
+
+
+def barrier_pair(axis_names: Sequence[str], peer):
+    """Two-device barrier with flat-id ``peer`` (ring neighbors etc.)."""
+    sem = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(sem, inc=1, device_id=peer,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(sem, 1)

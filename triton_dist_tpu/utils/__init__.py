@@ -1,0 +1,8 @@
+from triton_dist_tpu.utils.env import (  # noqa: F401
+    on_tpu,
+    on_cpu,
+    interpret_params,
+    default_interpret,
+)
+from triton_dist_tpu.utils.debug import dist_print, assert_allclose  # noqa: F401
+from triton_dist_tpu.utils.perf import perf_func, group_profile  # noqa: F401

@@ -1,0 +1,55 @@
+"""Debug/printing helpers (cf. reference python/triton_dist/utils.py:201-231
+``dist_print`` and :610-639 ``assert_allclose``)."""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import numpy as np
+
+
+def dist_print(*args, allowed_ranks="all", prefix: bool = False, file=None,
+               **kwargs):
+    """Print from one or more host processes. In single-controller jax there
+    is one host process per slice; identity is ``jax.process_index()``."""
+    file = file or sys.stderr
+    pid = jax.process_index()
+    if allowed_ranks == "all":
+        allowed = range(jax.process_count())
+    else:
+        allowed = allowed_ranks
+    if pid in allowed:
+        if prefix:
+            print(f"[rank {pid}]", *args, file=file, **kwargs)
+        else:
+            print(*args, file=file, **kwargs)
+
+
+def assert_allclose(x, y, atol: float = 1e-3, rtol: float = 1e-3, verbose: bool = True):
+    """Rich allclose assert: dumps max/mean abs error and the worst offending
+    indices on failure (cf. reference utils.py:610-639)."""
+    x = np.asarray(x)
+    y = np.asarray(y)
+    assert x.shape == y.shape, f"shape mismatch {x.shape} vs {y.shape}"
+    xf = x.astype(np.float64)
+    yf = y.astype(np.float64)
+    if np.allclose(xf, yf, atol=atol, rtol=rtol):
+        return
+    err = np.abs(xf - yf)
+    denom = np.abs(yf) + 1e-12
+    rel = err / denom
+    bad = (err > atol + rtol * np.abs(yf))
+    n_bad = int(bad.sum())
+    msg = [
+        f"assert_allclose failed: {n_bad}/{x.size} mismatched "
+        f"(atol={atol}, rtol={rtol})",
+        f"  max abs err {err.max():.6g}  mean abs err {err.mean():.6g}  "
+        f"max rel err {rel.max():.6g}",
+    ]
+    if verbose:
+        idx = np.unravel_index(np.argsort(err, axis=None)[::-1][:10], x.shape)
+        for i in range(min(10, n_bad)):
+            at = tuple(int(a[i]) for a in idx)
+            msg.append(f"  at {at}: got {x[at]!r} want {y[at]!r}")
+    raise AssertionError("\n".join(msg))

@@ -1,0 +1,49 @@
+"""Backend/environment detection.
+
+The same kernel code runs in two modes:
+- compiled Mosaic on real TPU chips (bench, production), and
+- Pallas TPU *interpret mode* on a virtual CPU device mesh (tests, CI) —
+  an improvement over the reference, whose tests require real GPUs
+  (reference SURVEY: no single-process cluster simulator).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+
+@lru_cache(None)
+def backend_platform() -> str:
+    return jax.devices()[0].platform.lower()
+
+
+def on_cpu() -> bool:
+    return backend_platform() == "cpu"
+
+
+def on_tpu() -> bool:
+    # The axon PJRT plugin reports devices as TPU; be liberal.
+    p = backend_platform()
+    return ("tpu" in p) or (p == "axon")
+
+
+def interpret_params(**kw) -> "pltpu.InterpretParams":
+    """TPU-interpret-mode params used when running on CPU devices.
+
+    ``dma_execution_mode='on_wait'`` preserves the async-DMA/semaphore
+    semantics closely enough to catch missing waits; set
+    ``TDT_DETECT_RACES=1`` to enable the interpreter's race detector
+    (the reference's analog is sleep-noise fuzzing, allgather.py:72-76).
+    """
+    if os.environ.get("TDT_DETECT_RACES") == "1":
+        kw.setdefault("detect_races", True)
+    return pltpu.InterpretParams(**kw)
+
+
+def default_interpret():
+    """What to pass as ``pallas_call(interpret=...)`` on this backend."""
+    return interpret_params() if on_cpu() else False

@@ -1,0 +1,55 @@
+"""Perf measurement + tracing harness.
+
+``perf_func`` mirrors the reference's CUDA-event wall-clock harness
+(reference python/triton_dist/utils.py:186-198); on TPU we block on the
+output buffers instead of recording events. ``group_profile`` mirrors the
+reference's merged chrome-trace context (utils.py:254-501); jax's profiler
+already merges multi-host traces, so it is a thin wrapper producing a
+Perfetto-loadable trace directory.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+def _block(tree):
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+
+
+def perf_func(func, iters: int = 10, warmup_iters: int = 3, return_result: bool = False):
+    """Return (result, avg_ms_per_iter); ``result`` is the last iteration's
+    output when ``return_result=True``, else None. ``func`` should return jax
+    arrays (they are blocked on for timing)."""
+    result = None
+    for _ in range(warmup_iters):
+        result = func()
+    _block(result)
+    start = time.perf_counter()
+    for _ in range(iters):
+        result = func()
+    _block(result)
+    elapsed_ms = (time.perf_counter() - start) * 1e3 / max(iters, 1)
+    if return_result:
+        return result, elapsed_ms
+    return None, elapsed_ms
+
+
+@contextlib.contextmanager
+def group_profile(name: str = "trace", do_prof: bool = True, out_dir: str = "prof"):
+    """Profile the enclosed region into ``{out_dir}/{name}`` (TensorBoard /
+    Perfetto format). Multi-host merging is native to jax's profiler."""
+    if not do_prof:
+        yield
+        return
+    path = f"{out_dir}/{name}"
+    jax.profiler.start_trace(path)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
