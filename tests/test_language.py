@@ -1,0 +1,72 @@
+"""dl.* language surface tests (parity: reference test_notify.py,
+test_distributed_wait.py — wait/notify/token discipline)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+import triton_dist_tpu.language as dl
+from triton_dist_tpu.shmem.context import initialize_distributed
+from triton_dist_tpu.utils import assert_allclose, default_interpret
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return initialize_distributed(axis_names=("x",))
+
+
+def test_rank_num_ranks(ctx):
+    def kernel(out_ref):
+        out_ref[0] = dl.rank("x")
+        out_ref[1] = dl.num_ranks("x")
+
+    def f():
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((2,), jnp.int32),
+            out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+            interpret=default_interpret(),
+        )()
+
+    y = jax.jit(ctx.shard_map(f, in_specs=(), out_specs=P("x")))()
+    y = np.asarray(y).reshape(ctx.num_ranks, 2)
+    assert list(y[:, 0]) == list(range(ctx.num_ranks))
+    assert all(v == ctx.num_ranks for v in y[:, 1])
+
+
+def test_notify_wait_roundtrip(ctx):
+    """Each PE notifies its right neighbor's REGULAR semaphore twice; the
+    neighbor waits for exactly 2 arrivals (counted, consumed)."""
+
+    def kernel(in_ref, out_ref, sem, scratch):
+        me = dl.rank("x")
+        n = dl.num_ranks("x")
+        right = dl.symm_at(("x",), "x", jax.lax.rem(me + 1, n))
+        dl.notify(sem, right, inc=1)
+        dl.notify(sem, right, inc=1)
+        token = dl.wait(sem, 2)
+        ref = dl.consume_token(in_ref, token)
+        pltpu.sync_copy(ref, scratch)
+        scratch[...] = scratch[...] + 1.0
+        pltpu.sync_copy(scratch, out_ref)
+
+    def f(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.REGULAR,
+                            pltpu.VMEM(x.shape, x.dtype)],
+            compiler_params=pltpu.CompilerParams(has_side_effects=True),
+            interpret=default_interpret(),
+        )(x)
+
+    n = ctx.num_ranks
+    x = jnp.ones((n * 8, 128), jnp.float32)
+    y = jax.jit(ctx.shard_map(f, in_specs=P("x"), out_specs=P("x")))(x)
+    assert_allclose(y, np.asarray(x) + 1.0)

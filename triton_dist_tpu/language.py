@@ -1,0 +1,68 @@
+"""``dl`` — the device-language surface of triton_dist_tpu.
+
+Mirrors the reference's ``triton_dist.language`` builtins
+(python/triton_dist/language.py:57-112: ``wait``, ``consume_token``,
+``rank``, ``num_ranks``, ``symm_at``, ``notify``) so kernels here read like
+the reference's kernels, while lowering to TPU-native constructs:
+
+- the reference's *token* discipline (``wait`` returns a token,
+  ``consume_token`` creates an artificial data dependency so loads are
+  ordered after the spin-wait — DistributedOps.td:79-109) is unnecessary on
+  TPU: Pallas semaphore waits are program-ordered with subsequent DMA/compute
+  already. ``wait``/``consume_token`` are kept for API parity and readability.
+- ``notify``'s SET mode (DistributedAttrDefs.td:36-40) has no TPU analog —
+  TPU semaphores count; all protocols in ``ops/`` use arrival counting.
+
+Usage inside a Pallas kernel::
+
+    import triton_dist_tpu.language as dl
+    me = dl.rank("x")
+    dl.notify(peer_sem, dl.symm_at(("x",), "x", peer), inc=1)
+    token = dl.wait(recv_sem, 1)
+    data = dl.consume_token(buf_ref, token)
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from triton_dist_tpu.shmem import device as _shd
+
+rank = _shd.my_pe
+num_ranks = _shd.n_pes
+
+
+def wait(sem_ref, count):
+    """Wait until ``sem_ref`` has accumulated ``count`` arrivals (consuming
+    them), and return a token ordering subsequent accesses. Analog of
+    ``dl.wait(barrier_ptrs, N, scope, semantic)`` (language.py:57-71); scope
+    and memory semantics are implicit in TPU semaphore hardware."""
+    _shd.signal_wait_until(sem_ref, count)
+    return ()
+
+
+def wait_recv(dst_ref, recv_sem):
+    """Wait for delivery of a one-sided put into ``dst_ref`` (DMA-semaphore
+    flavor of ``wait``)."""
+    _shd.wait_recv(dst_ref, recv_sem)
+    return ()
+
+
+def consume_token(ref, token):
+    """API-parity no-op (language.py:74-81): on TPU the wait above already
+    orders the accesses below it."""
+    del token
+    return ref
+
+
+def notify(sem_ref, pe=None, inc=1):
+    """Signal a (possibly remote) semaphore — analog of ``dl.notify``
+    (language.py:103-112) with ADD semantics."""
+    _shd.signal_op(sem_ref, inc, pe)
+
+
+def symm_at(axis_names: Sequence[str], axis: str, index):
+    """Flat logical device id of the peer at ``index`` along ``axis`` —
+    the addressing analog of ``dl.symm_at(ptr, rank)`` (language.py:96-100):
+    no pointer translation, remote refs are (buffer, device_id) pairs."""
+    return _shd.pe_at(axis_names, axis, index)

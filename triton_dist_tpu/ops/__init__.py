@@ -1,0 +1,7 @@
+"""Kernel library — overlapping distributed ops (the analog of reference
+python/triton_dist/kernels/nvidia/*, re-exported the same way its
+kernels/nvidia/__init__.py:25-89 does)."""
+
+from triton_dist_tpu.ops.common import collective_id_for, barrier_all_op  # noqa: F401
+from triton_dist_tpu.ops.allgather import all_gather  # noqa: F401
+from triton_dist_tpu.ops.reduce_scatter import reduce_scatter  # noqa: F401

@@ -1,0 +1,59 @@
+"""Shared kernel utilities (analog of reference
+python/triton_dist/kernels/nvidia/common_ops.py).
+
+On GPU the reference needs hand-written device barriers
+(common_ops.py:62-159: grid barriers, atomic-CAS intra-node barriers) and
+CPU-driven stream signal ops (:178-211). On TPU, barriers are the barrier
+semaphore, and there is no separate "stream signal plane" — the DMA
+semaphore of each remote copy is the signal.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.shmem import device as shd
+from triton_dist_tpu.shmem.context import ShmemContext
+from triton_dist_tpu.utils import default_interpret
+
+# Every kernel family that uses pltpu.get_barrier_semaphore() needs a
+# distinct collective id (matching across devices running the same kernel).
+# Ids must ONLY be passed for kernels that actually use barrier semaphores —
+# compiled TPU rejects them otherwise.
+_COLLECTIVE_IDS = {}
+_counter = itertools.count(0)
+
+
+def collective_id_for(name: str) -> int:
+    if name not in _COLLECTIVE_IDS:
+        _COLLECTIVE_IDS[name] = next(_counter)
+    return _COLLECTIVE_IDS[name]
+
+
+def barrier_all_op(ctx: ShmemContext, axis: str | None = None):
+    """Host-level device barrier across the mesh — analog of
+    ``barrier_all_on_stream`` (reference common_ops.py:162-175). Returns a
+    jitted callable performing a full-mesh in-kernel barrier."""
+    axes = ctx.axis_names if axis is None else (axis,)
+
+    def kernel(out_ref):
+        shd.barrier_all(axes, mesh_axes=ctx.axis_names)
+        out_ref[0] = 1
+
+    def f():
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((1,), "int32"),
+            out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=collective_id_for("barrier_all")),
+            interpret=default_interpret(),
+        )()
+
+    return jax.jit(ctx.shard_map(f, in_specs=(), out_specs=P(ctx.axis_names[0])))

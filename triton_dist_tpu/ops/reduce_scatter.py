@@ -1,0 +1,110 @@
+"""ReduceScatter kernel family (analog of reference
+python/triton_dist/kernels/nvidia/reduce_scatter.py).
+
+The reference builds a 2-D hierarchical RS from CE scatter copies, ring
+reduce kernels and inter-node p2p (reduce_scatter.py:45-785). The TPU-native
+core is a single in-kernel ring: each segment travels the ring once,
+accumulating each PE's contribution on the VPU, landing on its owner after
+n-1 hops — compute and communication overlap step-by-step by construction.
+
+Flow control: relay slots are reused every 2 steps, so a receiver *acks* its
+upstream sender after consuming a slot (REGULAR semaphore credits) — the
+TPU-native replacement for the reference's scatter_signal flags
+(gemm_reduce_scatter.py:77-87); DMA recv semaphores already provide the
+arrival signal.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.shmem import device as shd
+from triton_dist_tpu.shmem.context import ShmemContext
+from triton_dist_tpu.utils import default_interpret
+
+
+def _rs_ring_kernel(axis, mesh_axes, in_ref, out_ref,
+                    acc, loc, comm, send_sem, recv_sems, ack_sem):
+    """Ring reduce-scatter: segment j starts at PE j+1 and ends at its owner
+    PE j after n-1 right-hops, accumulating every PE's contribution."""
+    me = shd.my_pe(axis)
+    n = shd.n_pes(axis)
+    m = out_ref.shape[0]  # rows per segment
+    right_idx = lax.rem(me + 1, n)
+    right = shd.pe_at(mesh_axes, axis, right_idx)
+    left = shd.pe_at(mesh_axes, axis, lax.rem(me - 1 + n, n))
+
+    if n == 1:
+        pltpu.sync_copy(in_ref, out_ref)
+        return
+
+    # acc ← my contribution to the first segment I forward (j = me-1)
+    seg0 = lax.rem(me - 1 + n, n)
+    pltpu.sync_copy(in_ref.at[pl.ds(seg0 * m, m)], acc)
+
+    for s in range(n - 1):
+        slot = s % 2
+        if s >= 2:
+            # wait for downstream to have consumed the slot (credit)
+            shd.signal_wait_until(ack_sem, 1)
+        rdma = shd.putmem_nbi(comm.at[slot], acc, send_sem,
+                              recv_sems.at[slot], right)
+        rdma.wait_send()
+        # receive the partial travelling toward me from upstream
+        shd.wait_recv(comm.at[slot], recv_sems.at[slot])
+        seg = lax.rem(me - s - 2 + 2 * n, n)
+        pltpu.sync_copy(in_ref.at[pl.ds(seg * m, m)], loc)
+        acc[...] = comm[slot] + loc[...]
+        # tell upstream the slot is free again
+        shd.signal_op(ack_sem, 1, left)
+
+    pltpu.sync_copy(acc, out_ref)
+    # drain credits we never waited on (acks for the last ≤2 sends)
+    shd.signal_wait_until(ack_sem, min(n - 1, 2))
+
+
+def _rs_call(axis: str, mesh_axes, n: int, shard):
+    assert shard.shape[0] % n == 0, (
+        f"reduce_scatter: leading dim {shard.shape[0]} not divisible by {n}")
+    m = shard.shape[0] // n
+    seg_shape = (m,) + shard.shape[1:]
+    kernel = lambda i, o, *s: _rs_ring_kernel(axis, mesh_axes, i, o, *s)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(seg_shape, shard.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM(seg_shape, shard.dtype),   # acc
+            pltpu.VMEM(seg_shape, shard.dtype),   # loc
+            pltpu.VMEM((2,) + seg_shape, shard.dtype),  # relay slots
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=default_interpret(),
+    )(shard)
+
+
+def reduce_scatter(ctx: ShmemContext, x: jax.Array, axis: str | None = None):
+    """Reduce(sum)-scatter over ``axis``. ``x`` is globally ``(n*M, ...)``
+    sharded ``P(axis)`` — each device's local ``[M, ...]`` block is its own
+    full-size contribution (e.g. a GEMM partial). Device i receives the sum
+    of all contributions' segment i; the result is the ``(M, ...)`` global
+    array sharded ``P(axis)``. Golden: ``jax.lax.psum_scatter`` inside
+    shard_map."""
+    if axis is None:
+        axis = ctx.axis_names[0]
+    n = ctx.axis_size(axis)
+    mesh_axes = ctx.axis_names
+    f = lambda shard: _rs_call(axis, mesh_axes, n, shard)
+    sm = ctx.shard_map(f, in_specs=P(axis), out_specs=P(axis))
+    return sm(x)
+
+
+__all__ = ["reduce_scatter"]
