@@ -34,3 +34,11 @@ except Exception:
 assert jax.device_count() == 8, (
     f"expected 8 virtual CPU devices, got {jax.devices()}"
 )
+
+# NOTE: kernel tests build meshes over a 4-device *subset* of the 8 virtual
+# devices. On a single-core host, the Pallas TPU interpreter's device threads
+# can deadlock nondeterministically when >=7 of them block in semaphore
+# waits/barriers concurrently (threads pile up in the interpreter's internal
+# _barrier/_allocate_buffer); <=6 participating devices is reliable. The
+# kernels themselves are rank-count-generic.
+TEST_WORLD = 4

@@ -1,0 +1,68 @@
+"""AG-GEMM overlap op vs golden (parity target: reference
+test/nvidia/test_ag_gemm_intra_node.py — correctness case :128-148 builds the
+golden with all_gather + matmul; odd-ish shapes deliberately)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.ops.allgather_gemm import ag_gemm
+from triton_dist_tpu.ops.gemm import GemmConfig, matmul
+from conftest import TEST_WORLD
+from triton_dist_tpu.shmem.context import initialize_distributed
+from triton_dist_tpu.utils import assert_allclose
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return initialize_distributed(axis_names=("x",), mesh_shape=(TEST_WORLD,))
+
+
+def _golden(ctx, a, b):
+    def g(a_shard, b_shard):
+        a_full = jax.lax.all_gather(a_shard, "x", axis=0, tiled=True)
+        return jnp.dot(a_full, b_shard, preferred_element_type=jnp.float32)
+    sm = ctx.shard_map(g, in_specs=(P("x"), P(None, "x")),
+                       out_specs=P(None, "x"))
+    return jax.jit(sm)(a, b)
+
+
+def test_matmul_local():
+    a = jax.random.normal(jax.random.key(0), (64, 256), jnp.float32)
+    b = jax.random.normal(jax.random.key(1), (256, 128), jnp.float32)
+    c = jax.jit(lambda a, b: matmul(a, b, GemmConfig(block_m=32, block_n=64)))(a, b)
+    assert_allclose(c, np.asarray(a) @ np.asarray(b), atol=1e-2, rtol=1e-2)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_ag_gemm(ctx, dtype):
+    n = ctx.num_ranks
+    M, K, N = n * 32, 128, n * 64  # tiny Llama-shaped TP slice
+    a = jax.random.normal(jax.random.key(0), (M, K), jnp.float32).astype(dtype)
+    b = jax.random.normal(jax.random.key(1), (K, N), jnp.float32).astype(dtype)
+    a = ctx.shard(a, P("x"))
+    b = ctx.shard(b, P(None, "x"))
+    cfg = GemmConfig(block_m=32, block_n=64)
+    c = jax.jit(lambda a, b: ag_gemm(ctx, a, b, axis="x", cfg=cfg,
+                                     out_dtype=jnp.float32))(a, b)
+    golden = _golden(ctx, a, b)
+    tol = 1e-2 if dtype == jnp.bfloat16 else 1e-4
+    assert_allclose(np.asarray(c), np.asarray(golden), atol=tol, rtol=tol)
+
+
+def test_ag_gemm_repeated_calls(ctx):
+    """Back-to-back calls reuse workspace slots — the entry barrier must
+    prevent cross-call races (cf. local_copy_and_barrier_all)."""
+    n = ctx.num_ranks
+    M, K, N = n * 32, 128, n * 32
+    cfg = GemmConfig(block_m=32, block_n=32)
+    f = jax.jit(lambda a, b: ag_gemm(ctx, a, b, axis="x", cfg=cfg))
+    for i in range(3):
+        a = ctx.shard(jax.random.normal(jax.random.key(i), (M, K)), P("x"))
+        b = ctx.shard(jax.random.normal(jax.random.key(100 + i), (K, N)),
+                      P(None, "x"))
+        c = f(a, b)
+        golden = _golden(ctx, a, b)
+        assert_allclose(np.asarray(c), np.asarray(golden), atol=1e-4, rtol=1e-4)

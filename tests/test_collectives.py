@@ -12,18 +12,19 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from triton_dist_tpu.ops import all_gather, reduce_scatter, barrier_all_op
+from conftest import TEST_WORLD
 from triton_dist_tpu.shmem.context import initialize_distributed
 from triton_dist_tpu.utils import assert_allclose
 
 
 @pytest.fixture(scope="module")
 def ctx():
-    return initialize_distributed(axis_names=("x",))
+    return initialize_distributed(axis_names=("x",), mesh_shape=(TEST_WORLD,))
 
 
 @pytest.fixture(scope="module")
 def ctx2d():
-    return initialize_distributed(axis_names=("a", "b"), mesh_shape=(2, 4))
+    return initialize_distributed(axis_names=("a", "b"), mesh_shape=(2, 3))
 
 
 @pytest.mark.parametrize("method", ["push", "ring"])
@@ -39,7 +40,8 @@ def test_all_gather_1d(ctx, method, dtype):
 
 
 def test_all_gather_2d(ctx2d):
-    x = jnp.arange(8 * 8 * 128, dtype=jnp.float32).reshape(8 * 8, 128)
+    # asymmetric (2,3) mesh: a major/minor axis swap would change results
+    x = jnp.arange(6 * 8 * 128, dtype=jnp.float32).reshape(6 * 8, 128)
     x = ctx2d.shard(x, P(("a", "b")))
     y = jax.jit(lambda v: all_gather(ctx2d, v, method="ring_2d"))(x)
     assert_allclose(np.asarray(y), np.asarray(x))

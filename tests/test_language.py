@@ -10,13 +10,14 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 import triton_dist_tpu.language as dl
+from conftest import TEST_WORLD
 from triton_dist_tpu.shmem.context import initialize_distributed
 from triton_dist_tpu.utils import assert_allclose, default_interpret
 
 
 @pytest.fixture(scope="module")
 def ctx():
-    return initialize_distributed(axis_names=("x",))
+    return initialize_distributed(axis_names=("x",), mesh_shape=(TEST_WORLD,))
 
 
 def test_rank_num_ranks(ctx):

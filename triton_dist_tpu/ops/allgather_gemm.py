@@ -1,0 +1,135 @@
+"""AllGather-GEMM overlap (analog of reference
+python/triton_dist/kernels/nvidia/allgather_gemm.py).
+
+The reference overlaps a copy-engine allgather producer with a persistent
+consumer GEMM on separate CUDA streams, synchronized by per-rank flags that
+GEMM tiles spin-wait on, with a rank-swizzle so each rank computes its local
+segment first (allgather_gemm.py:203-217, :222-225, :405-527).
+
+TPU-native design — ONE kernel per device, no streams:
+
+1. On entry, a light barrier (cf. ``local_copy_and_barrier_all``,
+   allgather_gemm.py:99-116) protects the symmetric workspace across calls.
+2. Issue *all* remote puts of the local A-shard into every peer's workspace
+   slot ``me`` as non-blocking DMAs, plus a local copy into our own slot.
+   The ICI DMA engines are the "copy-engine producer" running in the
+   background of compute.
+3. Walk segments in swizzled order ``me, me+1, …`` (start-local trick),
+   wait that segment's receive semaphore once (TPU grids are sequential per
+   core — no per-tile spin flags needed), then run the pipelined MXU GEMM
+   for that segment via ``emit_gemm``.
+
+Segment-0 compute overlaps all in-flight transfers; steady state overlaps
+segment s's GEMM with segment s+1's arrival — same overlap structure, no
+CUDA-stream machinery.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.ops.common import collective_id_for
+from triton_dist_tpu.ops.gemm import GemmConfig, emit_gemm
+from triton_dist_tpu.shmem import device as shd
+from triton_dist_tpu.shmem.context import ShmemContext
+from triton_dist_tpu.utils import default_interpret
+
+
+def _ag_gemm_kernel(axis, mesh_axes, cfg, out_dtype,
+                    a_ref, b_ref, out_ref, ws_ref,
+                    send_sems, recv_sems):
+    # ws_ref is an HBM *output* used as the symmetric workspace (interpret
+    # mode does not allocate ANY-space scratch; an output works on both
+    # paths and is discarded by the host wrapper).
+    me = shd.my_pe(axis)
+    n = shd.n_pes(axis)
+    m_local = a_ref.shape[0]
+
+    # entry barrier: nobody puts into a peer's workspace before that peer
+    # has entered this call (workspace slots are reused across calls)
+    shd.barrier_all(axis if isinstance(axis, tuple) else (axis,),
+                    mesh_axes=mesh_axes)
+
+    # producer phase: local copy + puts to every peer (non-blocking)
+    local = pltpu.make_async_copy(a_ref, ws_ref.at[me], recv_sems.at[me])
+    local.start()
+    rdmas = []
+    for p in range(1, n):
+        dst = lax.rem(me + p, n)
+        pid = shd.pe_at(mesh_axes, axis, dst)
+        rdmas.append(shd.putmem_nbi(ws_ref.at[me], a_ref,
+                                    send_sems.at[dst], recv_sems.at[me], pid))
+
+    # consumer phase: swizzled segment loop, start local
+    for s in range(n):
+        seg = lax.rem(me + s, n)
+        shd.wait_recv(ws_ref.at[seg], recv_sems.at[seg])
+        emit_gemm(ws_ref.at[seg], b_ref,
+                  out_ref.at[pl.ds(seg * m_local, m_local)], cfg,
+                  out_dtype)
+
+    shd.quiet(*rdmas)
+
+
+def ag_gemm(ctx: ShmemContext, a: jax.Array, b: jax.Array,
+            axis: str | None = None, cfg: GemmConfig | None = None,
+            out_dtype=None) -> jax.Array:
+    """Tensor-parallel AllGather-GEMM: ``a`` is [M, K] sharded P(axis) on M
+    (each rank holds [M/n, K]); ``b`` is [K, N] sharded P(None, axis) on N
+    (column-parallel weight). Returns C = all_gather(a) @ b — [M, N] sharded
+    P(None, axis). Entry analog: ``ag_gemm_intra_node``
+    (allgather_gemm.py:835-880); golden: all_gather + dot."""
+    axis = axis or ctx.axis_names[0]
+    cfg = cfg or GemmConfig()
+    out_dtype = out_dtype or a.dtype
+    n = ctx.axis_size(axis)
+    mesh_axes = ctx.axis_names
+    M, K = a.shape
+    assert M % n == 0, f"M={M} not divisible by ranks {n}"
+    m_local = M // n
+    assert m_local % cfg.block_m == 0, (
+        f"local M {m_local} not divisible by block_m {cfg.block_m}")
+    assert cfg.vmem_ok(K, jnp.dtype(a.dtype).itemsize), (
+        f"tile config exceeds VMEM budget for K={K}")
+
+    def f(a_shard, b_shard):
+        kernel = lambda *refs: _ag_gemm_kernel(axis, mesh_axes, cfg,
+                                               out_dtype, *refs)
+        n_local = b_shard.shape[1]
+        flops = 2 * M * n_local * K
+        c, _ws = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((M, n_local), out_dtype),
+                jax.ShapeDtypeStruct((n, m_local, K), a_shard.dtype),  # symm ws
+            ),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pl.ANY)),
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA((n,)),
+                pltpu.SemaphoreType.DMA((n,)),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=collective_id_for("ag_gemm")),
+            cost_estimate=pl.CostEstimate(
+                flops=flops,
+                bytes_accessed=(a_shard.size + b_shard.size) * 2 + M * n_local * 2,
+                transcendentals=0),
+            interpret=default_interpret(),
+        )(a_shard, b_shard)
+        return c
+
+    sm = ctx.shard_map(f, in_specs=(P(axis), P(None, axis)),
+                       out_specs=P(None, axis))
+    return sm(a, b)
+
+
+__all__ = ["ag_gemm", "GemmConfig"]

@@ -1,0 +1,101 @@
+"""Tiled MXU matmul building blocks, shared by the overlap ops.
+
+The reference's consumer GEMMs are persistent-TMA Triton kernels
+(allgather_gemm.py:131-252, gemm_reduce_scatter.py:104-234). On TPU the
+equivalent machinery is ``pltpu.emit_pipeline``: an in-kernel double-buffered
+HBM→VMEM pipeline feeding ``jnp.dot`` on the MXU. Keeping it as a helper lets
+every overlap kernel (AG-GEMM, GEMM-RS, grouped GEMM) call it per *segment*,
+right after that segment's arrival semaphore is waited — the TPU analog of
+per-tile ``dl.wait`` + ``tl.dot``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.utils import default_interpret
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmConfig:
+    """Tile config (the analog of the reference's BLOCK_SIZE_M/N/K context
+    knobs, e.g. allgather_gemm.py:744-782). K is kept un-split per tile —
+    full-K VMEM strips keep the MXU busy without an accumulator round-trip;
+    ``vmem_ok`` guards the VMEM budget."""
+
+    block_m: int = 128
+    block_n: int = 128
+
+    def vmem_bytes(self, K: int, bytes_per_el: int) -> int:
+        # A strip + B strip + out tile, double-buffered by emit_pipeline
+        return 2 * bytes_per_el * (self.block_m * K + K * self.block_n
+                                   + self.block_m * self.block_n)
+
+    def vmem_ok(self, K: int, bytes_per_el: int, budget: int = 12 * 2**20) -> bool:
+        return self.vmem_bytes(K, bytes_per_el) <= budget
+
+
+def emit_gemm(a_ref, b_ref, out_ref, cfg: GemmConfig, out_dtype=None):
+    """Run a pipelined GEMM ``out = a @ b`` over HBM refs, inside a kernel.
+
+    a_ref: [M, K], b_ref: [K, N], out_ref: [M, N]. M % block_m == 0,
+    N % block_n == 0 (pad upstream — the reference pads M the same way,
+    gemm_reduce_scatter.py:482-493).
+    """
+    M, K = a_ref.shape
+    K2, N = b_ref.shape
+    assert K == K2, f"inner dims mismatch {K} vs {K2}"
+    assert M % cfg.block_m == 0 and N % cfg.block_n == 0, (
+        f"gemm shapes [{M},{K}]x[{K},{N}] not divisible by tile "
+        f"({cfg.block_m},{cfg.block_n})")
+    out_dtype = out_dtype or out_ref.dtype
+
+    def body(a_blk, b_blk, o_blk):
+        o_blk[...] = jnp.dot(a_blk[...], b_blk[...],
+                             preferred_element_type=jnp.float32
+                             ).astype(out_dtype)
+
+    grid = (M // cfg.block_m, N // cfg.block_n)
+    pltpu.emit_pipeline(
+        body,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((cfg.block_m, K), lambda i, j: (i, 0)),
+            pl.BlockSpec((K, cfg.block_n), lambda i, j: (0, j)),
+        ],
+        out_specs=[pl.BlockSpec((cfg.block_m, cfg.block_n),
+                                lambda i, j: (i, j))],
+    )(a_ref, b_ref, out_ref)
+
+
+def matmul(a: jax.Array, b: jax.Array, cfg: GemmConfig | None = None,
+           out_dtype=None) -> jax.Array:
+    """Standalone single-device Pallas matmul (test/bench baseline)."""
+    cfg = cfg or GemmConfig()
+    out_dtype = out_dtype or a.dtype
+    M, K = a.shape
+    _, N = b.shape
+
+    def kernel(a_ref, b_ref, out_ref):
+        emit_gemm(a_ref, b_ref, out_ref, cfg, out_dtype)
+
+    flops = 2 * M * N * K
+    bytes_accessed = (a.size * a.dtype.itemsize + b.size * b.dtype.itemsize
+                      + M * N * jnp.dtype(out_dtype).itemsize)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        cost_estimate=pl.CostEstimate(flops=flops,
+                                      bytes_accessed=bytes_accessed,
+                                      transcendentals=0),
+        interpret=default_interpret(),
+    )(a, b)

@@ -57,7 +57,13 @@ def initialize_distributed(axis_names: Sequence[str] = ("x",),
     devices = np.array(jax.devices())
     if mesh_shape is None:
         mesh_shape = (devices.size,) + (1,) * (len(axis_names) - 1)
-    mesh = Mesh(devices.reshape(tuple(mesh_shape)), tuple(axis_names))
+    n_mesh = int(np.prod(mesh_shape))
+    if n_mesh > devices.size:
+        raise ValueError(f"mesh_shape {mesh_shape} needs {n_mesh} devices, "
+                         f"only {devices.size} available")
+    # A prefix subset is allowed (e.g. a 4-device test mesh on an 8-device
+    # host, or one slice of a larger deployment).
+    mesh = Mesh(devices[:n_mesh].reshape(tuple(mesh_shape)), tuple(axis_names))
     ctx = ShmemContext(mesh=mesh)
     _DEFAULT_CONTEXT = ctx
     return ctx

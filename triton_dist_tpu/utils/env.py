@@ -44,6 +44,48 @@ def interpret_params(**kw) -> "pltpu.InterpretParams":
     return pltpu.InterpretParams(**kw)
 
 
+@lru_cache(None)
+def _register_cpu_tpu_info():
+    """Interpret mode runs kernels on CPU devices, but Pallas helpers that
+    model the hardware (``emit_pipeline`` tiling) still query
+    ``tpu_info.get_tpu_info()``. Register a v5e-like profile for the "cpu"
+    device kind via the module's public ``registry`` hook so those helpers
+    work in the simulator."""
+    try:
+        from jax._src.pallas.mosaic import tpu_info
+    except ImportError:
+        return  # private API moved; only emit_pipeline-style helpers notice
+
+    def _cpu_info():  # matches jax 0.9 TpuInfo; guarded below for drift
+        return tpu_info.TpuInfo(
+            chip_version=tpu_info.ChipVersion.TPU_V5E,
+            generation=5,
+            num_cores=1,
+            num_lanes=128,
+            num_sublanes=8,
+            mxu_column_size=128,
+            vmem_capacity_bytes=128 * 1024 * 1024,
+            cmem_capacity_bytes=0,
+            smem_capacity_bytes=1024 * 1024,
+            hbm_capacity_bytes=17_200_000_000,
+            mem_bw_bytes_per_second=int(8.20e11),
+            bf16_ops_per_second=int(1.97e14),
+            int8_ops_per_second=int(3.94e14),
+            fp8_ops_per_second=0,
+            int4_ops_per_second=int(7.88e14),
+        )
+
+    try:
+        _cpu_info()  # fail fast here (not inside a kernel) if TpuInfo drifted
+        tpu_info.registry.setdefault("cpu", _cpu_info)
+    except Exception:
+        pass  # only emit_pipeline-dependent paths will then raise, with
+        #       jax's own "Unsupported TPU device kind" message
+
+
 def default_interpret():
     """What to pass as ``pallas_call(interpret=...)`` on this backend."""
-    return interpret_params() if on_cpu() else False
+    if on_cpu():
+        _register_cpu_tpu_info()
+        return interpret_params()
+    return False
