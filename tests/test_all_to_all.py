@@ -1,0 +1,102 @@
+"""EP All-to-All dispatch/combine tests (parity targets: reference
+test/nvidia/test_all_to_all.py, test_ep_a2a.py — dispatch correctness against
+a dense golden, then a full dispatch→expert-compute→combine round trip)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from conftest import TEST_WORLD
+from triton_dist_tpu.ops.all_to_all import (
+    all_to_all_push, combine, create_all_to_all_context, dispatch,
+    route_tokens)
+from triton_dist_tpu.shmem.context import initialize_distributed
+from triton_dist_tpu.utils import assert_allclose
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return initialize_distributed(axis_names=("x",), mesh_shape=(TEST_WORLD,))
+
+
+def test_all_to_all_push_collective(ctx):
+    """Wire collective: out[src] on device d == in[d] on device src.
+    Golden: jax.lax.all_to_all."""
+    n = ctx.num_ranks
+    x = jax.random.normal(jax.random.key(0), (n * n, 8, 128), jnp.float32)
+    xs = ctx.shard(x, P("x"))
+    (y,) = jax.jit(lambda v: all_to_all_push(ctx, v))(xs)
+
+    def g(shard):
+        return jax.lax.all_to_all(shard, "x", split_axis=0, concat_axis=0,
+                                  tiled=True)
+    golden = jax.jit(ctx.shard_map(g, in_specs=P("x"), out_specs=P("x")))(xs)
+    assert_allclose(np.asarray(y), np.asarray(golden))
+
+
+def test_route_tokens_slots_unique(ctx):
+    a2a = create_all_to_all_context(ctx, max_tokens=16, hidden=128, topk=2,
+                                    num_experts=ctx.num_ranks * 2)
+    ids = jax.random.randint(jax.random.key(1), (16, 2), 0, a2a.num_experts)
+    dest, slot, valid = route_tokens(a2a, ids)
+    # within one destination rank, slots must be unique
+    d, s = np.asarray(dest).reshape(-1), np.asarray(slot).reshape(-1)
+    for r in range(a2a.n_ranks):
+        ss = s[d == r]
+        assert len(set(ss.tolist())) == len(ss), f"dup slots for rank {r}"
+
+
+def _moe_golden(tokens, topk_ids, topk_w, expert_scale):
+    """Dense golden: expert e multiplies token by expert_scale[e]."""
+    t = np.asarray(tokens, np.float32)
+    out = np.zeros_like(t)
+    ids, w = np.asarray(topk_ids), np.asarray(topk_w, np.float32)
+    for i in range(t.shape[0]):
+        acc = 0.0
+        for j in range(ids.shape[1]):
+            acc = acc + w[i, j] * (t[i] * expert_scale[ids[i, j]])
+        out[i] = acc
+    return out
+
+
+def test_dispatch_combine_roundtrip(ctx):
+    """Full EP MoE round trip with a linear 'expert' (scale per expert):
+    dispatch → per-rank processing of received tokens → combine. Matches the
+    dense golden exactly in f32."""
+    n = ctx.num_ranks
+    T, H, k, E = 8, 128, 2, n * 2
+    a2a = create_all_to_all_context(ctx, max_tokens=T, hidden=H, topk=k,
+                                    num_experts=E, dtype=jnp.float32)
+    tokens = jax.random.normal(jax.random.key(0), (n * T, H), jnp.float32)
+    # distinct experts per token (sample without replacement per row)
+    keys = jax.random.split(jax.random.key(1), n * T)
+    topk_ids = jnp.stack([jax.random.permutation(kk, E)[:k] for kk in keys])
+    topk_w = jax.nn.softmax(
+        jax.random.normal(jax.random.key(2), (n * T, k)), axis=-1)
+
+    tokens_s = ctx.shard(tokens, P("x"))
+    ids_s = ctx.shard(topk_ids, P("x"))
+    w_s = ctx.shard(topk_w, P("x"))
+
+    expert_scale = jnp.arange(1.0, E + 1.0, dtype=jnp.float32)  # scale per expert
+
+    def process(recv_tok, recv_ids):
+        # recv_tok [n, cap, H], recv_ids [n, cap] local expert ids (or -1)
+        me_base = jax.lax.axis_index("x") * a2a.experts_per_rank
+        gid = jnp.where(recv_ids >= 0, recv_ids + me_base, 0)
+        scale = expert_scale[gid] * (recv_ids >= 0)
+        return recv_tok * scale[..., None]
+
+    @jax.jit
+    def run(tokens_s, ids_s, w_s):
+        recv_tok, recv_ids, layout = dispatch(a2a, tokens_s, ids_s)
+        proc = ctx.shard_map(process, in_specs=(P("x"), P("x")),
+                             out_specs=P("x"))(recv_tok, recv_ids)
+        return combine(a2a, proc, layout, w_s)
+
+    out = run(tokens_s, ids_s, w_s)
+    golden = _moe_golden(tokens, topk_ids, topk_w,
+                         np.asarray(expert_scale))
+    assert_allclose(np.asarray(out), golden, atol=1e-4, rtol=1e-4)

@@ -121,7 +121,8 @@ def ag_gemm(ctx: ShmemContext, a: jax.Array, b: jax.Array,
                 collective_id=collective_id_for("ag_gemm")),
             cost_estimate=pl.CostEstimate(
                 flops=flops,
-                bytes_accessed=(a_shard.size + b_shard.size) * 2 + M * n_local * 2,
+                bytes_accessed=(a_shard.size + b_shard.size + M * n_local)
+                * jnp.dtype(a_shard.dtype).itemsize,
                 transcendentals=0),
             interpret=default_interpret(),
         )(a_shard, b_shard)

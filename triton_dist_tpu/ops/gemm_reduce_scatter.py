@@ -1,0 +1,156 @@
+"""GEMM-ReduceScatter overlap (analog of reference
+python/triton_dist/kernels/nvidia/gemm_reduce_scatter.py).
+
+The reference runs a producer GEMM that writes tiles into a symmetric buffer
+and sets per-tile scatter signals, with a reduce-scatter consumer draining
+them on a second stream under an SM budget (gemm_reduce_scatter.py:77-87,
+:104-234, :482-521). TPU-native single-kernel design:
+
+1. Walk output segments in swizzled order ``me+1, me+2, …, me`` (own segment
+   LAST — its result never travels, so remote partials spend the longest
+   possible time in flight behind compute).
+2. For each remote segment: pipelined MXU GEMM of that segment's rows into a
+   double-buffered staging slot, then a non-blocking put of the partial into
+   the owner's symmetric slot ``me``. Stage slots are reused every 2 steps,
+   guarded by the send semaphore of the put issued 2 steps earlier.
+3. Own segment: GEMM straight into our symmetric slot ``me`` (no copy).
+4. Reduce phase: wait each peer's arrival once, then a pipelined VPU
+   reduction over the ``n`` partial slots → output shard.
+
+Row-parallel TP semantics: A is [M, K] K-sharded, B is [K, N] K-sharded
+(row-parallel weight); each rank's partial is A_local @ B_local and ranks
+receive the M/n rows they own, summed over all ranks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.ops.common import collective_id_for
+from triton_dist_tpu.ops.gemm import GemmConfig, emit_gemm
+from triton_dist_tpu.shmem import device as shd
+from triton_dist_tpu.shmem.context import ShmemContext
+from triton_dist_tpu.utils import default_interpret
+
+
+def _gemm_rs_kernel(axis, mesh_axes, cfg, acc_dtype,
+                    a_ref, b_ref, out_ref, ws_ref, stage_ref,
+                    send_sems, recv_sems):
+    me = shd.my_pe(axis)
+    n = shd.n_pes(axis)
+    m_seg = out_ref.shape[0]
+
+    shd.barrier_all((axis,), mesh_axes=mesh_axes)
+
+    rdmas = [None] * max(n - 1, 0)
+    for s in range(n - 1):
+        seg = lax.rem(me + 1 + s, n)
+        slot = s % 2
+        if s >= 2:
+            rdmas[s - 2].wait_send()  # stage slot free again
+        emit_gemm(a_ref.at[pl.ds(seg * m_seg, m_seg)], b_ref,
+                  stage_ref.at[slot], cfg, acc_dtype)
+        pid = shd.pe_at(mesh_axes, axis, seg)
+        rdmas[s] = shd.putmem_nbi(ws_ref.at[me], stage_ref.at[slot],
+                                  send_sems.at[slot], recv_sems.at[me], pid)
+
+    # own segment straight into our own slot
+    emit_gemm(a_ref.at[pl.ds(me * m_seg, m_seg)], b_ref,
+              ws_ref.at[me], cfg, acc_dtype)
+
+    for s in range(max(n - 3, 0), n - 1):
+        rdmas[s].wait_send()
+    for p in range(1, n):
+        src = lax.rem(me + p, n)
+        shd.wait_recv(ws_ref.at[src], recv_sems.at[src])
+
+    # reduction over the n partial slots (VPU), pipelined over output tiles
+    bm = min(cfg.block_m, m_seg)
+    N = out_ref.shape[1]
+    bn = min(cfg.block_n, N)
+
+    def body(ws_blk, o_blk):
+        o_blk[...] = jnp.sum(
+            ws_blk[...].astype(jnp.float32), axis=0
+        ).astype(out_ref.dtype)
+
+    pltpu.emit_pipeline(
+        body,
+        grid=(m_seg // bm, N // bn),
+        in_specs=[pl.BlockSpec((n, bm, bn), lambda i, j: (0, i, j))],
+        out_specs=[pl.BlockSpec((bm, bn), lambda i, j: (i, j))],
+    )(ws_ref, out_ref)
+
+
+def gemm_rs(ctx: ShmemContext, a: jax.Array, b: jax.Array,
+            axis: str | None = None, cfg: GemmConfig | None = None,
+            out_dtype=None) -> jax.Array:
+    """Row-parallel GEMM + ReduceScatter: ``a`` [M, K] sharded P(None, axis),
+    ``b`` [K, N] sharded P(axis, None). Returns sum_r(a_r @ b_r) scattered
+    over M — global [M, N] sharded P(axis). Entry analog: ``gemm_rs``
+    (gemm_reduce_scatter.py:524-538); golden: dot + psum_scatter."""
+    axis = axis or ctx.axis_names[0]
+    cfg = cfg or GemmConfig()
+    n = ctx.axis_size(axis)
+    mesh_axes = ctx.axis_names
+    M, K = a.shape
+    Kb, N = b.shape
+    assert K == Kb, f"A/B inner dims {K} vs {Kb}"
+    assert M % n == 0, f"M={M} not divisible by ranks {n}"
+    m_seg = M // n
+    out_dtype = out_dtype or a.dtype
+    acc_dtype = jnp.float32 if out_dtype == jnp.bfloat16 else out_dtype
+    # clamp tiles to the segment, then require exact divisibility
+    cfg = GemmConfig(block_m=min(cfg.block_m, m_seg),
+                     block_n=min(cfg.block_n, N))
+    assert m_seg % cfg.block_m == 0, (
+        f"segment rows {m_seg} not divisible by block_m {cfg.block_m}")
+    assert N % cfg.block_n == 0, (
+        f"N={N} not divisible by block_n {cfg.block_n}")
+    k_local_g = K // n
+    assert cfg.vmem_ok(k_local_g, jnp.dtype(a.dtype).itemsize), (
+        f"tile config exceeds VMEM budget for K_local={k_local_g}")
+
+    def f(a_shard, b_shard):
+        kernel = lambda *refs: _gemm_rs_kernel(axis, mesh_axes, cfg,
+                                               acc_dtype, *refs)
+        k_local = a_shard.shape[1]
+        out, _ws, _stage = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((m_seg, N), out_dtype),
+                jax.ShapeDtypeStruct((n, m_seg, N), acc_dtype),   # symm slots
+                jax.ShapeDtypeStruct((2, m_seg, N), acc_dtype),   # send stage
+            ),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pl.ANY)),
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((n,)),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=collective_id_for("gemm_rs")),
+            cost_estimate=pl.CostEstimate(
+                flops=2 * M * N * k_local,
+                bytes_accessed=(a_shard.size + b_shard.size + m_seg * N)
+                * jnp.dtype(a_shard.dtype).itemsize,
+                transcendentals=0),
+            interpret=default_interpret(),
+        )(a_shard, b_shard)
+        return out
+
+    sm = ctx.shard_map(f, in_specs=(P(None, axis), P(axis, None)),
+                       out_specs=P(axis))
+    return sm(a, b)
+
+
+__all__ = ["gemm_rs"]
