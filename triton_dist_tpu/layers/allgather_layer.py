@@ -1,0 +1,38 @@
+"""AllGather module layer (analog of reference
+layers/nvidia/low_latency_allgather_layer.py:31-195 — a stage-buffered
+wrapper exposing one ``forward_*`` per AG algorithm)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from triton_dist_tpu.ops.allgather import all_gather
+from triton_dist_tpu.shmem.context import ShmemContext
+
+
+@dataclasses.dataclass(frozen=True)
+class AllGatherLayer:
+    """Method-per-algorithm wrapper. The reference stages inputs into
+    persistent symmetric buffers keyed by a rotating stage index
+    (low_latency_allgather_layer.py:44-62); jax allocates per-call output
+    buffers, so no stage bookkeeping is needed."""
+    ctx: ShmemContext
+    axis: str | None = None
+
+    def forward_push(self, x: jax.Array) -> jax.Array:
+        """Full-mesh one-hop push (≈ forward_pull/push 1-stage variants)."""
+        return all_gather(self.ctx, x, axis=self.axis, method="push")
+
+    def forward_ring(self, x: jax.Array) -> jax.Array:
+        """1-D bandwidth-optimal ring (≈ forward_push_2d)."""
+        return all_gather(self.ctx, x, axis=self.axis, method="ring")
+
+    def forward_ring_2d(self, x: jax.Array) -> jax.Array:
+        """Hierarchical 2-D ring for multi-axis meshes (≈ forward_push_numa_2d
+        / the multinode variants)."""
+        return all_gather(self.ctx, x, method="ring_2d")
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        return all_gather(self.ctx, x, axis=self.axis, method="auto")

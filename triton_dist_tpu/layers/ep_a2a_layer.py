@@ -1,0 +1,46 @@
+"""EP All-to-All module layer (analog of reference
+layers/nvidia/ep_a2a_layer.py:31-240 — preprocess/dispatch/combine
+orchestration over the low-latency A2A kernels)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.ops import all_to_all as a2a_ops
+from triton_dist_tpu.shmem.context import ShmemContext
+
+
+@dataclasses.dataclass(frozen=True)
+class EPAll2AllLayer:
+    """Holds the static A2A context (buffer shapes/capacity) — the role the
+    reference's layer plays with its preprocess()/dispatch()/combine()
+    triple (ep_a2a_layer.py:110-240). The routing *layout* is returned by
+    ``dispatch`` and passed to ``combine`` explicitly (it contains traced
+    arrays; stashing it on the layer would leak tracers across jit
+    boundaries)."""
+    a2a: a2a_ops.EpAllToAllContext
+
+    @classmethod
+    def create(cls, ctx: ShmemContext, max_tokens: int, hidden: int,
+               topk: int, num_experts: int, capacity: int | None = None,
+               axis: str | None = None, dtype=jnp.bfloat16):
+        return cls(a2a_ops.create_all_to_all_context(
+            ctx, max_tokens, hidden, topk, num_experts,
+            capacity=capacity, axis=axis, dtype=dtype))
+
+    def preprocess(self, topk_ids: jax.Array):
+        """Routing plan only (≈ layer.preprocess token sort,
+        ep_a2a_layer.py:110-130). Runs per-shard under shard_map."""
+        return a2a_ops.route_tokens(self.a2a, topk_ids)
+
+    def dispatch(self, tokens: jax.Array, topk_ids: jax.Array):
+        """Returns (recv_tokens, recv_ids, layout); thread ``layout`` into
+        ``combine``."""
+        return a2a_ops.dispatch(self.a2a, tokens, topk_ids)
+
+    def combine(self, processed: jax.Array, layout,
+                topk_weights: jax.Array) -> jax.Array:
+        return a2a_ops.combine(self.a2a, processed, layout, topk_weights)

@@ -1,0 +1,39 @@
+"""Sequence-parallel GQA flash-decode attention module (analog of reference
+layers/nvidia/sp_flash_decode_layer.py:43-184 ``SpGQAFlashDecodeAttention``).
+
+The reference module owns a growable AG staging buffer and toggles between
+JIT and AOT kernel paths (:111-132, :96-105). Here buffers are per-call and
+the AOT path is ``jax.jit(...).lower().compile()`` (see tools.aot), so the
+module reduces to configuration + the three-phase forward."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from triton_dist_tpu.ops.flash_decode import sp_gqa_flash_decode
+from triton_dist_tpu.shmem.context import ShmemContext
+
+
+@dataclasses.dataclass(frozen=True)
+class SpGQAFlashDecodeAttention:
+    ctx: ShmemContext
+    num_q_heads: int
+    num_kv_heads: int
+    head_dim: int
+    axis: str | None = None
+    block_s: int = 128
+    ag_method: str = "push"   # latency-bound partials -> one-hop push
+
+    def __call__(self, q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                 global_kv_lens: jax.Array) -> jax.Array:
+        """q [B, Hq, D] replicated; k/v_cache [B, Hkv, S, D] sequence-sharded
+        P(None, None, axis); global_kv_lens [B]. Returns [B, Hq, D] replicated
+        (local split-KV decode → partial (out‖lse) allgather → lse-merge)."""
+        B, Hq, D = q.shape
+        assert Hq == self.num_q_heads and D == self.head_dim
+        return sp_gqa_flash_decode(self.ctx, q, k_cache, v_cache,
+                                   global_kv_lens, axis=self.axis,
+                                   block_s=self.block_s,
+                                   ag_method=self.ag_method)

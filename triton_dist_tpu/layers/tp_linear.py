@@ -1,0 +1,43 @@
+"""Tensor-parallel linear layers over the overlap kernels — the module-level
+API the reference exposes through tutorials 07/08 (AG-GEMM forward,
+GEMM-RS forward) rather than as classes; provided as first-class layers
+here."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from triton_dist_tpu.ops.allgather_gemm import ag_gemm
+from triton_dist_tpu.ops.gemm import GemmConfig
+from triton_dist_tpu.ops.gemm_reduce_scatter import gemm_rs
+from triton_dist_tpu.shmem.context import ShmemContext
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnParallelLinear:
+    """y = all_gather(x) @ W with W column-sharded — the Megatron-style
+    first TP linear, computed by the AG-GEMM overlap kernel
+    (cf. reference allgather_gemm.py:835-880)."""
+    ctx: ShmemContext
+    axis: str | None = None
+    cfg: GemmConfig | None = None
+
+    def __call__(self, x: jax.Array, w: jax.Array, out_dtype=None):
+        return ag_gemm(self.ctx, x, w, axis=self.axis, cfg=self.cfg,
+                       out_dtype=out_dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class RowParallelLinear:
+    """y = reduce_scatter(x @ W) with W row-sharded — the second TP linear,
+    computed by the GEMM-RS overlap kernel
+    (cf. reference gemm_reduce_scatter.py:524-538)."""
+    ctx: ShmemContext
+    axis: str | None = None
+    cfg: GemmConfig | None = None
+
+    def __call__(self, x: jax.Array, w: jax.Array, out_dtype=None):
+        return gemm_rs(self.ctx, x, w, axis=self.axis, cfg=self.cfg,
+                       out_dtype=out_dtype)

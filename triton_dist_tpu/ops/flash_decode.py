@@ -1,0 +1,241 @@
+"""Distributed Flash-Decoding (analog of reference
+python/triton_dist/kernels/nvidia/flash_decode.py + the SP layer
+sp_flash_decode_layer.py).
+
+Reference structure: per-rank split-KV GQA decode kernel (flash_decode.py
+:129-280) + intra-rank combine (:392-480), then a low-latency allgather of
+each rank's partial (out ‖ lse) and an inter-rank lse-weighted combine
+(:481-566). Sequence parallelism = KV cache sharded over ranks
+(SURVEY §5.7); batch=1 decode is the target.
+
+TPU-native mapping:
+
+- GPU split-KV exists to fill SMs with (batch × head × split) blocks. A TPU
+  core runs its grid sequentially, so the *intra-rank* split is pointless —
+  the kernel is a single-pass online-softmax walk over the local KV shard
+  (the grid's S dimension pipelines KV blocks HBM→VMEM instead). The
+  *inter-rank* split IS the SP sharding, and the partial-merge math
+  (m/l/lse bookkeeping) is identical to the reference's combine kernels.
+- lse rides the wire lane-broadcast ([…, 128]) so every DMA slice stays
+  tiling-aligned.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.ops.allgather import all_gather
+from triton_dist_tpu.shmem.context import ShmemContext
+from triton_dist_tpu.utils import default_interpret
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(kv_len_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
+                   acc, m_i, l_i, *, block_s: int, sm_scale: float,
+                   n_kv_heads: int):
+    """Grid (B, S//block_s). Online softmax over KV blocks; all Hq query
+    heads are processed per step as a [Hkv, G, ·] batched contraction (Mosaic
+    needs the last-two block dims full/aligned, so heads are not split).
+    Analog of kernel_gqa_fwd_batch_decode_split_kv (flash_decode.py:129-280)
+    with the split-KV dimension replaced by sequential KV-block pipelining.
+    """
+    b = pl.program_id(0)
+    s = pl.program_id(1)
+    n_s = pl.num_programs(1)
+
+    @pl.when(s == 0)
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+        m_i[...] = jnp.full_like(m_i, NEG_INF)
+        l_i[...] = jnp.zeros_like(l_i)
+
+    kv_len = kv_len_ref[b]
+
+    @pl.when(s * block_s < kv_len)
+    def _():
+        Hq, D = acc.shape
+        G = Hq // n_kv_heads
+        q = q_ref[0].astype(jnp.float32).reshape(n_kv_heads, G, D)
+        k = k_ref[0].astype(jnp.float32)             # [Hkv, block_s, D]
+        v = v_ref[0].astype(jnp.float32)             # [Hkv, block_s, D]
+        scores = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * sm_scale  # [Hkv, G, bs]
+        scores = scores.reshape(Hq, block_s)
+        pos = s * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        scores = jnp.where(pos < kv_len, scores, NEG_INF)
+        m_new = jnp.maximum(m_i[...], jnp.max(scores, axis=1, keepdims=True))
+        alpha = jnp.exp(m_i[...] - m_new)
+        p = jnp.exp(scores - m_new)                  # [Hq, block_s]
+        l_i[...] = l_i[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.reshape(n_kv_heads, G, block_s), v,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32).reshape(Hq, D)
+        acc[...] = acc[...] * alpha + pv
+        m_i[...] = m_new
+
+    @pl.when(s == n_s - 1)
+    def _():
+        l_safe = jnp.where(l_i[...] > 0, l_i[...], 1.0)
+        out_ref[0] = (acc[...] / l_safe).astype(out_ref.dtype)
+        # lse = m + log(l); empty shard -> NEG_INF so combine ignores it
+        lse = jnp.where(l_i[...] > 0, m_i[...] + jnp.log(l_safe), NEG_INF)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def gqa_decode_partial(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                       kv_len: jax.Array, block_s: int = 128,
+                       sm_scale: float | None = None):
+    """Single-device split-KV decode over a (possibly partial) KV shard.
+    q [B, Hq, D]; k_cache/v_cache [B, Hkv, S, D] (head-major layout so KV
+    blocks are tiling-aligned DMA slices); kv_len [B] valid keys. Returns
+    (out [B, Hq, D] in q.dtype, lse [B, Hq, 128] f32 lane-broadcast).
+    Entry analog: gqa_fwd_batch_decode_intra_rank (flash_decode.py:847-930).
+    """
+    B, Hq, D = q.shape
+    _, Hkv, S, _ = k_cache.shape
+    assert Hq % Hkv == 0
+    block_s = min(block_s, S)
+    if S % block_s != 0:
+        # fall back to the largest common divisor so ragged shard lengths
+        # (e.g. S=192 with block_s=128) still work; kv_len masking handles
+        # the tail either way
+        block_s = math.gcd(S, block_s)
+    assert block_s % 8 == 0 or block_s == S, (
+        f"KV shard length {S} has no tiling-aligned block size; pad the "
+        f"cache (second-minor DMA dims must be multiples of 8)")
+    sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+
+    kernel = functools.partial(_decode_kernel, block_s=block_s,
+                               sm_scale=sm_scale, n_kv_heads=Hkv)
+    grid = (B, S // block_s)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, Hq, D), lambda b, s, kl: (b, 0, 0)),
+                pl.BlockSpec((1, Hkv, block_s, D),
+                             lambda b, s, kl: (b, 0, s, 0)),
+                pl.BlockSpec((1, Hkv, block_s, D),
+                             lambda b, s, kl: (b, 0, s, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, Hq, D), lambda b, s, kl: (b, 0, 0)),
+                pl.BlockSpec((1, Hq, 128), lambda b, s, kl: (b, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((Hq, D), jnp.float32),
+                pltpu.VMEM((Hq, 1), jnp.float32),
+                pltpu.VMEM((Hq, 1), jnp.float32),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, Hq, 128), jnp.float32),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * Hq * S * D,
+            bytes_accessed=(q.size + k_cache.size + v_cache.size) * 2,
+            transcendentals=B * Hq * S),
+        interpret=default_interpret(),
+    )(kv_len, q, k_cache, v_cache)
+
+
+def _combine_kernel(outs_ref, lses_ref, out_ref):
+    """Inter-rank lse-weighted merge (analog of
+    kernel_inter_rank_gqa_fwd_batch_decode_combine_kv,
+    flash_decode.py:481-566). Grid (B,): merge R partials for one batch."""
+    outs = outs_ref[:, 0].astype(jnp.float32)       # [R, Hq, D]
+    lses = lses_ref[:, 0, :, 0:1].astype(jnp.float32)  # [R, Hq, 1]
+    m = jnp.max(lses, axis=0)                        # [Hq, 1]
+    m = jnp.maximum(m, NEG_INF)
+    w = jnp.exp(lses - m[None])                      # [R, Hq, 1]
+    denom = jnp.sum(w, axis=0)                       # [Hq, 1]
+    denom = jnp.where(denom > 0, denom, 1.0)
+    merged = jnp.sum(outs * w, axis=0) / denom       # [Hq, D]
+    out_ref[0] = merged.astype(out_ref.dtype)
+
+
+def decode_combine(partial_outs: jax.Array, partial_lses: jax.Array):
+    """partial_outs [R, B, Hq, D], partial_lses [R, B, Hq, 128] →
+    merged [B, Hq, D]."""
+    R, B, Hq, D = partial_outs.shape
+    return pl.pallas_call(
+        _combine_kernel,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((R, 1, Hq, D), lambda b: (0, b, 0, 0)),
+            pl.BlockSpec((R, 1, Hq, 128), lambda b: (0, b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, D), lambda b: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), partial_outs.dtype),
+        interpret=default_interpret(),
+    )(partial_outs, partial_lses)
+
+
+def sp_gqa_flash_decode(ctx: ShmemContext, q: jax.Array, k_cache: jax.Array,
+                        v_cache: jax.Array, global_kv_lens: jax.Array,
+                        axis: str | None = None, block_s: int = 128,
+                        ag_method: str = "push") -> jax.Array:
+    """Sequence-parallel distributed flash-decode
+    (analog of SpGQAFlashDecodeAttention.forward,
+    sp_flash_decode_layer.py:78-184):
+
+    1. per-rank split-KV decode over the local KV shard,
+    2. low-latency AllGather of the partial (out ‖ lse),
+    3. inter-rank lse-weighted combine.
+
+    q [B, Hq, D] replicated; k_cache/v_cache [B, Hkv, n*S_local, D] sharded
+    P(None, None, axis) on S; global_kv_lens [B] total valid keys. Returns
+    [B, Hq, D] replicated. Golden: dense softmax attention over the full
+    cache."""
+    axis = axis or ctx.axis_names[0]
+    n = ctx.axis_size(axis)
+    B, Hq, D = q.shape
+    S = k_cache.shape[2]
+    assert S % n == 0
+    s_local = S // n
+
+    def local(q, k_shard, v_shard, kv_lens):
+        me = lax.axis_index(axis)
+        local_len = jnp.clip(kv_lens - me * s_local, 0, s_local)
+        out_p, lse_p = gqa_decode_partial(q, k_shard, v_shard,
+                                          local_len.astype(jnp.int32),
+                                          block_s=block_s)
+        return out_p[None], lse_p[None]   # add rank dim for the gather
+
+    def local_packed(q, k_shard, v_shard, kv_lens):
+        out_p, lse_p = local(q, k_shard, v_shard, kv_lens)
+        # one wire payload (out ‖ lse), f32, like the reference's fused
+        # partial buffer (sp_flash_decode_layer.py:134-137)
+        return jnp.concatenate(
+            [out_p.astype(jnp.float32), lse_p], axis=-1)
+
+    sm = ctx.shard_map(local_packed,
+                       in_specs=(P(), P(None, None, axis),
+                                 P(None, None, axis), P()),
+                       out_specs=P(axis))
+    packed = sm(q, k_cache, v_cache, global_kv_lens)   # [n, B, Hq, D+128]
+    g = all_gather(ctx, packed, axis=axis, method=ag_method)
+
+    def merge(pk):
+        return decode_combine(pk[..., :D].astype(q.dtype), pk[..., D:])
+
+    smc = ctx.shard_map(merge, in_specs=P(None), out_specs=P(None))
+    return smc(g)
+
+
+__all__ = ["gqa_decode_partial", "decode_combine", "sp_gqa_flash_decode"]

@@ -1,0 +1,136 @@
+"""Grouped (expert) GEMM + MoE token alignment (analog of reference
+``sort_topk_ids_align_block_size`` allgather_group_gemm.py:54-139, the
+grouped-GEMM consumer kernels :229-316, and csrc's
+``moe_ag_scatter_align_block_size`` moe_utils.cu:61-356).
+
+TPU-native design: tokens are sorted by expert and padded so every
+``block_m`` row-block belongs to exactly one expert; a scalar-prefetch array
+maps each block to its expert, letting the BlockSpec index_map stream the
+right expert's weight tile — the Pallas/TPU shape of "grouped GEMM" (cf.
+megablox). Sorting/alignment is pure jnp (argsort + one-hot cumsum), not a
+hand-written CUDA kernel: it runs on the VPU inside the same jit.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.utils import default_interpret
+
+
+def align_tokens_by_expert(ids: jax.Array, num_experts: int, block_m: int):
+    """Sort token indices by expert and pad each expert's run to a multiple
+    of ``block_m`` (analog of sort_topk_ids_align_block_size,
+    allgather_group_gemm.py:54-139 — there a CPU/CUDA helper, here jnp).
+
+    ids: [T] expert id per row (-1 = invalid/padding row).
+    Returns (gather_idx [P], row_valid [P], block_expert [P//block_m]) with
+    the *packed* static bound ``P = round_up(T, bm) + E*bm`` (each expert
+    wastes < one block of padding; per-expert offsets are runtime values —
+    ``block_expert`` is a scalar-prefetch array, so dynamic packing is
+    free). Gathered row j participates in expert ``block_expert[j//bm]``'s
+    GEMM iff ``row_valid[j]``; blocks past the used range carry no valid
+    rows.
+    """
+    T = ids.shape[0]
+    E = num_experts
+    bm = block_m
+    P = ((T + bm - 1) // bm) * bm + E * bm
+    n_blocks = P // bm
+    ids_safe = jnp.where(ids >= 0, ids, E)
+    oh = jax.nn.one_hot(ids_safe, E + 1, dtype=jnp.int32)
+    rank_in_e = (jnp.cumsum(oh, axis=0) - oh)[jnp.arange(T), ids_safe]
+    counts = jnp.sum(oh[:, :E], axis=0)                       # [E]
+    blocks_e = (counts + bm - 1) // bm                        # [E]
+    block_start = jnp.cumsum(blocks_e) - blocks_e             # [E] (blocks)
+    row_start = block_start * bm                              # [E] (rows)
+    dest_row = jnp.where(ids >= 0,
+                         jnp.take(row_start, jnp.clip(ids_safe, 0, E - 1))
+                         + rank_in_e,
+                         P)  # invalid rows -> dropped
+    gather_idx = jnp.zeros((P,), jnp.int32).at[dest_row].set(
+        jnp.arange(T, dtype=jnp.int32), mode="drop")
+    row_valid = jnp.zeros((P,), jnp.bool_).at[dest_row].set(True, mode="drop")
+    # expert of block i: number of experts whose block range ends at or
+    # before i (unused tail blocks get expert E-1; their rows are invalid)
+    blk = jnp.arange(n_blocks, dtype=jnp.int32)
+    block_expert = jnp.sum(
+        (block_start + blocks_e)[None, :] <= blk[:, None], axis=1
+    ).astype(jnp.int32)
+    block_expert = jnp.clip(block_expert, 0, E - 1)
+    return gather_idx, row_valid, block_expert
+
+
+def grouped_gemm(tokens: jax.Array, weights: jax.Array,
+                 block_expert: jax.Array, block_m: int = 128,
+                 block_n: int = 128, out_dtype=None) -> jax.Array:
+    """``out[i*bm:(i+1)*bm] = tokens[i*bm:(i+1)*bm] @ weights[block_expert[i]]``.
+
+    tokens: [P, H] (expert-aligned rows), weights: [E, H, N],
+    block_expert: [P // block_m] int32. The scalar-prefetch index_map streams
+    each block's expert weight tile HBM→VMEM double-buffered (grid analog of
+    the reference's ``kernel_consumer_m_parallel_scatter_group_gemm``,
+    allgather_group_gemm.py:229-316).
+    """
+    P, H = tokens.shape
+    E, H2, N = weights.shape
+    assert H == H2, (H, H2)
+    block_n = min(block_n, N)
+    assert P % block_m == 0 and N % block_n == 0, (P, N, block_m, block_n)
+    out_dtype = out_dtype or tokens.dtype
+
+    def kernel(be_ref, t_ref, w_ref, o_ref):
+        o_ref[...] = jnp.dot(t_ref[...], w_ref[0],
+                             preferred_element_type=jnp.float32
+                             ).astype(out_dtype)
+
+    grid = (P // block_m, N // block_n)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((block_m, H), lambda i, j, be: (i, 0)),
+                pl.BlockSpec((1, H, block_n), lambda i, j, be: (be[i], 0, j)),
+            ],
+            out_specs=pl.BlockSpec((block_m, block_n), lambda i, j, be: (i, j)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((P, N), out_dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * P * H * N,
+            bytes_accessed=(P * H + E * H * N + P * N)
+            * jnp.dtype(tokens.dtype).itemsize,
+            transcendentals=0),
+        interpret=default_interpret(),
+    )(block_expert, tokens, weights)
+
+
+def moe_ffn_local(tokens: jax.Array, ids: jax.Array, w_up: jax.Array,
+                  w_down: jax.Array, block_m: int = 128,
+                  activation=jax.nn.silu) -> jax.Array:
+    """Per-device MoE FFN over locally-present tokens: align by expert, run
+    grouped up-projection, activation, grouped down-projection, and scatter
+    rows back to their original positions. ``ids`` may contain -1 for padding
+    rows (they produce zeros). Building block for the EP layer and the MoE
+    overlap ops."""
+    T, H = tokens.shape
+    E = w_up.shape[0]
+    gather_idx, row_valid, block_expert = align_tokens_by_expert(
+        ids, E, block_m)
+    x = tokens[gather_idx] * row_valid[:, None].astype(tokens.dtype)
+    h = grouped_gemm(x, w_up, block_expert, block_m=block_m)
+    h = activation(h.astype(jnp.float32)).astype(tokens.dtype)
+    y = grouped_gemm(h, w_down, block_expert, block_m=block_m)
+    out = jnp.zeros((T, w_down.shape[-1]), y.dtype)
+    src_rows = jnp.where(row_valid, gather_idx, T)
+    return out.at[src_rows].add(
+        y * row_valid[:, None].astype(y.dtype), mode="drop")
+
+
+__all__ = ["align_tokens_by_expert", "grouped_gemm", "moe_ffn_local"]

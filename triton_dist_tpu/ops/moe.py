@@ -1,0 +1,118 @@
+"""MoE tensor-parallel overlap ops (analog of reference
+python/triton_dist/kernels/nvidia/allgather_group_gemm.py and
+moe_reduce_rs.py).
+
+- ``ag_moe_group_gemm``: AllGather token shards (+ routing ids) across the TP
+  group, then grouped expert GEMM against the local N-shard of every expert's
+  up-weights — the reference's "AG + GroupGEMM" stage
+  (allgather_group_gemm.py:317-770). Gather and compute are Pallas kernels;
+  their fusion into a single arrival-driven kernel (per-segment waits like
+  ag_gemm) is the planned optimization.
+- ``moe_reduce_rs``: grouped expert GEMM on the K-shard, topk-weighted
+  per-token reduction, then ReduceScatter of the result — the reference's
+  "GroupGEMM + topk-reduce + RS" stage (moe_reduce_rs.py:365-1027).
+
+Routing ids ride the wire as lane-aligned int32 blocks (cf. the splits
+transfer in low_latency_all_to_all.py:75-86).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.ops.allgather import all_gather
+from triton_dist_tpu.ops.group_gemm import (align_tokens_by_expert,
+                                            grouped_gemm)
+from triton_dist_tpu.ops.reduce_scatter import reduce_scatter
+from triton_dist_tpu.shmem.context import ShmemContext
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def ag_moe_group_gemm(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
+                      weights: jax.Array, axis: str | None = None,
+                      block_m: int = 128) -> jax.Array:
+    """tokens [T, H] sharded P(axis); ids [T] int32 expert per row (-1 pad);
+    weights [E, H, N] sharded P(None, None, axis) (N column-parallel).
+    Returns all ranks' tokens processed by their experts against the local
+    weight shard: [T, N_local] per device → global [T, N] sharded
+    P(None, axis). Golden: all_gather + dense per-expert matmul."""
+    axis = axis or ctx.axis_names[0]
+    n = ctx.axis_size(axis)
+    T, H = tokens.shape
+    assert T % n == 0
+    t_local = T // n
+    pad = _round_up(t_local, 128) - t_local
+
+    def pack(ids_shard):
+        w = jnp.pad(ids_shard, (0, pad), constant_values=-1)
+        return w.reshape(-1, 128)
+
+    ids_wire = ctx.shard_map(pack, in_specs=P(axis), out_specs=P(axis))(ids)
+    g_tokens = all_gather(ctx, tokens, axis=axis, method="ring")
+    g_ids_wire = all_gather(ctx, ids_wire, axis=axis, method="ring")
+
+    def compute(gt, gi, w_shard):
+        gids = gi.reshape(n, -1)[:, :t_local].reshape(-1)
+        E = w_shard.shape[0]
+        gather_idx, row_valid, block_expert = align_tokens_by_expert(
+            gids, E, block_m)
+        x = gt[gather_idx] * row_valid[:, None].astype(gt.dtype)
+        y = grouped_gemm(x, w_shard, block_expert, block_m=block_m)
+        out = jnp.zeros((gt.shape[0], w_shard.shape[-1]), y.dtype)
+        src = jnp.where(row_valid, gather_idx, gt.shape[0])
+        return out.at[src].add(y * row_valid[:, None].astype(y.dtype),
+                               mode="drop")
+
+    sm = ctx.shard_map(compute,
+                       in_specs=(P(None, None), P(None, None), P(None, None, axis)),
+                       out_specs=P(None, axis))
+    return sm(g_tokens, g_ids_wire, weights)
+
+
+def moe_reduce_rs(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
+                  topk_weights: jax.Array, weights: jax.Array,
+                  axis: str | None = None, block_m: int = 128) -> jax.Array:
+    """Second MoE-TP stage: ``tokens`` [T*topk, K] sharded P(None, axis) on K
+    (the up-projection's activations, one row per (token, k) pair);
+    ``ids`` [T*topk] global expert of each row; ``topk_weights`` [T, topk];
+    ``weights`` [E, K, N] sharded P(None, axis, None). Computes the grouped
+    down-GEMM partial on each rank, folds topk rows into per-token rows
+    (weighted sum), then ReduceScatters token rows across the group →
+    [T, N] sharded P(axis). Golden: dense compute + psum_scatter
+    (cf. moe_reduce_rs.py:889-1027)."""
+    axis = axis or ctx.axis_names[0]
+    n = ctx.axis_size(axis)
+    Tk, K = tokens.shape
+    T, topk = topk_weights.shape
+    assert Tk == T * topk
+    E = weights.shape[0]
+
+    def partial(tok_shard, ids_full, w_shard, tw):
+        gather_idx, row_valid, block_expert = align_tokens_by_expert(
+            ids_full, E, block_m)
+        x = tok_shard[gather_idx] * row_valid[:, None].astype(tok_shard.dtype)
+        y = grouped_gemm(x, w_shard, block_expert, block_m=block_m)
+        rows = jnp.zeros((Tk, w_shard.shape[-1]), jnp.float32)
+        src = jnp.where(row_valid, gather_idx, Tk)
+        rows = rows.at[src].add(
+            (y * row_valid[:, None].astype(y.dtype)).astype(jnp.float32),
+            mode="drop")
+        # topk-weighted fold: [T*topk, N] -> [T, N]
+        rows = rows.reshape(T, topk, -1) * tw[..., None].astype(jnp.float32)
+        return jnp.sum(rows, axis=1).astype(tokens.dtype)
+
+    sm = ctx.shard_map(
+        partial,
+        in_specs=(P(None, axis), P(None), P(None, axis, None), P(None, None)),
+        out_specs=P(axis))
+    # each device's partial stacked along dim0 -> reduce_scatter input layout
+    partials = sm(tokens, ids, weights, topk_weights)
+    return reduce_scatter(ctx, partials, axis=axis)
+
+
+__all__ = ["ag_moe_group_gemm", "moe_reduce_rs"]
