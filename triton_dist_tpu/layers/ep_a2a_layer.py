@@ -32,9 +32,18 @@ class EPAll2AllLayer:
             capacity=capacity, axis=axis, dtype=dtype))
 
     def preprocess(self, topk_ids: jax.Array):
-        """Routing plan only (≈ layer.preprocess token sort,
-        ep_a2a_layer.py:110-130). Runs per-shard under shard_map."""
-        return a2a_ops.route_tokens(self.a2a, topk_ids)
+        """Routing plan for globally P(axis)-sharded ``topk_ids`` — the same
+        plan ``dispatch`` computes internally (≈ layer.preprocess token sort,
+        ep_a2a_layer.py:110-130). Slot allocation is per source shard, so
+        this must run under shard_map — calling ``route_tokens`` on the
+        global array would count slots across ranks jointly and disagree
+        with dispatch's capacity-drop decisions."""
+        ctx, axis = self.a2a.ctx, self.a2a.axis
+        from jax.sharding import PartitionSpec as P
+        sm = ctx.shard_map(lambda ids: a2a_ops.route_tokens(self.a2a, ids),
+                           in_specs=P(axis),
+                           out_specs=(P(axis), P(axis), P(axis)))
+        return sm(topk_ids)
 
     def dispatch(self, tokens: jax.Array, topk_ids: jax.Array):
         """Returns (recv_tokens, recv_ids, layout); thread ``layout`` into

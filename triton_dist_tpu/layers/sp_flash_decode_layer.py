@@ -33,6 +33,9 @@ class SpGQAFlashDecodeAttention:
         (local split-KV decode → partial (out‖lse) allgather → lse-merge)."""
         B, Hq, D = q.shape
         assert Hq == self.num_q_heads and D == self.head_dim
+        assert k_cache.shape[1] == self.num_kv_heads, (
+            f"cache has {k_cache.shape[1]} kv heads, "
+            f"layer configured for {self.num_kv_heads}")
         return sp_gqa_flash_decode(self.ctx, q, k_cache, v_cache,
                                    global_kv_lens, axis=self.axis,
                                    block_s=self.block_s,

@@ -1,0 +1,4 @@
+from triton_dist_tpu.models.llama import (  # noqa: F401
+    LlamaConfig, init_params, forward, forward_tp_overlap)
+from triton_dist_tpu.models.moe import (  # noqa: F401
+    MoEConfig, init_moe_params, moe_forward)

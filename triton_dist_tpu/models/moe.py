@@ -1,0 +1,260 @@
+"""MoE model family (Mixtral/DeepSeek-style) over the EP kernel stack.
+
+The reference's MoE story is kernel-level: EP All-to-All dispatch/combine
+(reference python/triton_dist/kernels/nvidia/low_latency_all_to_all.py,
+ep_a2a.py) and MoE-TP grouped-GEMM overlap ops (allgather_group_gemm.py,
+moe_reduce_rs.py), exercised end-to-end by test_ep_moe_inference.py (an MoE
+block: router → dispatch → grouped FFN → combine). This module provides that
+same end-to-end MoE block as part of a full model, two ways:
+
+- ``moe_mlp_gshard``: differentiable GShard-style einsum dispatch with
+  experts sharded over an ``ep`` mesh axis — the *training* path. XLA turns
+  the dispatch/combine einsums into all-to-alls over ICI and overlaps them
+  with the expert GEMMs (async collectives); grads flow through everything.
+- ``moe_mlp_ep_overlap``: the *inference* path through the hand-overlapped
+  Pallas A2A dispatch/combine + grouped-GEMM kernels (the reference's
+  showcase pipeline, low_latency_all_to_all.py:189-270 + ep_a2a_layer.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.models.llama import (LlamaConfig, rmsnorm, rope,
+                                          _attention)
+from triton_dist_tpu.shmem.context import ShmemContext
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    base: LlamaConfig = dataclasses.field(default_factory=LlamaConfig)
+    num_experts: int = 8
+    topk: int = 2
+    moe_d_ff: int = 2048           # per-expert FFN width
+    capacity_factor: float = 1.25  # train-path expert capacity
+    router_aux_coef: float = 0.01  # load-balance loss weight
+
+    @classmethod
+    def tiny(cls, n_layers: int = 2, num_experts: int = 4):
+        return cls(base=LlamaConfig.tiny(n_layers), num_experts=num_experts,
+                   topk=2, moe_d_ff=128)
+
+    @classmethod
+    def mixtral_8x7b(cls):
+        return cls(base=LlamaConfig(vocab_size=32000, d_model=4096,
+                                    n_layers=32, n_heads=32, n_kv_heads=8,
+                                    d_ff=14336),
+                   num_experts=8, topk=2, moe_d_ff=14336)
+
+    @classmethod
+    def deepseek_infer(cls):
+        """The reference's A2A benchmark shape: hidden 7168, topk 8
+        (BASELINE.md / reference README.md:55)."""
+        return cls(base=LlamaConfig(vocab_size=129280, d_model=7168,
+                                    n_layers=4, n_heads=56, n_kv_heads=8,
+                                    d_ff=18432),
+                   num_experts=64, topk=8, moe_d_ff=2048)
+
+
+def init_moe_params(key: jax.Array, cfg: MoEConfig) -> dict:
+    """Llama-style attention params + per-layer MoE FFN params (router +
+    stacked expert weights)."""
+    from triton_dist_tpu.models.llama import init_params
+    b = cfg.base
+    L, D, F, E = b.n_layers, b.d_model, cfg.moe_d_ff, cfg.num_experts
+    params = init_params(key, b)
+    blocks = dict(params["blocks"])
+    for k in ("w_gate", "w_up", "w_down"):
+        del blocks[k]
+    keys = jax.random.split(jax.random.fold_in(key, 1), 4)
+    s = 0.02
+
+    def norm(k, *shape):
+        return (jax.random.normal(k, shape, jnp.float32) * s).astype(b.dtype)
+
+    blocks["w_router"] = jnp.asarray(
+        jax.random.normal(keys[0], (L, D, E), jnp.float32) * s)
+    blocks["we_gate"] = norm(keys[1], L, E, D, F)
+    blocks["we_up"] = norm(keys[2], L, E, D, F)
+    blocks["we_down"] = norm(keys[3], L, E, F, D)
+    params["blocks"] = blocks
+    return params
+
+
+def moe_param_specs(cfg: MoEConfig, tp: str | None = "tp",
+                    ep: str | None = "ep", pp: str | None = None) -> dict:
+    """Specs tree matching ``init_moe_params``: experts sharded over ``ep``,
+    attention Megatron-TP over ``tp``."""
+    from triton_dist_tpu.models.llama import param_specs
+    specs = param_specs(cfg.base, tp=tp, pp=pp)
+    blocks = dict(specs["blocks"])
+    for k in ("w_gate", "w_up", "w_down"):
+        del blocks[k]
+    blocks["w_router"] = P(pp, None, None)
+    blocks["we_gate"] = P(pp, ep, None, tp)
+    blocks["we_up"] = P(pp, ep, None, tp)
+    blocks["we_down"] = P(pp, ep, tp, None)
+    specs["blocks"] = blocks
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# training path: GShard-style differentiable dispatch (ep via GSPMD)
+# ---------------------------------------------------------------------------
+
+def moe_mlp_gshard(x2d: jax.Array, p: dict, cfg: MoEConfig):
+    """Capacity-bounded top-k MoE FFN as dispatch/combine einsums
+    (GShard/Switch formulation). x2d [T, D] → ([T, D], aux_loss). With
+    ``we_*`` sharded over an ``ep`` axis, XLA lowers the ``tec``-contractions
+    to all-to-alls over the expert axis — the differentiable twin of the
+    Pallas dispatch/combine path below."""
+    T, D = x2d.shape
+    E, k = cfg.num_experts, cfg.topk
+    C = max(int(cfg.capacity_factor * T * k / E), 1)
+    C = min(C, T)
+
+    logits = (x2d.astype(jnp.float32) @ p["w_router"])          # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_ids = lax.top_k(probs, k)                   # [T, k]
+    gate_vals = gate_vals / jnp.sum(gate_vals, -1, keepdims=True)
+
+    # position of each (token, k) in its expert's capacity buffer
+    e_oh = jax.nn.one_hot(gate_ids, E, dtype=jnp.int32)         # [T, k, E]
+    flat = e_oh.reshape(T * k, E)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat                  # exclusive
+    pos = jnp.take_along_axis(
+        pos_flat.reshape(T, k, E), gate_ids[..., None], -1)[..., 0]  # [T, k]
+    keep = pos < C
+    pos_oh = jax.nn.one_hot(jnp.where(keep, pos, C), C, dtype=x2d.dtype)
+    disp = jnp.einsum("tke,tkc->tec", e_oh.astype(x2d.dtype), pos_oh)
+    comb = jnp.einsum("tke,tkc,tk->tec", e_oh.astype(jnp.float32),
+                      pos_oh.astype(jnp.float32),
+                      gate_vals * keep.astype(jnp.float32))
+
+    xe = jnp.einsum("td,tec->ecd", x2d, disp)                   # [E, C, D]
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, p["we_gate"],
+                               preferred_element_type=jnp.float32)
+                    ).astype(x2d.dtype) \
+        * jnp.einsum("ecd,edf->ecf", xe, p["we_up"])
+    ye = jnp.einsum("ecf,efd->ecd", h, p["we_down"])            # [E, C, D]
+    y = jnp.einsum("ecd,tec->td", ye.astype(jnp.float32), comb)
+
+    # Switch-style load-balance aux loss
+    me = jnp.mean(probs, axis=0)                                 # [E]
+    ce = jnp.mean(e_oh[:, 0].astype(jnp.float32), axis=0)        # top-1 frac
+    aux = cfg.router_aux_coef * E * jnp.sum(me * ce)
+    return y.astype(x2d.dtype), aux
+
+
+def moe_block_apply(cfg: MoEConfig, x: jax.Array, p: dict,
+                    positions: jax.Array, act_spec: P | None = None):
+    """One MoE transformer block → (x, aux_loss). x [B, S, D]."""
+    import math as _math
+    b = cfg.base
+    B, S, D = x.shape
+    Hq, Hkv, Dh = b.n_heads, b.n_kv_heads, b.head_dim
+
+    def pin(h):
+        if act_spec is not None:
+            h = lax.with_sharding_constraint(h, act_spec)
+        return h
+
+    h = rmsnorm(x, p["attn_norm"], b.norm_eps)
+    q = rope((h @ p["wq"]).reshape(B, S, Hq, Dh), positions, b.rope_theta)
+    kk = rope((h @ p["wk"]).reshape(B, S, Hkv, Dh), positions, b.rope_theta)
+    v = (h @ p["wv"]).reshape(B, S, Hkv, Dh)
+    attn = _attention(q, kk, v, 1.0 / _math.sqrt(Dh))
+    x = pin(x + attn.reshape(B, S, Hq * Dh) @ p["wo"])
+
+    h = rmsnorm(x, p["mlp_norm"], b.norm_eps)
+    y, aux = moe_mlp_gshard(h.reshape(B * S, D), p, cfg)
+    x = pin(x + y.reshape(B, S, D))
+    return x, aux
+
+
+def moe_forward(params: dict, tokens: jax.Array, cfg: MoEConfig,
+                act_spec: P | None = None, remat: bool = False):
+    """Full MoE forward → (logits [B,S,V] f32, aux_loss scalar)."""
+    b = cfg.base
+    B, S = tokens.shape
+    x = params["embed"][tokens].astype(b.dtype)
+    positions = jnp.arange(S)[None, :].repeat(B, 0)
+
+    def body(carry, p):
+        x, aux = carry
+        x, a = moe_block_apply(cfg, x, p, positions, act_spec)
+        return (x, aux + a), None
+
+    if remat:
+        body = jax.checkpoint(body)
+    (x, aux), _ = lax.scan(body, (x, jnp.float32(0)), params["blocks"])
+    x = rmsnorm(x, params["final_norm"], b.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32), aux
+
+
+# ---------------------------------------------------------------------------
+# inference path: Pallas EP overlap kernels
+# ---------------------------------------------------------------------------
+
+def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
+                       router_w: jax.Array, we_gate: jax.Array,
+                       we_up: jax.Array, we_down: jax.Array,
+                       axis: str | None = None) -> jax.Array:
+    """The reference's EP MoE inference block (test_ep_moe_inference.py /
+    tutorial 04) on the Pallas kernel stack: router → low-latency A2A
+    dispatch → grouped expert FFN on each rank's local experts → A2A combine
+    with top-k weights.
+
+    x2d [T, D] globally P(axis)-sharded token rows; router_w [D, E];
+    we_* [E, D, F]/[E, F, D] — each rank uses its local expert slice
+    we_*[me*Elocal:(me+1)*Elocal].
+    """
+    from triton_dist_tpu.ops.group_gemm import apply_grouped, grouped_gemm
+
+    axis = axis or ctx.axis_names[0]
+    n = ctx.axis_size(axis)
+    a2a = a2a_layer.a2a
+    E, k = a2a.num_experts, a2a.topk
+    e_local = a2a.experts_per_rank
+
+    logits = x2d.astype(jnp.float32) @ router_w
+    gate_vals, gate_ids = lax.top_k(jax.nn.softmax(logits, -1), k)
+    gate_vals = (gate_vals / jnp.sum(gate_vals, -1, keepdims=True))
+
+    recv_tok, recv_ids, layout = a2a_layer.dispatch(x2d, gate_ids)
+
+    def expert_ffn(tok, ids, wg, wu, wd):
+        me = lax.axis_index(axis)
+        cap, H = tok.shape[-2], tok.shape[-1]
+        tflat = tok.reshape(n * cap, H)
+        iflat = ids.reshape(n * cap)
+        wg_l = lax.dynamic_slice_in_dim(wg, me * e_local, e_local)
+        wu_l = lax.dynamic_slice_in_dim(wu, me * e_local, e_local)
+        wd_l = lax.dynamic_slice_in_dim(wd, me * e_local, e_local)
+
+        # gated FFN: silu(x@wg) * (x@wu) @ wd over local experts
+        def ffn(xs, be):
+            g = grouped_gemm(xs, wg_l, be, block_m=128)
+            u = grouped_gemm(xs, wu_l, be, block_m=128)
+            hh = jax.nn.silu(g.astype(jnp.float32)).astype(u.dtype) * u
+            return grouped_gemm(hh, wd_l, be, block_m=128)
+
+        out = apply_grouped(tflat, iflat, e_local, ffn, block_m=128)
+        return out.reshape(n, cap, -1)
+
+    sm = ctx.shard_map(expert_ffn,
+                       in_specs=(P(axis), P(axis), P(None, None, None),
+                                 P(None, None, None), P(None, None, None)),
+                       out_specs=P(axis))
+    processed = sm(recv_tok, recv_ids, we_gate, we_up, we_down)
+    return a2a_layer.combine(processed, layout, gate_vals)
+
+
+__all__ = ["MoEConfig", "init_moe_params", "moe_param_specs",
+           "moe_mlp_gshard", "moe_block_apply", "moe_forward",
+           "moe_mlp_ep_overlap"]

@@ -161,7 +161,6 @@ def _combine_kernel(outs_ref, lses_ref, out_ref):
     outs = outs_ref[:, 0].astype(jnp.float32)       # [R, Hq, D]
     lses = lses_ref[:, 0, :, 0:1].astype(jnp.float32)  # [R, Hq, 1]
     m = jnp.max(lses, axis=0)                        # [Hq, 1]
-    m = jnp.maximum(m, NEG_INF)
     w = jnp.exp(lses - m[None])                      # [R, Hq, 1]
     denom = jnp.sum(w, axis=0)                       # [Hq, 1]
     denom = jnp.where(denom > 0, denom, 1.0)

@@ -77,11 +77,15 @@ def grouped_gemm(tokens: jax.Array, weights: jax.Array,
     the reference's ``kernel_consumer_m_parallel_scatter_group_gemm``,
     allgather_group_gemm.py:229-316).
     """
+    import math
+
     P, H = tokens.shape
     E, H2, N = weights.shape
     assert H == H2, (H, H2)
-    block_n = min(block_n, N)
-    assert P % block_m == 0 and N % block_n == 0, (P, N, block_m, block_n)
+    # ragged N (e.g. a 192-wide TP shard): fall back to the largest common
+    # divisor, like flash_decode's block_s handling
+    block_n = math.gcd(min(block_n, N), N)
+    assert P % block_m == 0, (P, block_m)
     out_dtype = out_dtype or tokens.dtype
 
     def kernel(be_ref, t_ref, w_ref, o_ref):
@@ -111,26 +115,40 @@ def grouped_gemm(tokens: jax.Array, weights: jax.Array,
     )(block_expert, tokens, weights)
 
 
+def apply_grouped(tokens: jax.Array, ids: jax.Array, num_experts: int, fn,
+                  block_m: int = 128) -> jax.Array:
+    """The shared align→gather→mask→compute→scatter-back sequence every MoE
+    op needs: align rows by expert, call ``fn(x_aligned, block_expert) ->
+    y_aligned`` (one or more grouped GEMMs sharing the alignment), and
+    scatter results back to the original row order (invalid ids → zero
+    rows). Returns [T, N]."""
+    T = tokens.shape[0]
+    gather_idx, row_valid, block_expert = align_tokens_by_expert(
+        ids, num_experts, block_m)
+    x = tokens[gather_idx] * row_valid[:, None].astype(tokens.dtype)
+    y = fn(x, block_expert)
+    out = jnp.zeros((T, y.shape[-1]), y.dtype)
+    src = jnp.where(row_valid, gather_idx, T)
+    return out.at[src].add(y * row_valid[:, None].astype(y.dtype),
+                           mode="drop")
+
+
 def moe_ffn_local(tokens: jax.Array, ids: jax.Array, w_up: jax.Array,
                   w_down: jax.Array, block_m: int = 128,
                   activation=jax.nn.silu) -> jax.Array:
-    """Per-device MoE FFN over locally-present tokens: align by expert, run
-    grouped up-projection, activation, grouped down-projection, and scatter
-    rows back to their original positions. ``ids`` may contain -1 for padding
-    rows (they produce zeros). Building block for the EP layer and the MoE
-    overlap ops."""
-    T, H = tokens.shape
+    """Per-device MoE FFN over locally-present tokens: grouped up-projection,
+    activation, grouped down-projection, rows restored to their original
+    positions. ``ids`` may contain -1 for padding rows (they produce zeros).
+    Building block for the EP layer and the MoE overlap ops."""
     E = w_up.shape[0]
-    gather_idx, row_valid, block_expert = align_tokens_by_expert(
-        ids, E, block_m)
-    x = tokens[gather_idx] * row_valid[:, None].astype(tokens.dtype)
-    h = grouped_gemm(x, w_up, block_expert, block_m=block_m)
-    h = activation(h.astype(jnp.float32)).astype(tokens.dtype)
-    y = grouped_gemm(h, w_down, block_expert, block_m=block_m)
-    out = jnp.zeros((T, w_down.shape[-1]), y.dtype)
-    src_rows = jnp.where(row_valid, gather_idx, T)
-    return out.at[src_rows].add(
-        y * row_valid[:, None].astype(y.dtype), mode="drop")
+
+    def ffn(x, block_expert):
+        h = grouped_gemm(x, w_up, block_expert, block_m=block_m)
+        h = activation(h.astype(jnp.float32)).astype(tokens.dtype)
+        return grouped_gemm(h, w_down, block_expert, block_m=block_m)
+
+    return apply_grouped(tokens, ids, E, ffn, block_m=block_m)
 
 
-__all__ = ["align_tokens_by_expert", "grouped_gemm", "moe_ffn_local"]
+__all__ = ["align_tokens_by_expert", "grouped_gemm", "apply_grouped",
+           "moe_ffn_local"]

@@ -23,8 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from triton_dist_tpu.ops.allgather import all_gather
-from triton_dist_tpu.ops.group_gemm import (align_tokens_by_expert,
-                                            grouped_gemm)
+from triton_dist_tpu.ops.group_gemm import apply_grouped, grouped_gemm
 from triton_dist_tpu.ops.reduce_scatter import reduce_scatter
 from triton_dist_tpu.shmem.context import ShmemContext
 
@@ -59,14 +58,10 @@ def ag_moe_group_gemm(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
     def compute(gt, gi, w_shard):
         gids = gi.reshape(n, -1)[:, :t_local].reshape(-1)
         E = w_shard.shape[0]
-        gather_idx, row_valid, block_expert = align_tokens_by_expert(
-            gids, E, block_m)
-        x = gt[gather_idx] * row_valid[:, None].astype(gt.dtype)
-        y = grouped_gemm(x, w_shard, block_expert, block_m=block_m)
-        out = jnp.zeros((gt.shape[0], w_shard.shape[-1]), y.dtype)
-        src = jnp.where(row_valid, gather_idx, gt.shape[0])
-        return out.at[src].add(y * row_valid[:, None].astype(y.dtype),
-                               mode="drop")
+        return apply_grouped(
+            gt, gids, E,
+            lambda x, be: grouped_gemm(x, w_shard, be, block_m=block_m),
+            block_m=block_m)
 
     sm = ctx.shard_map(compute,
                        in_specs=(P(None, None), P(None, None), P(None, None, axis)),
@@ -93,15 +88,10 @@ def moe_reduce_rs(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
     E = weights.shape[0]
 
     def partial(tok_shard, ids_full, w_shard, tw):
-        gather_idx, row_valid, block_expert = align_tokens_by_expert(
-            ids_full, E, block_m)
-        x = tok_shard[gather_idx] * row_valid[:, None].astype(tok_shard.dtype)
-        y = grouped_gemm(x, w_shard, block_expert, block_m=block_m)
-        rows = jnp.zeros((Tk, w_shard.shape[-1]), jnp.float32)
-        src = jnp.where(row_valid, gather_idx, Tk)
-        rows = rows.at[src].add(
-            (y * row_valid[:, None].astype(y.dtype)).astype(jnp.float32),
-            mode="drop")
+        rows = apply_grouped(
+            tok_shard, ids_full, E,
+            lambda x, be: grouped_gemm(x, w_shard, be, block_m=block_m),
+            block_m=block_m).astype(jnp.float32)
         # topk-weighted fold: [T*topk, N] -> [T, N]
         rows = rows.reshape(T, topk, -1) * tw[..., None].astype(jnp.float32)
         return jnp.sum(rows, axis=1).astype(tokens.dtype)

@@ -1,0 +1,4 @@
+from triton_dist_tpu.parallel.mesh import make_mesh, factorize_devices  # noqa: F401
+from triton_dist_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
+from triton_dist_tpu.parallel.train import (  # noqa: F401
+    ParallelPlan, TrainState, make_train_step)

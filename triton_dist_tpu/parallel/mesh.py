@@ -1,0 +1,61 @@
+"""Mesh construction helpers.
+
+The reference's "mesh" is implicit: one process per GPU under torchrun, with
+`RANK/LOCAL_RANK/WORLD_SIZE` env (reference python/triton_dist/utils.py:91-111)
+and NUMA/NVLink topology probing to pick algorithms (utils.py:504-607). On TPU
+the topology is explicit — a `jax.sharding.Mesh` over named axes — and every
+parallelism dimension (dp/pp/tp/ep) is an axis name. These helpers build
+meshes from axis-size dicts and factorize an unknown device count into a
+requested axis order (outermost axis gets the largest factor, so dp rides DCN
+and tp rides ICI, per the scaling-book recipe).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(axes: dict[str, int], devices=None) -> Mesh:
+    """Build a mesh from an ordered ``{axis_name: size}`` dict. A prefix
+    subset of the available devices is allowed (e.g. a 4-device test mesh on
+    an 8-device host)."""
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    n = int(np.prod(list(axes.values())))
+    if n > devices.size:
+        raise ValueError(f"mesh {axes} needs {n} devices, "
+                         f"have {devices.size}")
+    shape = tuple(axes.values())
+    return Mesh(devices[:n].reshape(shape), tuple(axes.keys()))
+
+
+def factorize_devices(n_devices: int,
+                      axis_order: Sequence[str] = ("dp", "pp", "tp"),
+                      prefer_inner: str | None = "tp") -> dict[str, int]:
+    """Split ``n_devices`` across the named axes. The ``prefer_inner`` axis
+    (innermost = fastest interconnect neighbours) takes the largest factor;
+    remaining factors are dealt outer-to-inner. E.g. 8 → {dp:2, pp:2, tp:2};
+    4 → {dp:1, pp:2, tp:2}; 2 → {dp:1, pp:1, tp:2}; 1 → all ones."""
+    axes = {a: 1 for a in axis_order}
+    # greedy: repeatedly halve into axes, preferring the inner axis first
+    remaining = n_devices
+    order = list(axis_order)[::-1]  # inner first
+    if prefer_inner and prefer_inner in axes:
+        order.remove(prefer_inner)
+        order.insert(0, prefer_inner)
+    i = 0
+    while remaining > 1:
+        # find smallest prime factor
+        f = next((p for p in range(2, remaining + 1) if remaining % p == 0))
+        axes[order[i % len(order)]] *= f
+        remaining //= f
+        i += 1
+    return axes
+
+
+__all__ = ["make_mesh", "factorize_devices"]

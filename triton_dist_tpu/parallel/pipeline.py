@@ -1,0 +1,72 @@
+"""Pipeline parallelism: GPipe-style microbatch wavefront over a ``pp`` mesh
+axis via ``shard_map`` + ``lax.ppermute``.
+
+Out of scope for the reference (a kernel-level TP/EP/SP library — SURVEY.md
+§2.4 notes DP/PP are absent), but jax composition makes it nearly free, and
+the driver's multi-chip dryrun exercises it. Design: the stacked per-layer
+params are sharded over ``pp`` on the layer dim; all stages run the same
+``T = n_micro + P - 1``-step scan; stage 0 injects microbatches, activations
+hop stage→stage+1 through ``ppermute`` (differentiable, so ``jax.grad``
+through the whole pipeline yields the standard GPipe backward schedule).
+Partial-manual ``shard_map`` (manual over ``pp`` only) leaves dp/tp sharding
+inside each stage to GSPMD.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x_micro: jax.Array,
+                   axis: str = "pp"):
+    """Run inside ``shard_map`` (manual over ``axis``).
+
+    stage_fn(stage_params, h) -> h : this stage's chunk of the network.
+    stage_params: params for the local layer chunk (leading layer dim already
+    sliced by shard_map).
+    x_micro: [n_micro, mb, ...] microbatched input (same on every stage;
+    only stage 0 reads it).
+    Returns [n_micro, mb, ...] outputs, valid on the LAST stage and zeros
+    elsewhere — callers ``psum`` over ``axis`` to broadcast.
+    """
+    n_stages = lax.axis_size(axis)
+    stage = lax.axis_index(axis)
+    n_micro = x_micro.shape[0]
+    steps = n_micro + n_stages - 1
+    state0 = jnp.zeros_like(x_micro[0])
+    outs0 = jnp.zeros_like(x_micro)
+
+    def step(carry, t):
+        state, outs = carry
+        # stage 0 injects microbatch t; later stages consume last hop's recv
+        inject = lax.dynamic_index_in_dim(
+            x_micro, jnp.clip(t, 0, n_micro - 1), keepdims=False)
+        h_in = jnp.where(stage == 0, inject, state)
+        h_out = stage_fn(stage_params, h_in)
+        # last stage stores microbatch (t - (P-1)) when it's valid
+        out_idx = t - (n_stages - 1)
+        valid = (stage == n_stages - 1) & (out_idx >= 0)
+        idx = jnp.clip(out_idx, 0, n_micro - 1)
+        cur = lax.dynamic_index_in_dim(outs, idx, keepdims=False)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(valid, h_out, cur), idx, 0)
+        # hop to the next stage (wrap-around to 0 is ignored — stage 0
+        # overwrites with its injection)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        state = lax.ppermute(h_out, axis, perm)
+        return (state, outs), None
+
+    (_, outs), _ = lax.scan(step, (state0, outs0),
+                            jnp.arange(steps, dtype=jnp.int32))
+    # broadcast the last stage's outputs to every stage (f32 psum: XLA CPU's
+    # AllReducePromotion pass check-fails cloning a bf16 all-reduce here)
+    is_last = (stage == n_stages - 1).astype(jnp.float32)
+    return lax.psum(outs.astype(jnp.float32) * is_last,
+                    axis).astype(outs.dtype)
+
+
+__all__ = ["pipeline_apply"]

@@ -1,0 +1,174 @@
+"""Composable distributed train step: dp / tp / sp / pp / ep over one mesh.
+
+Beyond the reference's scope (its kernels are forward-only; SURVEY.md §2.4:
+"DP/PP … NOT present — the TPU build can note jax shard_map/pjit gives
+composition for free") — this module is that composition, and what the
+driver's multi-chip dryrun compiles:
+
+- **dp**: batch dim sharded over ``plan.dp``.
+- **tp**: Megatron param sharding (models.llama.param_specs) over ``plan.tp``;
+  XLA inserts/overlaps the TP collectives in the backward too.
+- **sp**: Megatron-style sequence parallelism — the residual stream between
+  blocks is sequence-sharded over the *tp* axis (norms/elementwise run on
+  S/tp rows; cf. SURVEY §5.7's note that the reference's SP story is
+  decode-side only).
+- **pp**: GPipe microbatch wavefront (parallel.pipeline) over ``plan.pp``.
+- **ep**: MoE expert sharding over ``plan.ep`` (models.moe.moe_mlp_gshard's
+  dispatch einsums become all-to-alls).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.models import llama as llama_mod
+from triton_dist_tpu.models import moe as moe_mod
+from triton_dist_tpu.parallel.pipeline import pipeline_apply
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelPlan:
+    dp: str | None = "dp"
+    tp: str | None = "tp"
+    pp: str | None = None
+    ep: str | None = None
+    sp: bool = True          # sequence-shard the residual over the tp axis
+    n_micro: int = 2         # pipeline microbatches (pp only)
+    remat: bool = False
+
+    def act_spec(self) -> P:
+        return P(self.dp, self.tp if self.sp else None, None)
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def _xent(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Mean next-token cross-entropy. logits [B,S,V] f32, tokens [B,S]."""
+    lp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    tgt = tokens[:, 1:]
+    ll = jnp.take_along_axis(lp, tgt[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def make_train_step(cfg, mesh, plan: ParallelPlan | None = None,
+                    optimizer: optax.GradientTransformation | None = None,
+                    ) -> tuple[Callable, Callable]:
+    """Returns ``(init_fn, step_fn)``, both jitted over ``mesh``:
+
+    - ``init_fn(key) -> TrainState`` with params laid out per the plan.
+    - ``step_fn(state, tokens[B,S]) -> (TrainState, loss)``.
+
+    ``cfg`` is a ``LlamaConfig`` (dense; supports pp) or ``MoEConfig``
+    (GShard ep path; no pp).
+    """
+    plan = plan or ParallelPlan()
+    optimizer = optimizer or optax.adamw(3e-4)
+    is_moe = isinstance(cfg, moe_mod.MoEConfig)
+    if is_moe:
+        assert plan.pp is None, "pp+MoE composition not wired yet"
+        specs = moe_mod.moe_param_specs(cfg, tp=plan.tp, ep=plan.ep)
+        init_raw = lambda key: moe_mod.init_moe_params(key, cfg)
+    else:
+        specs = llama_mod.param_specs(cfg.base if is_moe else cfg,
+                                      tp=plan.tp, pp=plan.pp)
+        init_raw = lambda key: llama_mod.init_params(key, cfg)
+
+    def shardings(tree_specs):
+        return jax.tree.map(lambda s: NamedSharding(mesh, s), tree_specs,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def constrain(params):
+        return jax.tree.map(lax.with_sharding_constraint, params,
+                            shardings(specs))
+
+    act_spec = plan.act_spec()
+
+    # ---- forward/loss ----------------------------------------------------
+    if is_moe:
+        def loss_fn(params, tokens):
+            logits, aux = moe_mod.moe_forward(params, tokens, cfg,
+                                              act_spec=act_spec,
+                                              remat=plan.remat)
+            return _xent(logits, tokens) + aux
+    elif plan.pp is None:
+        def loss_fn(params, tokens):
+            logits = llama_mod.forward(params, tokens, cfg,
+                                       act_spec=act_spec, remat=plan.remat)
+            return _xent(logits, tokens)
+    else:
+        pp, n_micro = plan.pp, plan.n_micro
+        n_stages = mesh.shape[pp]
+        assert cfg.n_layers % n_stages == 0
+
+        def stage_fn(blocks, h):
+            S = h.shape[1]
+            positions = jnp.arange(S)[None, :].repeat(h.shape[0], 0)
+
+            def body(x, p):
+                return llama_mod.block_apply(cfg, x, p, positions,
+                                             act_spec), None
+
+            if plan.remat:
+                body = jax.checkpoint(body)
+            h, _ = lax.scan(body, h, blocks)
+            return h
+
+        block_pp_specs = jax.tree.map(lambda _: P(pp), specs["blocks"],
+                                      is_leaf=lambda x: isinstance(x, P))
+
+        def loss_fn(params, tokens):
+            B, S = tokens.shape
+            assert B % n_micro == 0, (B, n_micro)
+            mb = B // n_micro
+            # f32 at the shard_map boundary: the transpose of a replicated
+            # (P()) input is a psum over pp, and XLA CPU's AllReducePromotion
+            # pass check-fails on the bf16 all-reduce it would produce
+            x = params["embed"][tokens].astype(jnp.float32)
+            x_micro = x.reshape(n_micro, mb, S, cfg.d_model)
+
+            pipe = jax.shard_map(
+                lambda blocks, xm: pipeline_apply(
+                    stage_fn, blocks, xm.astype(cfg.dtype),
+                    axis=pp).astype(jnp.float32),
+                mesh=mesh,
+                in_specs=(block_pp_specs, P()),
+                out_specs=P(),
+                axis_names={pp},
+                check_vma=False,
+            )
+            outs = pipe(params["blocks"], x_micro)
+            x = outs.reshape(B, S, cfg.d_model)
+            x = llama_mod.rmsnorm(x, params["final_norm"], cfg.norm_eps)
+            logits = (x @ params["lm_head"]).astype(jnp.float32)
+            return _xent(logits, tokens)
+
+    # ---- init / step -----------------------------------------------------
+    @jax.jit
+    def init_fn(key) -> TrainState:
+        params = constrain(init_raw(key))
+        opt_state = optimizer.init(params)
+        return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+
+    @jax.jit
+    def step_fn(state: TrainState, tokens: jax.Array):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = constrain(optax.apply_updates(state.params, updates))
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return init_fn, step_fn
+
+
+__all__ = ["ParallelPlan", "TrainState", "make_train_step"]
