@@ -1,0 +1,75 @@
+// Host-native MoE token alignment (analog of reference
+// csrc/distributed/csrc/moe_utils.cu `moe_ag_scatter_align_block_size`,
+// moe_utils.cu:61-356 — there a CUDA kernel pair; here a C++ host op).
+//
+// On TPU the in-jit path is the vectorized jnp implementation
+// (triton_dist_tpu/ops/group_gemm.py::align_tokens_by_expert); this native
+// version serves the host-side datapath: routing tables that arrive from a
+// CPU dataloader/serving frontend can be aligned without a device round-trip,
+// then fed to the grouped GEMM as scalar-prefetch arrays.
+//
+// Contract (identical to align_tokens_by_expert):
+//   P        = round_up(T, block_m) + E * block_m   (static packed bound)
+//   n_blocks = P / block_m
+//   gather_idx[P]        source row for each aligned row (0 for padding)
+//   row_valid[P]         1 iff the aligned row carries a real token
+//   block_expert[P/bm]   expert id owning each block (tail blocks: E-1)
+// ids may contain -1 (or any out-of-range value) for padding rows.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+int64_t tdt_moe_align_padded_rows(int64_t T, int32_t E, int32_t block_m) {
+  int64_t bm = block_m;
+  return ((T + bm - 1) / bm) * bm + (int64_t)E * bm;
+}
+
+// Returns 0 on success, nonzero on bad arguments.
+int32_t tdt_moe_align_block_size(const int32_t* ids, int64_t T, int32_t E,
+                                 int32_t block_m, int32_t* gather_idx,
+                                 uint8_t* row_valid, int32_t* block_expert) {
+  if (T < 0 || E <= 0 || block_m <= 0) return 1;
+  const int64_t bm = block_m;
+  const int64_t P = tdt_moe_align_padded_rows(T, E, block_m);
+  const int64_t n_blocks = P / bm;
+
+  std::vector<int64_t> counts(E, 0);
+  for (int64_t t = 0; t < T; ++t) {
+    int32_t e = ids[t];
+    if (e >= 0 && e < E) counts[e]++;
+  }
+  // block_start (in blocks) per expert; ends non-decreasing by construction
+  std::vector<int64_t> row_start(E, 0), ends(E, 0);
+  int64_t acc = 0;
+  for (int32_t e = 0; e < E; ++e) {
+    int64_t blocks_e = (counts[e] + bm - 1) / bm;
+    row_start[e] = acc * bm;
+    acc += blocks_e;
+    ends[e] = acc;  // block index one past expert e's range
+  }
+
+  std::memset(gather_idx, 0, P * sizeof(int32_t));
+  std::memset(row_valid, 0, P * sizeof(uint8_t));
+  std::vector<int64_t> fill(E, 0);
+  for (int64_t t = 0; t < T; ++t) {
+    int32_t e = ids[t];
+    if (e < 0 || e >= E) continue;  // padding row -> dropped
+    int64_t dest = row_start[e] + fill[e]++;
+    gather_idx[dest] = (int32_t)t;
+    row_valid[dest] = 1;
+  }
+
+  // block_expert[i] = clip(#experts whose range ends at or before i, 0, E-1)
+  // (two-pointer sweep over the non-decreasing `ends`)
+  int64_t done = 0;
+  for (int64_t i = 0; i < n_blocks; ++i) {
+    while (done < E && ends[done] <= i) done++;
+    block_expert[i] = (int32_t)(done < E ? done : E - 1);
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,86 @@
+"""ctypes bindings for the native host ops in ``csrc/`` (analog of reference
+csrc's pybind module ``libtriton_distributed`` → ``distributed.*`` ops,
+op_pybind.cc:34-48 — here a C ABI + ctypes, no pybind11 in the image).
+
+The library builds lazily on first import (g++ is in the base image); set
+``TDT_NO_NATIVE=1`` to skip the native path entirely (pure-jnp fallbacks in
+ops.group_gemm keep everything functional).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_SO = os.path.join(_HERE, "_build", "libtdt_host.so")
+_SRC = os.path.join(_REPO, "csrc")
+
+_lib = None
+
+
+def _build() -> None:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    srcs = [os.path.join(_SRC, "moe_align.cc")]
+    cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-Wall",
+           *srcs, "-o", _SO]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The loaded native library, building it if needed; None when disabled
+    or the toolchain is unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if os.environ.get("TDT_NO_NATIVE") == "1":
+        return None
+    try:
+        if not os.path.exists(_SO) or any(
+                os.path.getmtime(s) > os.path.getmtime(_SO)
+                for s in [os.path.join(_SRC, "moe_align.cc")]):
+            _build()
+        lib = ctypes.CDLL(_SO)
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    lib.tdt_moe_align_padded_rows.restype = ctypes.c_int64
+    lib.tdt_moe_align_padded_rows.argtypes = [
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32]
+    lib.tdt_moe_align_block_size.restype = ctypes.c_int32
+    lib.tdt_moe_align_block_size.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32)]
+    _lib = lib
+    return _lib
+
+
+def moe_align_block_size(ids: np.ndarray, num_experts: int, block_m: int):
+    """Native host-side twin of ops.group_gemm.align_tokens_by_expert:
+    returns (gather_idx [P] i32, row_valid [P] bool, block_expert [P/bm] i32)
+    for a host routing table — no device round-trip."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable "
+                           "(TDT_NO_NATIVE=1 or no toolchain)")
+    ids = np.ascontiguousarray(ids, dtype=np.int32)
+    T = ids.shape[0]
+    P = lib.tdt_moe_align_padded_rows(T, num_experts, block_m)
+    gather_idx = np.zeros(P, np.int32)
+    row_valid = np.zeros(P, np.uint8)
+    block_expert = np.zeros(P // block_m, np.int32)
+    rc = lib.tdt_moe_align_block_size(
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), T, num_experts,
+        block_m,
+        gather_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        row_valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        block_expert.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    assert rc == 0, f"tdt_moe_align_block_size failed: rc={rc}"
+    return gather_idx, row_valid.astype(bool), block_expert
+
+
+__all__ = ["get_lib", "moe_align_block_size"]

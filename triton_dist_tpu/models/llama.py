@@ -216,6 +216,118 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
 
 
 # ---------------------------------------------------------------------------
+# decode / serving path (KV cache + flash-decode kernel)
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_seq: int) -> dict:
+    """Head-major cache layout [L, B, Hkv, S, D] — KV blocks are
+    tiling-aligned DMA slices for the decode kernel (ops.flash_decode)."""
+    Hkv, Dh = cfg.n_kv_heads, cfg.head_dim
+    shape = (cfg.n_layers, batch, Hkv, max_seq, Dh)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def prefill(params: dict, tokens: jax.Array, cfg: LlamaConfig,
+            cache: dict) -> tuple[jax.Array, dict]:
+    """Full-sequence forward that also writes K/V into ``cache[:, :, :S]``.
+    Returns (last-position logits [B, V], cache)."""
+    B, S = tokens.shape
+    Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    x = params["embed"][tokens].astype(cfg.dtype)
+    positions = jnp.arange(S)[None, :].repeat(B, 0)
+
+    def body(x, layer):
+        p, ck, cv = layer
+        h = rmsnorm(x, p["attn_norm"], cfg.norm_eps)
+        q = rope((h @ p["wq"]).reshape(B, S, Hq, Dh), positions,
+                 cfg.rope_theta)
+        k = rope((h @ p["wk"]).reshape(B, S, Hkv, Dh), positions,
+                 cfg.rope_theta)
+        v = (h @ p["wv"]).reshape(B, S, Hkv, Dh)
+        ck = lax.dynamic_update_slice(
+            ck, k.transpose(0, 2, 1, 3), (0, 0, 0, 0))
+        cv = lax.dynamic_update_slice(
+            cv, v.transpose(0, 2, 1, 3), (0, 0, 0, 0))
+        attn = _attention(q, k, v, 1.0 / math.sqrt(Dh))
+        x = x + attn.reshape(B, S, Hq * Dh) @ p["wo"]
+        h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps)
+        ff = jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)
+                         ).astype(h.dtype) * (h @ p["w_up"])
+        x = x + ff @ p["w_down"]
+        return x, (ck, cv)
+
+    x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"],
+                                     cache["v"]))
+    x = rmsnorm(x[:, -1], params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": ks, "v": vs}
+
+
+def decode_step(params: dict, token: jax.Array, pos: jax.Array,
+                cfg: LlamaConfig, cache: dict) -> tuple[jax.Array, dict]:
+    """One-token decode via the flash-decode kernel. ``token`` [B] int32,
+    ``pos`` scalar int32 (cache slots filled so far). Returns
+    (logits [B, V], cache). Attention = ops.flash_decode.gqa_decode_partial
+    over the cache (the single-rank half of SpGQAFlashDecodeAttention)."""
+    from triton_dist_tpu.ops.flash_decode import gqa_decode_partial
+
+    B = token.shape[0]
+    Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    x = params["embed"][token].astype(cfg.dtype)          # [B, D]
+    positions = jnp.full((B, 1), pos, jnp.int32)
+
+    def body(x, layer):
+        p, ck, cv = layer
+        h = rmsnorm(x, p["attn_norm"], cfg.norm_eps)
+        q = rope((h @ p["wq"]).reshape(B, 1, Hq, Dh), positions,
+                 cfg.rope_theta)[:, 0]                     # [B, Hq, Dh]
+        k = rope((h @ p["wk"]).reshape(B, 1, Hkv, Dh), positions,
+                 cfg.rope_theta)
+        v = (h @ p["wv"]).reshape(B, 1, Hkv, Dh)
+        ck = lax.dynamic_update_slice(ck, k.transpose(0, 2, 1, 3),
+                                      (0, 0, pos, 0))
+        cv = lax.dynamic_update_slice(cv, v.transpose(0, 2, 1, 3),
+                                      (0, 0, pos, 0))
+        kv_len = jnp.full((B,), pos + 1, jnp.int32)
+        attn, _lse = gqa_decode_partial(q, ck, cv, kv_len)
+        x = x + attn.reshape(B, Hq * Dh) @ p["wo"]
+        h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps)
+        ff = jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)
+                         ).astype(h.dtype) * (h @ p["w_up"])
+        x = x + ff @ p["w_down"]
+        return x, (ck, cv)
+
+    x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"],
+                                     cache["v"]))
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": ks, "v": vs}
+
+
+def generate(params: dict, prompt: jax.Array, cfg: LlamaConfig,
+             max_new_tokens: int, max_seq: int | None = None) -> jax.Array:
+    """Greedy generation: prefill + scanned decode loop (batch decode, the
+    reference's target regime, SURVEY.md §5.7). Returns [B, max_new_tokens].
+    """
+    B, S0 = prompt.shape
+    max_seq = max_seq or cfg.max_seq_len
+    assert S0 + max_new_tokens <= max_seq
+    cache = init_kv_cache(cfg, B, max_seq)
+    logits, cache = prefill(params, prompt, cfg, cache)
+    tok0 = jnp.argmax(logits, -1).astype(jnp.int32)
+
+    def step(carry, i):
+        tok, cache = carry
+        logits, cache = decode_step(params, tok, S0 + i, cfg, cache)
+        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+        return (nxt, cache), tok
+
+    (_, _), toks = lax.scan(step, (tok0, cache),
+                            jnp.arange(max_new_tokens, dtype=jnp.int32))
+    return toks.T                                          # [B, new]
+
+
+# ---------------------------------------------------------------------------
 # hand-overlapped TP forward (the reference's raison d'être)
 # ---------------------------------------------------------------------------
 
@@ -281,4 +393,5 @@ def forward_tp_overlap(ctx: ShmemContext, params: dict, tokens: jax.Array,
 
 
 __all__ = ["LlamaConfig", "init_params", "param_specs", "forward",
-           "forward_tp_overlap", "rmsnorm", "rope", "block_apply"]
+           "forward_tp_overlap", "rmsnorm", "rope", "block_apply",
+           "init_kv_cache", "prefill", "decode_step", "generate"]

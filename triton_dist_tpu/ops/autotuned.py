@@ -1,0 +1,67 @@
+"""Autotuned entry points for the overlap ops — the reference wraps its
+AG-GEMM/GEMM-RS thunks in ``contextual_autotune`` the same way
+(docs/autotuner.md; autotuner.py:247-256).
+
+Candidate tile configs are pruned by shape divisibility and VMEM budget
+before timing, and every process agrees on the winner (consensus in
+tools.autotuner)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.ops.allgather_gemm import ag_gemm
+from triton_dist_tpu.ops.gemm import GemmConfig
+from triton_dist_tpu.ops.gemm_reduce_scatter import gemm_rs
+from triton_dist_tpu.shmem.context import ShmemContext
+from triton_dist_tpu.tools.autotuner import contextual_autotune
+
+_CANDIDATES = [
+    GemmConfig(128, 128), GemmConfig(128, 256), GemmConfig(256, 128),
+    GemmConfig(256, 256), GemmConfig(512, 256), GemmConfig(256, 512),
+    GemmConfig(64, 128), GemmConfig(32, 64),
+]
+
+
+def _prune_ag(cfg: GemmConfig, args) -> bool:
+    ctx, a, b = args[:3]
+    axis = args[3] if len(args) > 3 else ctx.axis_names[0]
+    n = ctx.axis_size(axis)
+    M, K = a.shape
+    n_local = b.shape[1] // n
+    return ((M // n) % cfg.block_m == 0 and n_local % cfg.block_n == 0
+            and cfg.vmem_ok(K, jnp.dtype(a.dtype).itemsize))
+
+
+def _prune_rs(cfg: GemmConfig, args) -> bool:
+    ctx, a, b = args[:3]
+    axis = args[3] if len(args) > 3 else ctx.axis_names[0]
+    n = ctx.axis_size(axis)
+    M, K = a.shape
+    N = b.shape[1]
+    return ((M // n) % cfg.block_m == 0 and N % cfg.block_n == 0
+            and cfg.vmem_ok(K // n, jnp.dtype(a.dtype).itemsize))
+
+
+_ag_jit = jax.jit(ag_gemm, static_argnums=(0,),
+                  static_argnames=("axis", "cfg", "out_dtype"))
+_rs_jit = jax.jit(gemm_rs, static_argnums=(0,),
+                  static_argnames=("axis", "cfg", "out_dtype"))
+
+
+@contextual_autotune(configs=_CANDIDATES, prune=_prune_ag)
+def ag_gemm_autotuned(ctx: ShmemContext, a: jax.Array, b: jax.Array,
+                      axis: str | None = None, cfg: GemmConfig | None = None,
+                      out_dtype=None) -> jax.Array:
+    return _ag_jit(ctx, a, b, axis=axis, cfg=cfg, out_dtype=out_dtype)
+
+
+@contextual_autotune(configs=_CANDIDATES, prune=_prune_rs)
+def gemm_rs_autotuned(ctx: ShmemContext, a: jax.Array, b: jax.Array,
+                      axis: str | None = None, cfg: GemmConfig | None = None,
+                      out_dtype=None) -> jax.Array:
+    return _rs_jit(ctx, a, b, axis=axis, cfg=cfg, out_dtype=out_dtype)
+
+
+__all__ = ["ag_gemm_autotuned", "gemm_rs_autotuned"]

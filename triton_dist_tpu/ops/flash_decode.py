@@ -39,17 +39,16 @@ from triton_dist_tpu.utils import default_interpret
 NEG_INF = -1e30
 
 
-def _decode_kernel(kv_len_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
-                   acc, m_i, l_i, *, block_s: int, sm_scale: float,
-                   n_kv_heads: int):
-    """Grid (B, S//block_s). Online softmax over KV blocks; all Hq query
-    heads are processed per step as a [Hkv, G, ·] batched contraction (Mosaic
-    needs the last-two block dims full/aligned, so heads are not split).
-    Analog of kernel_gqa_fwd_batch_decode_split_kv (flash_decode.py:129-280)
-    with the split-KV dimension replaced by sequential KV-block pipelining.
-    """
-    b = pl.program_id(0)
-    s = pl.program_id(1)
+def _online_softmax_body(s, kv_len, q_ref, k_ref, v_ref, out_ref, lse_ref,
+                         acc, m_i, l_i, *, block_s: int, sm_scale: float,
+                         n_kv_heads: int):
+    """Shared grid-step body for the decode kernels: init at s==0, one
+    online-softmax update per KV block, finalize (incl. lse) at the last
+    step. All Hq query heads are processed per step as a [Hkv, G, ·] batched
+    contraction (Mosaic needs the last-two block dims full/aligned, so heads
+    are not split). Analog of kernel_gqa_fwd_batch_decode_split_kv
+    (flash_decode.py:129-280) with the split-KV dimension replaced by
+    sequential KV-block pipelining."""
     n_s = pl.num_programs(1)
 
     @pl.when(s == 0)
@@ -57,8 +56,6 @@ def _decode_kernel(kv_len_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
         acc[...] = jnp.zeros_like(acc)
         m_i[...] = jnp.full_like(m_i, NEG_INF)
         l_i[...] = jnp.zeros_like(l_i)
-
-    kv_len = kv_len_ref[b]
 
     @pl.when(s * block_s < kv_len)
     def _():
@@ -92,6 +89,31 @@ def _decode_kernel(kv_len_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
         # lse = m + log(l); empty shard -> NEG_INF so combine ignores it
         lse = jnp.where(l_i[...] > 0, m_i[...] + jnp.log(l_safe), NEG_INF)
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _decode_kernel(kv_len_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
+                   acc, m_i, l_i, *, block_s: int, sm_scale: float,
+                   n_kv_heads: int):
+    """Grid (B, S//block_s) over a contiguous KV shard."""
+    b = pl.program_id(0)
+    s = pl.program_id(1)
+    _online_softmax_body(s, kv_len_ref[b], q_ref, k_ref, v_ref, out_ref,
+                         lse_ref, acc, m_i, l_i, block_s=block_s,
+                         sm_scale=sm_scale, n_kv_heads=n_kv_heads)
+
+
+def _decode_paged_kernel(kv_len_ref, bt_ref, q_ref, k_ref, v_ref, out_ref,
+                         lse_ref, acc, m_i, l_i, *, block_s: int,
+                         sm_scale: float, n_kv_heads: int):
+    """Grid (B, pages_per_seq) over a paged KV pool; ``bt_ref`` is the
+    block table (scalar-prefetch — the index_map streams page
+    ``bt[b, s]``). Analog of the reference's block_table-driven split-KV
+    kernel (flash_decode.py:129-280 `page` indexing)."""
+    b = pl.program_id(0)
+    s = pl.program_id(1)
+    _online_softmax_body(s, kv_len_ref[b], q_ref, k_ref, v_ref, out_ref,
+                         lse_ref, acc, m_i, l_i, block_s=block_s,
+                         sm_scale=sm_scale, n_kv_heads=n_kv_heads)
 
 
 def gqa_decode_partial(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
@@ -152,6 +174,63 @@ def gqa_decode_partial(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             transcendentals=B * Hq * S),
         interpret=default_interpret(),
     )(kv_len, q, k_cache, v_cache)
+
+
+def gqa_decode_paged(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                     block_table: jax.Array, kv_len: jax.Array,
+                     sm_scale: float | None = None):
+    """Paged-attention decode over a shared KV page pool (the serving-side
+    cache layout; parity with the reference's block_table path and its
+    ``ref_paged_attn`` golden, test_sp_decode_attn.py:81-134).
+
+    q [B, Hq, D]; k_pages/v_pages [P, Hkv, page_size, D] (page-major pool);
+    block_table [B, pages_per_seq] int32 page ids (entries past
+    ceil(kv_len/page_size) may be arbitrary valid ids — masked out);
+    kv_len [B]. Returns (out [B, Hq, D], lse [B, Hq, 128] f32).
+    """
+    B, Hq, D = q.shape
+    P_pool, Hkv, page_size, _ = k_pages.shape
+    assert Hq % Hkv == 0
+    assert page_size % 8 == 0, f"page_size {page_size} must be 8-aligned"
+    pages_per_seq = block_table.shape[1]
+    sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+
+    kernel = functools.partial(_decode_paged_kernel, block_s=page_size,
+                               sm_scale=sm_scale, n_kv_heads=Hkv)
+    grid = (B, pages_per_seq)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, Hq, D), lambda b, s, kl, bt: (b, 0, 0)),
+                pl.BlockSpec((1, Hkv, page_size, D),
+                             lambda b, s, kl, bt: (bt[b, s], 0, 0, 0)),
+                pl.BlockSpec((1, Hkv, page_size, D),
+                             lambda b, s, kl, bt: (bt[b, s], 0, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, Hq, D), lambda b, s, kl, bt: (b, 0, 0)),
+                pl.BlockSpec((1, Hq, 128), lambda b, s, kl, bt: (b, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((Hq, D), jnp.float32),
+                pltpu.VMEM((Hq, 1), jnp.float32),
+                pltpu.VMEM((Hq, 1), jnp.float32),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, Hq, 128), jnp.float32),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * Hq * pages_per_seq * page_size * D,
+            bytes_accessed=(q.size
+                            + B * pages_per_seq * Hkv * page_size * D * 2),
+            transcendentals=B * Hq * pages_per_seq * page_size),
+        interpret=default_interpret(),
+    )(kv_len, block_table, q, k_pages, v_pages)
 
 
 def _combine_kernel(outs_ref, lses_ref, out_ref):
@@ -237,4 +316,5 @@ def sp_gqa_flash_decode(ctx: ShmemContext, q: jax.Array, k_cache: jax.Array,
     return smc(g)
 
 
-__all__ = ["gqa_decode_partial", "decode_combine", "sp_gqa_flash_decode"]
+__all__ = ["gqa_decode_partial", "gqa_decode_paged", "decode_combine",
+           "sp_gqa_flash_decode"]

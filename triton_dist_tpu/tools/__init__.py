@@ -1,0 +1,3 @@
+from triton_dist_tpu.tools.autotuner import contextual_autotune  # noqa: F401
+from triton_dist_tpu.tools.aot import (  # noqa: F401
+    aot_compile, aot_compile_spaces, export_serialized, load_serialized)

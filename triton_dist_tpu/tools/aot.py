@@ -1,0 +1,87 @@
+"""AOT compilation / deployment path (analog of reference tools/compile_aot.py
+``@aot_compile_spaces`` + generated C dispatchers + triton_aot_runtime.cc,
+SURVEY.md §5.9).
+
+The reference generates C dispatcher code per kernel signature, compiles it
+into ``libtriton_distributed_kernel.so``, and loads CUDA cubins at runtime.
+On TPU the whole machinery collapses into jax's AOT stack:
+
+- ``aot_compile``        = ``jit(fn).lower(*args).compile()`` — an executable
+  bound to this process's devices (no re-trace, no re-compile at call time).
+- ``export_serialized``  = ``jax.export`` → portable StableHLO artifact on
+  disk (the ``.so``-shipping analog); ``load_serialized`` rehydrates it in a
+  fresh process and recompiles for the local topology.
+- ``aot_compile_spaces`` = the dispatcher: a decorator precompiling one
+  executable per declared signature and dispatching on arg shapes/dtypes at
+  call time (cf. compile_aot.py:61-77's signature/grid spaces and the
+  generated per-variant C entry points).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+
+
+def aot_compile(fn: Callable, *example_args, **jit_kw):
+    """Lower+compile ``fn`` for ``example_args``'s shapes now; returns the
+    compiled executable (callable with matching-shaped args)."""
+    return jax.jit(fn, **jit_kw).lower(*example_args).compile()
+
+
+def export_serialized(fn: Callable, *example_args, **jit_kw) -> bytes:
+    """Portable serialized artifact (StableHLO) of ``fn`` at these shapes."""
+    from jax import export
+    exp = export.export(jax.jit(fn, **jit_kw))(*example_args)
+    return bytes(exp.serialize())
+
+
+def load_serialized(data: bytes) -> Callable:
+    """Rehydrate an ``export_serialized`` artifact; the returned callable
+    compiles for the local topology on first call."""
+    from jax import export
+    return export.deserialize(data).call
+
+
+def _sig_of(args: Sequence[Any]) -> tuple:
+    return tuple((tuple(a.shape), str(a.dtype))
+                 if hasattr(a, "shape") and hasattr(a, "dtype")
+                 else ("static", a)
+                 for a in args)
+
+
+def aot_compile_spaces(spaces: Mapping[str, Callable[[], tuple]],
+                       **jit_kw):
+    """Decorator: precompile ``fn`` for every named signature space and
+    dispatch by runtime arg signature.
+
+    ``spaces`` maps variant name → zero-arg factory returning example args
+    (factories defer allocation until ``precompile`` or first use). Unknown
+    signatures fall back to plain ``jax.jit`` (and are cached thereafter).
+    """
+    def deco(fn):
+        jitted = jax.jit(fn, **jit_kw)
+        compiled: dict[tuple, Any] = {}
+
+        def precompile():
+            for name, factory in spaces.items():
+                args = factory()
+                compiled[_sig_of(args)] = aot_compile(fn, *args, **jit_kw)
+            return {n: True for n in spaces}
+
+        @functools.wraps(fn)
+        def wrapper(*args):
+            exe = compiled.get(_sig_of(args))
+            return exe(*args) if exe is not None else jitted(*args)
+
+        wrapper.precompile = precompile
+        wrapper.compiled = compiled
+        return wrapper
+
+    return deco
+
+
+__all__ = ["aot_compile", "export_serialized", "load_serialized",
+           "aot_compile_spaces"]

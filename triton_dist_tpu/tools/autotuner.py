@@ -1,0 +1,103 @@
+"""Distributed thunk-level autotuner (analog of reference
+python/triton_dist/autotuner.py ``contextual_autotune``).
+
+The reference cannot use Triton's per-kernel autotuner for overlap ops — a
+config change alters *multi-kernel pipelines with side effects* (symmetric
+buffers, signals), and each rank must pick the SAME config or the job
+deadlocks. So it tunes whole thunks by re-running full calls per config and
+reaches cross-rank consensus by all-reducing MAX of the timings
+(autotuner.py:225-256).
+
+Same shape here, simpler by construction:
+- a "thunk" is a pure jitted function → re-running per config is safe by
+  default (no serial-mode bisection needed);
+- consensus: jax is single-controller per process, but multi-host jobs still
+  time differently per host — we allgather per-host timings and take the
+  elementwise MAX (a config is as slow as its slowest host), exactly the
+  reference's consensus rule;
+- results are cached per (function, static key, arg shapes) and logged to
+  ``.autotune_logs/process-N.log`` (cf. autotuner.py:57-67).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+
+from triton_dist_tpu.utils.perf import perf_func
+
+_CACHE: dict = {}
+
+
+def _consensus_times(times: np.ndarray) -> np.ndarray:
+    """Elementwise MAX of per-host timings across processes (reference
+    all_reduce(MAX) consensus, autotuner.py:225-238)."""
+    if jax.process_count() == 1:
+        return times
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(times)  # [P, n_cfg]
+    return np.max(np.asarray(gathered), axis=0)
+
+
+def _log(msg: str) -> None:
+    os.makedirs(".autotune_logs", exist_ok=True)
+    path = f".autotune_logs/process-{jax.process_index()}.log"
+    with open(path, "a") as f:
+        f.write(f"[{time.strftime('%H:%M:%S')}] {msg}\n")
+
+
+def contextual_autotune(configs: Sequence[Any], iters: int = 5,
+                        warmup: int = 2,
+                        prune: Callable[[Any, tuple], bool] | None = None):
+    """Decorator: ``fn(*args, cfg=<config>, **kw)`` gets its ``cfg`` picked
+    by timing every candidate on the first call per arg-shape signature.
+
+    ``prune(config, args)`` may return False to skip invalid candidates
+    (e.g. tile sizes that don't divide the shapes — the analog of Triton's
+    early-config-prune).
+    """
+    configs = list(configs)
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kw):
+            if kw.get("cfg") is not None:
+                return fn(*args, **kw)
+            key = (fn.__qualname__,
+                   tuple((tuple(a.shape), str(a.dtype))
+                         if hasattr(a, "shape") else a for a in args))
+            if key not in _CACHE:
+                cands = [c for c in configs
+                         if prune is None or prune(c, args)]
+                assert cands, f"all autotune configs pruned for {key}"
+                times = np.full((len(cands),), np.inf)
+                for i, c in enumerate(cands):
+                    try:
+                        kw2 = dict(kw, cfg=c)
+                        _, ms = perf_func(lambda: fn(*args, **kw2),
+                                          iters=iters, warmup_iters=warmup)
+                        times[i] = ms
+                    except Exception as e:  # config failed to compile/run
+                        _log(f"{fn.__qualname__} cfg {c}: FAILED {e!r}")
+                times = _consensus_times(times)
+                best = int(np.argmin(times))
+                assert np.isfinite(times[best]), (
+                    f"every autotune config failed for {key}")
+                _CACHE[key] = cands[best]
+                _log(f"{fn.__qualname__} {key[1]}: picked {cands[best]} "
+                     f"({times[best]:.3f} ms; "
+                     f"{np.sum(np.isfinite(times))}/{len(cands)} ok)")
+            return fn(*args, **dict(kw, cfg=_CACHE[key]))
+
+        wrapper._autotune_cache = _CACHE
+        return wrapper
+
+    return deco
+
+
+__all__ = ["contextual_autotune"]

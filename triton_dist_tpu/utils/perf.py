@@ -14,12 +14,20 @@ import contextlib
 import time
 
 import jax
+import numpy as np
 
 
 def _block(tree):
+    """Synchronize on ``tree``'s buffers. ``block_until_ready`` alone is not
+    trusted: under remote-execution runtimes (axon tunnel) it can return
+    before the device work lands, over-reporting throughput ~100x. A 1-element
+    device-to-host pull cannot complete early, so pull one scalar per leaf;
+    in-order execution then guarantees everything earlier finished too."""
     for leaf in jax.tree_util.tree_leaves(tree):
         if hasattr(leaf, "block_until_ready"):
             leaf.block_until_ready()
+        if hasattr(leaf, "addressable_shards") and leaf.size:
+            np.asarray(leaf.addressable_shards[0].data.ravel()[:1])
 
 
 def perf_func(func, iters: int = 10, warmup_iters: int = 3, return_result: bool = False):
