@@ -11,17 +11,20 @@ TPU-native design — ONE kernel per device, no streams:
 1. On entry, a light barrier (cf. ``local_copy_and_barrier_all``,
    allgather_gemm.py:99-116) protects the symmetric workspace across calls.
 2. Issue *all* remote puts of the local A-shard into every peer's workspace
-   slot ``me`` as non-blocking DMAs, plus a local copy into our own slot.
-   The ICI DMA engines are the "copy-engine producer" running in the
-   background of compute.
-3. Walk segments in swizzled order ``me, me+1, …`` (start-local trick),
-   wait that segment's receive semaphore once (TPU grids are sequential per
-   core — no per-tile spin flags needed), then run the pipelined MXU GEMM
-   for that segment via ``emit_gemm``.
+   slot ``me`` as non-blocking DMAs. The ICI DMA engines are the
+   "copy-engine producer" running in the background of compute.
+3. Walk segments in swizzled order ``me, me+1, …`` (start-local trick).
+   The FIRST segment is always our own shard, so its GEMM reads ``a_ref``
+   directly — no workspace copy, no wait: compute starts immediately while
+   every transfer is in flight (one better than the reference, which
+   local-copies into the symm buffer first, allgather_gemm.py:99-116).
+   Each remote segment waits its receive semaphore once (TPU grids are
+   sequential per core — no per-tile spin flags needed), then runs the
+   pipelined MXU GEMM via ``emit_gemm``.
 
-Segment-0 compute overlaps all in-flight transfers; steady state overlaps
-segment s's GEMM with segment s+1's arrival — same overlap structure, no
-CUDA-stream machinery.
+Steady state overlaps segment s's GEMM with segment s+1's arrival — same
+overlap structure, no CUDA-stream machinery. The n=1 degenerate case leaves
+barrier + MXU pipeline only, preserving full single-chip GEMM efficiency.
 """
 
 from __future__ import annotations
@@ -55,9 +58,8 @@ def _ag_gemm_kernel(axis, mesh_axes, cfg, out_dtype,
     shd.barrier_all(axis if isinstance(axis, tuple) else (axis,),
                     mesh_axes=mesh_axes)
 
-    # producer phase: local copy + puts to every peer (non-blocking)
-    local = pltpu.make_async_copy(a_ref, ws_ref.at[me], recv_sems.at[me])
-    local.start()
+    # producer phase: puts to every peer (non-blocking); our own segment
+    # never touches the workspace (consumed straight from a_ref below)
     rdmas = []
     for p in range(1, n):
         dst = lax.rem(me + p, n)
@@ -65,8 +67,11 @@ def _ag_gemm_kernel(axis, mesh_axes, cfg, out_dtype,
         rdmas.append(shd.putmem_nbi(ws_ref.at[me], a_ref,
                                     send_sems.at[dst], recv_sems.at[me], pid))
 
-    # consumer phase: swizzled segment loop, start local
-    for s in range(n):
+    # consumer phase: swizzled segment loop — s=0 is statically the local
+    # segment (seg == me), fed by a_ref with zero wait
+    emit_gemm(a_ref, b_ref, out_ref.at[pl.ds(me * m_local, m_local)], cfg,
+              out_dtype)
+    for s in range(1, n):
         seg = lax.rem(me + s, n)
         shd.wait_recv(ws_ref.at[seg], recv_sems.at[seg])
         emit_gemm(ws_ref.at[seg], b_ref,
