@@ -96,7 +96,14 @@ def make_calls_timer(fn, args):
     """Timer over ``iters`` back-to-back dispatches plus one final pull —
     in-order device execution makes the pull wait for every prior kernel.
     Used for ops whose output sharding/shape differs from the input's (so
-    they do not self-chain): multi-chip ag_gemm, A2A dispatch."""
+    they do not self-chain): currently only multi-chip ag_gemm.
+
+    Every in-flight dispatch holds its output buffer live, so callers must
+    keep ``iters`` small enough that iters × out_bytes fits HBM (a mid-chain
+    sync can't fix this: a true scalar pull costs a tunnel round-trip that
+    would NOT cancel in the differencing, and ``block_until_ready`` can
+    return early here — see the module docstring). ``_CALLS_ITERS`` below is
+    sized for ≤ ~2 GB of in-flight [4096, 4096] bf16-class outputs."""
     pull = jax.jit(lambda x: jnp.sum(
         jax.tree.leaves(x)[0].astype(jnp.float32)))
 
@@ -107,6 +114,11 @@ def make_calls_timer(fn, args):
         return float(pull(out))
 
     return timer
+
+
+# iteration pair for make_calls_timer paths: bounded in-flight memory
+# (see make_calls_timer); the chain-timer paths use the wider i1/i2 spread
+_CALLS_ITERS = (4, 54)
 
 
 def bench_ag_gemm(ctx, n_dev: int, M: int, N: int, K: int, configs,
@@ -139,11 +151,12 @@ def bench_ag_gemm(ctx, n_dev: int, M: int, N: int, K: int, configs,
                 step = lambda x, y, c=cfg: ag_gemm(
                     ctx, x, y, axis="x", cfg=c, out_dtype=jnp.bfloat16)
                 timer = make_chain_timer(step, a_s, b_s)
+                best_s = min(best_s, _per_iter(timer, i1, i2))
             else:
                 f = jax.jit(lambda a, b, c=cfg: ag_gemm(
                     ctx, a, b, axis="x", cfg=c, out_dtype=jnp.bfloat16))
                 timer = make_calls_timer(f, (a_s, b_s))
-            best_s = min(best_s, _per_iter(timer, i1, i2))
+                best_s = min(best_s, _per_iter(timer, *_CALLS_ITERS))
         except Exception:
             continue
     return best_s
@@ -173,8 +186,17 @@ def bench_a2a(ctx, tokens_per_rank: int, hidden: int, topk: int,
                                                    (T, topk)), axis=-1),
                   P(axis))
 
-    disp = jax.jit(lambda t, i: dispatch(a2a, t, i))
-    dispatch_s = _per_iter(make_calls_timer(disp, (tokens, ids)), i1, i2)
+    # dispatch alone does not self-chain ([T,H] → [n,cap,H]), so feed an
+    # epsilon-scaled summary of the output back into the input: a real data
+    # dependency (not constant-foldable) that lets the scan-based chain
+    # timer manage buffers (XLA reuses them across iterations — hundreds of
+    # un-executed dispatches would otherwise hold [n,cap,H] each)
+    def disp_step(t, i):
+        recv_tokens, _, _ = dispatch(a2a, t, i)
+        eps = (jnp.sum(recv_tokens.astype(jnp.float32)) * 1e-20).astype(t.dtype)
+        return t + eps
+
+    dispatch_s = _per_iter(make_chain_timer(disp_step, tokens, ids), i1, i2)
 
     # dispatch→combine roundtrip self-chains ([T,H] → [T,H]), so it can be
     # timed as a data-dependent scan — immune to host-dispatch noise

@@ -17,7 +17,7 @@ def test_autotuner_picks_and_caches():
     calls = []
 
     @contextual_autotune(configs=[1, 2, 3], iters=1, warmup=0,
-                         prune=lambda c, args: c != 3)
+                         prune=lambda c, args, kw: c != 3)
     def op(x, cfg=None):
         calls.append(cfg)
         return x * cfg

@@ -82,7 +82,7 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
     normal) in ``cfg.dtype`` (bf16 keeps the MXU fed); norm gains in f32."""
     L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
     Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    keys = jax.random.split(key, 8)
+    keys = jax.random.split(key, 9)
     s = 0.02
 
     def norm(k, *shape):
@@ -102,7 +102,7 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
             "w_down": norm(keys[7], L, F, D) / math.sqrt(2 * L),
         },
         "final_norm": jnp.ones((D,), jnp.float32),
-        "lm_head": norm(keys[0], D, cfg.vocab_size),
+        "lm_head": norm(keys[8], D, cfg.vocab_size),
     }
 
 

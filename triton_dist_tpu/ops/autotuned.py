@@ -24,20 +24,24 @@ _CANDIDATES = [
 ]
 
 
-def _prune_ag(cfg: GemmConfig, args) -> bool:
+def _axis_of(ctx, args, kw):
+    if len(args) > 3 and args[3] is not None:
+        return args[3]
+    return kw.get("axis") or ctx.axis_names[0]
+
+
+def _prune_ag(cfg: GemmConfig, args, kw) -> bool:
     ctx, a, b = args[:3]
-    axis = args[3] if len(args) > 3 else ctx.axis_names[0]
-    n = ctx.axis_size(axis)
+    n = ctx.axis_size(_axis_of(ctx, args, kw))
     M, K = a.shape
     n_local = b.shape[1] // n
     return ((M // n) % cfg.block_m == 0 and n_local % cfg.block_n == 0
             and cfg.vmem_ok(K, jnp.dtype(a.dtype).itemsize))
 
 
-def _prune_rs(cfg: GemmConfig, args) -> bool:
+def _prune_rs(cfg: GemmConfig, args, kw) -> bool:
     ctx, a, b = args[:3]
-    axis = args[3] if len(args) > 3 else ctx.axis_names[0]
-    n = ctx.axis_size(axis)
+    n = ctx.axis_size(_axis_of(ctx, args, kw))
     M, K = a.shape
     N = b.shape[1]
     return ((M // n) % cfg.block_m == 0 and N % cfg.block_n == 0

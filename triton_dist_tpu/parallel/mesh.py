@@ -6,8 +6,9 @@ and NUMA/NVLink topology probing to pick algorithms (utils.py:504-607). On TPU
 the topology is explicit — a `jax.sharding.Mesh` over named axes — and every
 parallelism dimension (dp/pp/tp/ep) is an axis name. These helpers build
 meshes from axis-size dicts and factorize an unknown device count into a
-requested axis order (outermost axis gets the largest factor, so dp rides DCN
-and tp rides ICI, per the scaling-book recipe).
+requested axis order (the ``prefer_inner`` axis — tp by default, the one that
+most needs fast neighbours — gets the largest factor and rides ICI; outer
+axes like dp get the rest and may ride DCN, per the scaling-book recipe).
 """
 
 from __future__ import annotations
@@ -38,23 +39,24 @@ def factorize_devices(n_devices: int,
                       axis_order: Sequence[str] = ("dp", "pp", "tp"),
                       prefer_inner: str | None = "tp") -> dict[str, int]:
     """Split ``n_devices`` across the named axes. The ``prefer_inner`` axis
-    (innermost = fastest interconnect neighbours) takes the largest factor;
-    remaining factors are dealt outer-to-inner. E.g. 8 → {dp:2, pp:2, tp:2};
-    4 → {dp:1, pp:2, tp:2}; 2 → {dp:1, pp:1, tp:2}; 1 → all ones."""
+    (innermost = fastest interconnect neighbours) takes the largest prime
+    factor; the rest are dealt largest-first, round-robin inner-to-outer.
+    E.g. 8 → {dp:2, pp:2, tp:2}; 4 → {dp:1, pp:2, tp:2};
+    12 → {dp:2, pp:2, tp:3}; 1 → all ones."""
     axes = {a: 1 for a in axis_order}
-    # greedy: repeatedly halve into axes, preferring the inner axis first
     remaining = n_devices
     order = list(axis_order)[::-1]  # inner first
     if prefer_inner and prefer_inner in axes:
         order.remove(prefer_inner)
         order.insert(0, prefer_inner)
-    i = 0
+    factors = []
     while remaining > 1:
-        # find smallest prime factor
         f = next((p for p in range(2, remaining + 1) if remaining % p == 0))
-        axes[order[i % len(order)]] *= f
+        factors.append(f)
         remaining //= f
-        i += 1
+    # deal largest factors first so the preferred axis gets the biggest one
+    for i, f in enumerate(sorted(factors, reverse=True)):
+        axes[order[i % len(order)]] *= f
     return axes
 
 

@@ -80,8 +80,7 @@ def make_train_step(cfg, mesh, plan: ParallelPlan | None = None,
         specs = moe_mod.moe_param_specs(cfg, tp=plan.tp, ep=plan.ep)
         init_raw = lambda key: moe_mod.init_moe_params(key, cfg)
     else:
-        specs = llama_mod.param_specs(cfg.base if is_moe else cfg,
-                                      tp=plan.tp, pp=plan.pp)
+        specs = llama_mod.param_specs(cfg, tp=plan.tp, pp=plan.pp)
         init_raw = lambda key: llama_mod.init_params(key, cfg)
 
     def shardings(tree_specs):

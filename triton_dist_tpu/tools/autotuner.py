@@ -53,27 +53,39 @@ def _log(msg: str) -> None:
 
 def contextual_autotune(configs: Sequence[Any], iters: int = 5,
                         warmup: int = 2,
-                        prune: Callable[[Any, tuple], bool] | None = None):
+                        prune: Callable[[Any, tuple, dict], bool] | None = None):
     """Decorator: ``fn(*args, cfg=<config>, **kw)`` gets its ``cfg`` picked
     by timing every candidate on the first call per arg-shape signature.
 
-    ``prune(config, args)`` may return False to skip invalid candidates
+    ``prune(config, args, kw)`` may return False to skip invalid candidates
     (e.g. tile sizes that don't divide the shapes — the analog of Triton's
     early-config-prune).
     """
     configs = list(configs)
 
+    def _sig(a):
+        return ((tuple(a.shape), str(a.dtype)) if hasattr(a, "shape") else a)
+
     def deco(fn):
+        import inspect
+        fn_sig = inspect.signature(fn)
+
         @functools.wraps(fn)
         def wrapper(*args, **kw):
             if kw.get("cfg") is not None:
                 return fn(*args, **kw)
+            # kwargs like axis/out_dtype select different code paths, so they
+            # are part of the tuning signature (cfg itself is excluded).
+            # Bind to the canonical parameter form so positional vs keyword
+            # spelling of the same argument shares one cache entry.
+            bound = fn_sig.bind(*args, **kw)
+            bound.apply_defaults()
             key = (fn.__qualname__,
-                   tuple((tuple(a.shape), str(a.dtype))
-                         if hasattr(a, "shape") else a for a in args))
+                   tuple((k, _sig(v)) for k, v in bound.arguments.items()
+                         if k != "cfg"))
             if key not in _CACHE:
                 cands = [c for c in configs
-                         if prune is None or prune(c, args)]
+                         if prune is None or prune(c, args, kw)]
                 assert cands, f"all autotune configs pruned for {key}"
                 times = np.full((len(cands),), np.inf)
                 for i, c in enumerate(cands):
