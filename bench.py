@@ -102,8 +102,8 @@ def make_calls_timer(fn, args):
     keep ``iters`` small enough that iters × out_bytes fits HBM (a mid-chain
     sync can't fix this: a true scalar pull costs a tunnel round-trip that
     would NOT cancel in the differencing, and ``block_until_ready`` can
-    return early here — see the module docstring). ``_CALLS_ITERS`` below is
-    sized for ≤ ~2 GB of in-flight [4096, 4096] bf16-class outputs."""
+    return early here — see the module docstring). Use ``calls_iters`` to
+    size the iteration pair against the per-call output footprint."""
     pull = jax.jit(lambda x: jnp.sum(
         jax.tree.leaves(x)[0].astype(jnp.float32)))
 
@@ -116,9 +116,13 @@ def make_calls_timer(fn, args):
     return timer
 
 
-# iteration pair for make_calls_timer paths: bounded in-flight memory
-# (see make_calls_timer); the chain-timer paths use the wider i1/i2 spread
-_CALLS_ITERS = (4, 54)
+def calls_iters(out_bytes_per_call: int, i1: int, i2: int) -> tuple[int, int]:
+    """Iteration pair for make_calls_timer: as wide as the caller's (i1, i2)
+    spread allows while keeping in-flight output buffers under ~2 GB
+    (see make_calls_timer). On small smoke shapes this returns (i1, i2)
+    unchanged; it only narrows when the memory cap forces it."""
+    cap = max(2, int(2e9 // max(out_bytes_per_call, 1)))
+    return (min(i1, max(2, cap // 8)), min(i2, cap))
 
 
 def bench_ag_gemm(ctx, n_dev: int, M: int, N: int, K: int, configs,
@@ -156,7 +160,11 @@ def bench_ag_gemm(ctx, n_dev: int, M: int, N: int, K: int, configs,
                 f = jax.jit(lambda a, b, c=cfg: ag_gemm(
                     ctx, a, b, axis="x", cfg=c, out_dtype=jnp.bfloat16))
                 timer = make_calls_timer(f, (a_s, b_s))
-                best_s = min(best_s, _per_iter(timer, *_CALLS_ITERS))
+                # in-flight bytes/call: the [M, N/n] out + the discarded
+                # [n, M/n, K] workspace output (until workspaces persist)
+                per_call = 2 * (M * (N // n_dev) + M * K)
+                best_s = min(best_s, _per_iter(timer,
+                                               *calls_iters(per_call, i1, i2)))
         except Exception:
             continue
     return best_s

@@ -29,6 +29,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
+from triton_dist_tpu.ops.common import collective_id_for
 from triton_dist_tpu.shmem import device as shd
 from triton_dist_tpu.shmem.context import ShmemContext
 from triton_dist_tpu.utils import default_interpret
@@ -39,6 +40,14 @@ def _ag_push_kernel(axis, mesh_axes, in_ref, out_ref, send_sems, recv_sems):
     me = shd.my_pe(axis)
     n = shd.n_pes(axis)
     m = in_ref.shape[0]
+
+    # Entry barrier: DMA semaphores are physical registers shared across
+    # calls — without this, device A's call-k+1 put could signal device B's
+    # recv_sem while B is still draining call k, mis-delivering the arrival
+    # (cf. the reference's local_copy_and_barrier_all prologue,
+    # allgather_gemm.py:99-116). Devices execute kernels in order, so
+    # "everyone entered call k+1" implies "everyone exited call k".
+    shd.barrier_all((axis,), mesh_axes=mesh_axes)
 
     # own slot via local DMA
     local = pltpu.make_async_copy(in_ref, out_ref.at[pl.ds(me * m, m)],
@@ -67,6 +76,10 @@ def _ag_ring_kernel(axis, mesh_axes, in_ref, out_ref, send_sem, recv_sems):
     n = shd.n_pes(axis)
     m = in_ref.shape[0]
     right = shd.pe_at(mesh_axes, axis, lax.rem(me + 1, n))
+
+    # entry barrier: see _ag_push_kernel — protects cross-call semaphore
+    # delivery (ring neighbors advance at different speeds)
+    shd.barrier_all((axis,), mesh_axes=mesh_axes)
 
     local = pltpu.make_async_copy(in_ref, out_ref.at[pl.ds(me * m, m)],
                                   recv_sems.at[me])
@@ -101,7 +114,13 @@ def _ag_call(axis: str, mesh_axes, n: int, method: str, shard):
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            # distinct id per (kernel family, axis): the 2-D hierarchical AG
+            # runs two of these back-to-back over different axis subsets, and
+            # sharing one physical barrier semaphore would let stage-2
+            # signals satisfy a device still waiting in stage 1
+            collective_id=collective_id_for(f"ag_{method}_{axis}")),
         interpret=default_interpret(),
     )(shard)
 

@@ -22,6 +22,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
+from triton_dist_tpu.ops.common import collective_id_for
 from triton_dist_tpu.shmem import device as shd
 from triton_dist_tpu.shmem.context import ShmemContext
 from triton_dist_tpu.utils import default_interpret
@@ -37,6 +38,13 @@ def _rs_ring_kernel(axis, mesh_axes, in_ref, out_ref,
     right_idx = lax.rem(me + 1, n)
     right = shd.pe_at(mesh_axes, axis, right_idx)
     left = shd.pe_at(mesh_axes, axis, lax.rem(me - 1 + n, n))
+
+    # entry barrier: ack credits and recv semaphores are physical registers;
+    # without it a fast neighbor's call-k+1 signals could be consumed by our
+    # still-running call k (see _ag_push_kernel in allgather.py). Emitted
+    # before the n==1 early-out so the kernel always uses its barrier
+    # semaphore — compiled TPU rejects collective_id otherwise.
+    shd.barrier_all((axis,), mesh_axes=mesh_axes)
 
     if n == 1:
         pltpu.sync_copy(in_ref, out_ref)
@@ -86,7 +94,9 @@ def _rs_call(axis: str, mesh_axes, n: int, shard):
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR,
         ],
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            collective_id=collective_id_for(f"rs_ring_{axis}")),
         interpret=default_interpret(),
     )(shard)
 

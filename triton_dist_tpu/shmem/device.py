@@ -35,12 +35,44 @@ multi-axis) jax mesh, not a flat rank list.
 
 from __future__ import annotations
 
+import itertools
+import os
 from typing import Sequence
 
 import jax
 from jax import lax
 from jax.experimental import pallas as pl  # noqa: F401  (re-exported for kernels)
 from jax.experimental.pallas import tpu as pltpu
+
+
+# -- producer-delay fuzzing --------------------------------------------------
+
+_NOISE_SITE = itertools.count()
+
+
+def _noise_trips() -> int:
+    try:
+        return int(os.environ.get("TDT_NOISE", "0") or "0")
+    except ValueError:
+        return 0
+
+
+def producer_noise(src_ref) -> None:
+    """Sync-bug fuzzing hook (analog of the reference's
+    ``_add_noise_workload_debug`` sleep injection, allgather.py:72-76).
+
+    When ``TDT_NOISE=<n>`` is set at trace time, emits ``n * (site%3 + 1)``
+    effectful self-copies of ``src_ref`` before a put — per-call-site-varied
+    busywork that widens producer/consumer timing windows so missing waits
+    surface in interpret mode (pair with ``TDT_DETECT_RACES=1``). A no-op
+    (zero emitted ops) when unset; debug knob only — it emits real DMAs if
+    enabled on hardware."""
+    trips = _noise_trips()
+    if not trips:
+        return
+    k = next(_NOISE_SITE) % 3 + 1
+    for _ in range(trips * k):
+        pltpu.sync_copy(src_ref, src_ref)
 
 
 # -- PE identity ------------------------------------------------------------
@@ -95,6 +127,7 @@ def putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, pe,):
     DMA engine when the data has fully landed — this gives the
     "putmem_signal" delivery guarantee for free.
     """
+    producer_noise(src_ref)
     rdma = pltpu.make_async_remote_copy(
         src_ref=src_ref,
         dst_ref=dst_ref,
