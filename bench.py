@@ -92,48 +92,33 @@ def make_chain_timer(step_fn, a, b):
     return timer
 
 
-def make_calls_timer(fn, args):
-    """Timer over ``iters`` back-to-back dispatches plus one final pull —
-    in-order device execution makes the pull wait for every prior kernel.
-    Used for ops whose output sharding/shape differs from the input's (so
-    they do not self-chain): currently only multi-chip ag_gemm.
-
-    Every in-flight dispatch holds its output buffer live, so callers must
-    keep ``iters`` small enough that iters × out_bytes fits HBM (a mid-chain
-    sync can't fix this: a true scalar pull costs a tunnel round-trip that
-    would NOT cancel in the differencing, and ``block_until_ready`` can
-    return early here — see the module docstring). Use ``calls_iters`` to
-    size the iteration pair against the per-call output footprint."""
-    pull = jax.jit(lambda x: jnp.sum(
-        jax.tree.leaves(x)[0].astype(jnp.float32)))
-
-    def timer(iters: int):
-        out = None
-        for _ in range(iters):
-            out = fn(*args)
-        return float(pull(out))
-
-    return timer
-
-
 def calls_iters(out_bytes_per_call: int, i1: int, i2: int) -> tuple[int, int]:
-    """Iteration pair for make_calls_timer: as wide as the caller's (i1, i2)
-    spread allows while keeping in-flight output buffers under ~2 GB
-    (see make_calls_timer). On small smoke shapes this returns (i1, i2)
-    unchanged; it only narrows when the memory cap forces it."""
+    """Iteration pair for back-to-back-dispatch timers: as wide as the
+    caller's (i1, i2) spread allows while keeping in-flight output buffers
+    under ~2 GB. Un-executed dispatches hold their outputs live, and a
+    mid-loop sync can't bound that (a true scalar pull costs a tunnel
+    round-trip that would not cancel in the differencing;
+    ``block_until_ready`` can return early here — see module docstring).
+    On small smoke shapes this returns (i1, i2) unchanged."""
     cap = max(2, int(2e9 // max(out_bytes_per_call, 1)))
-    return (min(i1, max(2, cap // 8)), min(i2, cap))
+    hi = max(min(i2, cap), 2)
+    lo = max(min(i1, max(2, cap // 8), hi - 1), 1)  # strictly below hi
+    return lo, hi
 
 
 def bench_ag_gemm(ctx, n_dev: int, M: int, N: int, K: int, configs,
                   i1: int, i2: int) -> float:
-    """Best per-call seconds for the overlapping ``ag_gemm`` kernel.
+    """Best per-call seconds for the overlapping ``ag_gemm`` kernel, using
+    the persistent-workspace form (``ag_gemm_ws`` — context-owned symmetric
+    workspace threaded through the timing loop; zero per-call workspace
+    allocation, matching the reference's create-context-once usage).
 
     At n=1 the kernel degenerates to barrier_all + the segment-GEMM
     pipeline reading the input directly (the local segment bypasses the
     workspace by design); remote DMA paths only exist at n>1.
     """
-    from triton_dist_tpu.ops.allgather_gemm import ag_gemm
+    from triton_dist_tpu.ops.allgather_gemm import (ag_gemm_ws,
+                                                    create_ag_gemm_workspace)
 
     a = jax.random.normal(jax.random.key(0), (M, K), jnp.float32
                           ).astype(jnp.bfloat16)
@@ -141,6 +126,9 @@ def bench_ag_gemm(ctx, n_dev: int, M: int, N: int, K: int, configs,
                           ).astype(jnp.bfloat16)
     a_s = ctx.shard(a, P("x"))
     b_s = ctx.shard(b, P(None, "x"))
+    ws0 = (create_ag_gemm_workspace(ctx, M // n_dev, K, jnp.bfloat16,
+                                    axis="x")
+           if n_dev == 1 and N == K else None)
 
     best_s = float("inf")
     for cfg in configs:
@@ -150,19 +138,46 @@ def bench_ag_gemm(ctx, n_dev: int, M: int, N: int, K: int, configs,
             continue
         try:
             if n_dev == 1 and N == K:
-                # output [M, N] matches input a [M, K]: self-chains, which
-                # gives the tightest dispatch-free timing
-                step = lambda x, y, c=cfg: ag_gemm(
-                    ctx, x, y, axis="x", cfg=c, out_dtype=jnp.bfloat16)
-                timer = make_chain_timer(step, a_s, b_s)
+                # output [M, N] matches input a [M, K]: self-chains as a
+                # scan with (activation, workspace) carry — the tightest
+                # dispatch-free timing, buffers reused in place by XLA
+                cache = {}
+
+                def timer(iters: int, c=cfg):
+                    if iters not in cache:
+                        def chain(a, b, ws):
+                            def body(carry, _):
+                                x, w = carry
+                                y, w = ag_gemm_ws(ctx, x, b, w, axis="x",
+                                                  cfg=c,
+                                                  out_dtype=jnp.bfloat16)
+                                return (y * jnp.asarray(0.01, y.dtype), w), None
+                            (y, _), _ = lax.scan(body, (a, ws), None,
+                                                 length=iters)
+                            return jnp.sum(y.astype(jnp.float32))
+                        cache[iters] = jax.jit(chain)
+                    return float(cache[iters](a_s, b_s, ws0))
+
                 best_s = min(best_s, _per_iter(timer, i1, i2))
             else:
-                f = jax.jit(lambda a, b, c=cfg: ag_gemm(
-                    ctx, a, b, axis="x", cfg=c, out_dtype=jnp.bfloat16))
-                timer = make_calls_timer(f, (a_s, b_s))
-                # in-flight bytes/call: the [M, N/n] out + the discarded
-                # [n, M/n, K] workspace output (until workspaces persist)
-                per_call = 2 * (M * (N // n_dev) + M * K)
+                f = jax.jit(lambda w, a, b, c=cfg: ag_gemm_ws(
+                    ctx, a, b, w, axis="x", cfg=c, out_dtype=jnp.bfloat16),
+                    donate_argnums=(0,))
+                # fresh workspace per config: donation consumes the buffer,
+                # so ws0 can't be re-donated for a second config
+                ws = create_ag_gemm_workspace(ctx, M // n_dev, K,
+                                              jnp.bfloat16, axis="x")
+
+                def timer(iters: int):
+                    nonlocal ws
+                    out = None
+                    for _ in range(iters):
+                        out, ws = f(ws, a_s, b_s)
+                    return float(jnp.sum(out.astype(jnp.float32)))
+
+                # in-flight bytes/call: just the [M, N/n] output (the
+                # workspace is donated in place)
+                per_call = 2 * M * (N // n_dev)
                 best_s = min(best_s, _per_iter(timer,
                                                *calls_iters(per_call, i1, i2)))
         except Exception:

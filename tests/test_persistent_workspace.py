@@ -1,0 +1,148 @@
+"""Persistent symmetric workspaces for the overlap ops.
+
+Parity target: the reference creates symm workspaces ONCE per context and
+reuses them across calls (create_ag_gemm_intra_node_context,
+allgather_gemm.py:785-832; create_gemm_rs_context,
+gemm_reduce_scatter.py:77-87) instead of allocating per call. Here the
+workspace is an explicit aliased operand (functional-state idiom) with
+donation, or a stateful *Context object for eager callers.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from conftest import TEST_WORLD
+from triton_dist_tpu.ops.allgather_gemm import (ag_gemm_ws,
+                                                create_ag_gemm_context,
+                                                create_ag_gemm_workspace)
+from triton_dist_tpu.ops.gemm import GemmConfig
+from triton_dist_tpu.ops.gemm_reduce_scatter import (create_gemm_rs_context,
+                                                     create_gemm_rs_workspace,
+                                                     gemm_rs_ws)
+from triton_dist_tpu.shmem.context import initialize_distributed
+from triton_dist_tpu.utils import assert_allclose
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return initialize_distributed(axis_names=("x",), mesh_shape=(TEST_WORLD,))
+
+
+def test_ag_gemm_ws_donated_repeated(ctx):
+    n = ctx.num_ranks
+    M = K = 16 * n
+    N = 128 * n
+    cfg = GemmConfig(M // n, 128)
+    ws = create_ag_gemm_workspace(ctx, M // n, K, jnp.float32, axis="x")
+    f = jax.jit(lambda w, u, v: ag_gemm_ws(ctx, u, v, w, axis="x", cfg=cfg),
+                donate_argnums=(0,))
+    for it in range(3):
+        a = jax.random.normal(jax.random.key(it), (M, K), jnp.float32)
+        b = jax.random.normal(jax.random.key(100 + it), (K, N), jnp.float32)
+        c, ws = f(ws, ctx.shard(a, P("x")), ctx.shard(b, P(None, "x")))
+        assert_allclose(np.asarray(c), np.asarray(a @ b), rtol=1e-4,
+                        atol=1e-3)
+
+
+def test_ag_gemm_ws_in_scan(ctx):
+    """The workspace threads through lax.scan as carry — the jit-composable
+    form the chain-timing bench uses."""
+    n = ctx.num_ranks
+    # M == N == K for self-chaining; 128 divides evenly for any TEST_WORLD
+    M = K = N = 128
+    cfg = GemmConfig(M // n, N // n)
+    ws = create_ag_gemm_workspace(ctx, M // n, K, jnp.float32, axis="x")
+    a = jax.random.normal(jax.random.key(0), (M, K), jnp.float32)
+    b = jnp.eye(K, N, dtype=jnp.float32) * 0.5
+    a_s, b_s = ctx.shard(a, P("x")), ctx.shard(b, P(None, "x"))
+
+    @jax.jit
+    def chain(a0, b, ws):
+        def body(carry, _):
+            x, w = carry
+            c, w = ag_gemm_ws(ctx, x, b, w, axis="x", cfg=cfg)
+            return (c, w), None
+        (c, ws), _ = jax.lax.scan(body, (a0, ws), None, length=3)
+        return c, ws
+
+    c, _ = chain(a_s, b_s, ws)
+    assert_allclose(np.asarray(c), np.asarray(a) * 0.5 ** 3, rtol=1e-4,
+                    atol=1e-4)
+
+
+def test_ag_gemm_context_stateful(ctx):
+    n = ctx.num_ranks
+    M = K = 16 * n
+    N = 128 * n
+    cfg = GemmConfig(M // n, 128)
+    agc = create_ag_gemm_context(ctx, M // n, K, jnp.float32, axis="x")
+    for it in range(3):
+        a = jax.random.normal(jax.random.key(it), (M, K), jnp.float32)
+        b = jax.random.normal(jax.random.key(50 + it), (K, N), jnp.float32)
+        c = agc(ctx.shard(a, P("x")), ctx.shard(b, P(None, "x")), cfg=cfg)
+        assert_allclose(np.asarray(c), np.asarray(a @ b), rtol=1e-4,
+                        atol=1e-3)
+
+
+def test_ag_gemm_context_rejects_outer_jit(ctx):
+    n = ctx.num_ranks
+    M = K = 16 * n
+    agc = create_ag_gemm_context(ctx, M // n, K, jnp.float32, axis="x")
+    with pytest.raises(AssertionError, match="must not be called under"):
+        jax.jit(lambda a, b: agc(a, b))(
+            jnp.zeros((M, K)), jnp.zeros((K, 128 * n)))
+
+
+def test_gemm_rs_ws_donated_repeated(ctx):
+    n = ctx.num_ranks
+    M, K, N = n * 32, n * 32, 64
+    cfg = GemmConfig(32, 32)
+    ws, stage = create_gemm_rs_workspace(ctx, M // n, N, jnp.float32,
+                                         axis="x")
+    f = jax.jit(lambda w, s, u, v: gemm_rs_ws(ctx, u, v, w, s, axis="x",
+                                              cfg=cfg),
+                donate_argnums=(0, 1))
+
+    def golden(a, b):
+        def g(a_shard, b_shard):
+            part = jnp.dot(a_shard, b_shard,
+                           preferred_element_type=jnp.float32)
+            return jax.lax.psum_scatter(part, "x", scatter_dimension=0,
+                                        tiled=True)
+        return jax.jit(ctx.shard_map(g, in_specs=(P(None, "x"), P("x", None)),
+                                     out_specs=P("x")))(a, b)
+
+    for it in range(3):
+        a = ctx.shard(jax.random.normal(jax.random.key(it), (M, K)),
+                      P(None, "x"))
+        b = ctx.shard(jax.random.normal(jax.random.key(70 + it), (K, N)),
+                      P("x", None))
+        c, ws, stage = f(ws, stage, a, b)
+        assert_allclose(np.asarray(c), np.asarray(golden(a, b)), rtol=1e-4,
+                        atol=1e-4)
+
+
+def test_gemm_rs_context_stateful(ctx):
+    n = ctx.num_ranks
+    M, K, N = n * 32, n * 32, 64
+    cfg = GemmConfig(32, 32)
+    rsc = create_gemm_rs_context(ctx, M // n, N, jnp.float32, axis="x")
+    for it in range(2):
+        a_h = jax.random.normal(jax.random.key(it), (M, K))
+        b_h = jax.random.normal(jax.random.key(90 + it), (K, N))
+        a = ctx.shard(a_h, P(None, "x"))
+        b = ctx.shard(b_h, P("x", None))
+        c = rsc(a, b, cfg=cfg)
+
+        def g(a_shard, b_shard):
+            part = jnp.dot(a_shard, b_shard,
+                           preferred_element_type=jnp.float32)
+            return jax.lax.psum_scatter(part, "x", scatter_dimension=0,
+                                        tiled=True)
+        gold = jax.jit(ctx.shard_map(g, in_specs=(P(None, "x"), P("x", None)),
+                                     out_specs=P("x")))(a, b)
+        assert_allclose(np.asarray(c), np.asarray(gold), rtol=1e-4,
+                        atol=1e-4)

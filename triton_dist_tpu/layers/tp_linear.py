@@ -1,7 +1,14 @@
 """Tensor-parallel linear layers over the overlap kernels — the module-level
 API the reference exposes through tutorials 07/08 (AG-GEMM forward,
 GEMM-RS forward) rather than as classes; provided as first-class layers
-here."""
+here.
+
+With ``persistent=True`` a layer owns reusable symmetric workspaces (the
+reference's create-context-once pattern, allgather_gemm.py:785-832) and must
+be called eagerly — each call is internally jitted with workspace donation.
+The default (non-persistent) form is freely jit-composable but allocates a
+fresh workspace per call.
+"""
 
 from __future__ import annotations
 
@@ -9,13 +16,16 @@ import dataclasses
 
 import jax
 
-from triton_dist_tpu.ops.allgather_gemm import ag_gemm
+from triton_dist_tpu.ops.allgather_gemm import (AgGemmContext, ag_gemm,
+                                                create_ag_gemm_context)
 from triton_dist_tpu.ops.gemm import GemmConfig
-from triton_dist_tpu.ops.gemm_reduce_scatter import gemm_rs
+from triton_dist_tpu.ops.gemm_reduce_scatter import (GemmRsContext,
+                                                     create_gemm_rs_context,
+                                                     gemm_rs)
 from triton_dist_tpu.shmem.context import ShmemContext
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass
 class ColumnParallelLinear:
     """y = all_gather(x) @ W with W column-sharded — the Megatron-style
     first TP linear, computed by the AG-GEMM overlap kernel
@@ -23,13 +33,23 @@ class ColumnParallelLinear:
     ctx: ShmemContext
     axis: str | None = None
     cfg: GemmConfig | None = None
+    persistent: bool = False
+    _ag_ctxs: dict = dataclasses.field(default_factory=dict)
 
     def __call__(self, x: jax.Array, w: jax.Array, out_dtype=None):
+        if self.persistent:
+            n = self.ctx.axis_size(self.axis or self.ctx.axis_names[0])
+            key = (x.shape[0] // n, x.shape[1], str(x.dtype))
+            agc = self._ag_ctxs.get(key)
+            if agc is None:
+                agc = self._ag_ctxs[key] = create_ag_gemm_context(
+                    self.ctx, key[0], key[1], x.dtype, axis=self.axis)
+            return agc(x, w, cfg=self.cfg, out_dtype=out_dtype)
         return ag_gemm(self.ctx, x, w, axis=self.axis, cfg=self.cfg,
                        out_dtype=out_dtype)
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass
 class RowParallelLinear:
     """y = reduce_scatter(x @ W) with W row-sharded — the second TP linear,
     computed by the GEMM-RS overlap kernel
@@ -37,7 +57,18 @@ class RowParallelLinear:
     ctx: ShmemContext
     axis: str | None = None
     cfg: GemmConfig | None = None
+    persistent: bool = False
+    _rs_ctxs: dict = dataclasses.field(default_factory=dict)
 
     def __call__(self, x: jax.Array, w: jax.Array, out_dtype=None):
+        if self.persistent:
+            n = self.ctx.axis_size(self.axis or self.ctx.axis_names[0])
+            out_dt = out_dtype or x.dtype
+            key = (x.shape[0] // n, w.shape[1], str(out_dt))
+            rsc = self._rs_ctxs.get(key)
+            if rsc is None:
+                rsc = self._rs_ctxs[key] = create_gemm_rs_context(
+                    self.ctx, key[0], key[1], out_dt, axis=self.axis)
+            return rsc(x, w, cfg=self.cfg, out_dtype=out_dtype)
         return gemm_rs(self.ctx, x, w, axis=self.axis, cfg=self.cfg,
                        out_dtype=out_dtype)

@@ -29,6 +29,8 @@ barrier + MXU pipeline only, preserving full single-chip GEMM efficiency.
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -46,9 +48,10 @@ from triton_dist_tpu.utils import default_interpret
 def _ag_gemm_kernel(axis, mesh_axes, cfg, out_dtype,
                     a_ref, b_ref, out_ref, ws_ref,
                     send_sems, recv_sems):
-    # ws_ref is an HBM *output* used as the symmetric workspace (interpret
-    # mode does not allocate ANY-space scratch; an output works on both
-    # paths and is discarded by the host wrapper).
+    # ws_ref is the symmetric workspace: either a context-owned persistent
+    # buffer (aliased input→output, see ag_gemm_ws) or a discarded fresh
+    # HBM output (legacy jit-anywhere path; interpret mode cannot allocate
+    # ANY-space scratch, so an output covers both backends).
     me = shd.my_pe(axis)
     n = shd.n_pes(axis)
     m_local = a_ref.shape[0]
@@ -81,19 +84,8 @@ def _ag_gemm_kernel(axis, mesh_axes, cfg, out_dtype,
     shd.quiet(*rdmas)
 
 
-def ag_gemm(ctx: ShmemContext, a: jax.Array, b: jax.Array,
-            axis: str | None = None, cfg: GemmConfig | None = None,
-            out_dtype=None) -> jax.Array:
-    """Tensor-parallel AllGather-GEMM: ``a`` is [M, K] sharded P(axis) on M
-    (each rank holds [M/n, K]); ``b`` is [K, N] sharded P(None, axis) on N
-    (column-parallel weight). Returns C = all_gather(a) @ b — [M, N] sharded
-    P(None, axis). Entry analog: ``ag_gemm_intra_node``
-    (allgather_gemm.py:835-880); golden: all_gather + dot."""
-    axis = axis or ctx.axis_names[0]
-    cfg = cfg or GemmConfig()
-    out_dtype = out_dtype or a.dtype
+def _validate(ctx, a, b, axis, cfg):
     n = ctx.axis_size(axis)
-    mesh_axes = ctx.axis_names
     M, K = a.shape
     assert M % n == 0, f"M={M} not divisible by ranks {n}"
     m_local = M // n
@@ -101,36 +93,81 @@ def ag_gemm(ctx: ShmemContext, a: jax.Array, b: jax.Array,
         f"local M {m_local} not divisible by block_m {cfg.block_m}")
     assert cfg.vmem_ok(K, jnp.dtype(a.dtype).itemsize), (
         f"tile config exceeds VMEM budget for K={K}")
+    return n, M, K, m_local
 
-    def f(a_shard, b_shard):
-        kernel = lambda *refs: _ag_gemm_kernel(axis, mesh_axes, cfg,
-                                               out_dtype, *refs)
-        n_local = b_shard.shape[1]
-        flops = 2 * M * n_local * K
+
+def _pallas_ag_gemm(axis, mesh_axes, cfg, out_dtype, n, M, K, m_local,
+                    a_shard, b_shard, ws_shard=None):
+    """Shared pallas_call builder. With ``ws_shard`` the workspace is an
+    aliased input→output pair (persistent, zero per-call allocation);
+    without it the workspace is a fresh discarded output."""
+    n_local = b_shard.shape[1]
+    flops = 2 * M * n_local * K
+    common = dict(
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((n,)),
+            pltpu.SemaphoreType.DMA((n,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            collective_id=collective_id_for("ag_gemm")),
+        cost_estimate=pl.CostEstimate(
+            flops=flops,
+            bytes_accessed=(a_shard.size + b_shard.size + M * n_local)
+            * jnp.dtype(a_shard.dtype).itemsize,
+            transcendentals=0),
+        interpret=default_interpret(),
+    )
+    out_c = jax.ShapeDtypeStruct((M, n_local), out_dtype)
+    out_ws = jax.ShapeDtypeStruct((n, m_local, K), a_shard.dtype)
+    if ws_shard is None:
+        kernel = lambda a_r, b_r, c_r, ws_r, *sems: _ag_gemm_kernel(
+            axis, mesh_axes, cfg, out_dtype, a_r, b_r, c_r, ws_r, *sems)
         c, _ws = pl.pallas_call(
             kernel,
-            out_shape=(
-                jax.ShapeDtypeStruct((M, n_local), out_dtype),
-                jax.ShapeDtypeStruct((n, m_local, K), a_shard.dtype),  # symm ws
-            ),
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY),
-                      pl.BlockSpec(memory_space=pl.ANY)],
-            out_specs=(pl.BlockSpec(memory_space=pl.ANY),
-                       pl.BlockSpec(memory_space=pl.ANY)),
-            scratch_shapes=[
-                pltpu.SemaphoreType.DMA((n,)),
-                pltpu.SemaphoreType.DMA((n,)),
-            ],
-            compiler_params=pltpu.CompilerParams(
-                has_side_effects=True,
-                collective_id=collective_id_for("ag_gemm")),
-            cost_estimate=pl.CostEstimate(
-                flops=flops,
-                bytes_accessed=(a_shard.size + b_shard.size + M * n_local)
-                * jnp.dtype(a_shard.dtype).itemsize,
-                transcendentals=0),
-            interpret=default_interpret(),
+            out_shape=(out_c, out_ws),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 2,
+            **common,
         )(a_shard, b_shard)
+        return c, None
+    # persistent: ws is input 2 aliased to output 1 (same buffer; the
+    # kernel sees one ref for it — ws_in is consumed by the alias)
+    kernel = lambda a_r, b_r, ws_in, c_r, ws_r, *sems: _ag_gemm_kernel(
+        axis, mesh_axes, cfg, out_dtype, a_r, b_r, c_r, ws_r, *sems)
+    c, ws_out = pl.pallas_call(
+        kernel,
+        out_shape=(out_c, out_ws),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 2,
+        input_output_aliases={2: 1},
+        **common,
+    )(a_shard, b_shard, ws_shard)
+    return c, ws_out
+
+
+def ag_gemm(ctx: ShmemContext, a: jax.Array, b: jax.Array,
+            axis: str | None = None, cfg: GemmConfig | None = None,
+            out_dtype=None) -> jax.Array:
+    """Tensor-parallel AllGather-GEMM: ``a`` is [M, K] sharded P(axis) on M
+    (each rank holds [M/n, K]); ``b`` is [K, N] sharded P(None, axis) on N
+    (column-parallel weight). Returns C = all_gather(a) @ b — [M, N] sharded
+    P(None, axis). Entry analog: ``ag_gemm_intra_node``
+    (allgather_gemm.py:835-880); golden: all_gather + dot.
+
+    This form allocates a fresh [n, M/n, K] workspace per call (discarded).
+    For repeated calls, use ``ag_gemm_ws`` / ``AgGemmContext`` which reuse a
+    context-owned symmetric workspace (reference parity:
+    create_ag_gemm_intra_node_context, allgather_gemm.py:785-832)."""
+    axis = axis or ctx.axis_names[0]
+    cfg = cfg or GemmConfig()
+    out_dtype = out_dtype or a.dtype
+    mesh_axes = ctx.axis_names
+    n, M, K, m_local = _validate(ctx, a, b, axis, cfg)
+
+    def f(a_shard, b_shard):
+        c, _ = _pallas_ag_gemm(axis, mesh_axes, cfg, out_dtype, n, M, K,
+                               m_local, a_shard, b_shard)
         return c
 
     sm = ctx.shard_map(f, in_specs=(P(axis), P(None, axis)),
@@ -138,4 +175,85 @@ def ag_gemm(ctx: ShmemContext, a: jax.Array, b: jax.Array,
     return sm(a, b)
 
 
-__all__ = ["ag_gemm", "GemmConfig"]
+def ag_gemm_ws(ctx: ShmemContext, a: jax.Array, b: jax.Array, ws: jax.Array,
+               axis: str | None = None, cfg: GemmConfig | None = None,
+               out_dtype=None) -> tuple[jax.Array, jax.Array]:
+    """Workspace-threading AG-GEMM: like ``ag_gemm`` but the symmetric
+    workspace is an explicit operand, aliased in place and returned.
+    Functional-state idiom (like PRNG keys / optimizer state): jit with
+    ``donate_argnums`` on ``ws`` (or carry it through ``lax.scan``) and the
+    buffer is reused with zero per-call allocation. Create ``ws`` with
+    ``create_ag_gemm_workspace``."""
+    axis = axis or ctx.axis_names[0]
+    cfg = cfg or GemmConfig()
+    out_dtype = out_dtype or a.dtype
+    mesh_axes = ctx.axis_names
+    n, M, K, m_local = _validate(ctx, a, b, axis, cfg)
+    assert ws.shape == (n, n, m_local, K) and ws.dtype == a.dtype, (
+        f"workspace {ws.shape}/{ws.dtype} does not match "
+        f"({n}, {n}, {m_local}, {K})/{a.dtype} — create it with "
+        f"create_ag_gemm_workspace(ctx, m_local={m_local}, k={K}, ...)")
+
+    def f(a_shard, b_shard, ws_shard):
+        c, ws_out = _pallas_ag_gemm(
+            axis, mesh_axes, cfg, out_dtype, n, M, K, m_local,
+            a_shard, b_shard, ws_shard.reshape(n, m_local, K))
+        return c, ws_out.reshape(ws_shard.shape)
+
+    sm = ctx.shard_map(f, in_specs=(P(axis), P(None, axis), P(axis)),
+                       out_specs=(P(None, axis), P(axis)))
+    return sm(a, b, ws)
+
+
+def create_ag_gemm_workspace(ctx: ShmemContext, m_local: int, k: int,
+                             dtype=jnp.bfloat16,
+                             axis: str | None = None) -> jax.Array:
+    """Symmetric AG workspace: per-device [n, m_local, k] slots (one per
+    source rank), global [n, n, m_local, k] sharded P(axis). Analog of the
+    reference's per-context symm workspace tensor list
+    (create_ag_gemm_intra_node_context, allgather_gemm.py:785-832)."""
+    axis = axis or ctx.axis_names[0]
+    n = ctx.axis_size(axis)
+    return ctx.create_symm_tensor((n, m_local, k), dtype, axis=axis)
+
+
+@dataclasses.dataclass
+class AgGemmContext:
+    """Stateful sugar over ``ag_gemm_ws``: owns the symmetric workspace and
+    a per-shape cache of donated jitted steps, so eager callers get in-place
+    workspace reuse without threading state themselves. Do NOT wrap calls in
+    an outer ``jax.jit`` (each step is already jitted; under an outer trace
+    the state update would leak) — use ``ag_gemm_ws`` inside jit/scan.
+    """
+    ctx: ShmemContext
+    axis: str
+    ws: jax.Array
+    _steps: dict = dataclasses.field(default_factory=dict)
+
+    def __call__(self, a: jax.Array, b: jax.Array,
+                 cfg: GemmConfig | None = None, out_dtype=None) -> jax.Array:
+        from jax._src import core as jcore
+        assert jcore.trace_state_clean(), (
+            "AgGemmContext must not be called under jit/vmap tracing; "
+            "use ag_gemm_ws and thread the workspace explicitly")
+        key = (a.shape, b.shape, str(a.dtype), cfg, out_dtype)
+        if key not in self._steps:
+            self._steps[key] = jax.jit(
+                lambda ws, a, b: ag_gemm_ws(self.ctx, a, b, ws,
+                                            axis=self.axis, cfg=cfg,
+                                            out_dtype=out_dtype)[::-1],
+                donate_argnums=(0,))
+        self.ws, c = self._steps[key](self.ws, a, b)
+        return c
+
+
+def create_ag_gemm_context(ctx: ShmemContext, m_local: int, k: int,
+                           dtype=jnp.bfloat16,
+                           axis: str | None = None) -> AgGemmContext:
+    axis = axis or ctx.axis_names[0]
+    ws = create_ag_gemm_workspace(ctx, m_local, k, dtype, axis)
+    return AgGemmContext(ctx=ctx, axis=axis, ws=ws)
+
+
+__all__ = ["ag_gemm", "ag_gemm_ws", "create_ag_gemm_workspace",
+           "create_ag_gemm_context", "AgGemmContext", "GemmConfig"]

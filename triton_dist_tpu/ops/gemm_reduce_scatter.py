@@ -24,6 +24,8 @@ receive the M/n rows they own, summed over all ranks.
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -87,24 +89,13 @@ def _gemm_rs_kernel(axis, mesh_axes, cfg, acc_dtype,
     )(ws_ref, out_ref)
 
 
-def gemm_rs(ctx: ShmemContext, a: jax.Array, b: jax.Array,
-            axis: str | None = None, cfg: GemmConfig | None = None,
-            out_dtype=None) -> jax.Array:
-    """Row-parallel GEMM + ReduceScatter: ``a`` [M, K] sharded P(None, axis),
-    ``b`` [K, N] sharded P(axis, None). Returns sum_r(a_r @ b_r) scattered
-    over M — global [M, N] sharded P(axis). Entry analog: ``gemm_rs``
-    (gemm_reduce_scatter.py:524-538); golden: dot + psum_scatter."""
-    axis = axis or ctx.axis_names[0]
-    cfg = cfg or GemmConfig()
+def _validate(ctx, a, b, axis, cfg):
     n = ctx.axis_size(axis)
-    mesh_axes = ctx.axis_names
     M, K = a.shape
     Kb, N = b.shape
     assert K == Kb, f"A/B inner dims {K} vs {Kb}"
     assert M % n == 0, f"M={M} not divisible by ranks {n}"
     m_seg = M // n
-    out_dtype = out_dtype or a.dtype
-    acc_dtype = jnp.float32 if out_dtype == jnp.bfloat16 else out_dtype
     # clamp tiles to the segment, then require exact divisibility
     cfg = GemmConfig(block_m=min(cfg.block_m, m_seg),
                      block_n=min(cfg.block_n, N))
@@ -115,37 +106,81 @@ def gemm_rs(ctx: ShmemContext, a: jax.Array, b: jax.Array,
     k_local_g = K // n
     assert cfg.vmem_ok(k_local_g, jnp.dtype(a.dtype).itemsize), (
         f"tile config exceeds VMEM budget for K_local={k_local_g}")
+    return n, M, K, N, m_seg, cfg
 
-    def f(a_shard, b_shard):
-        kernel = lambda *refs: _gemm_rs_kernel(axis, mesh_axes, cfg,
-                                               acc_dtype, *refs)
-        k_local = a_shard.shape[1]
+
+def _pallas_gemm_rs(axis, mesh_axes, cfg, acc_dtype, out_dtype, n, M, N,
+                    m_seg, a_shard, b_shard, ws_shard=None, stage_shard=None):
+    """Shared pallas_call builder: fresh workspace outputs (legacy), or
+    persistent aliased workspace+stage buffers when provided."""
+    k_local = a_shard.shape[1]
+    common = dict(
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((n,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            collective_id=collective_id_for("gemm_rs")),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * M * N * k_local,
+            bytes_accessed=(a_shard.size + b_shard.size + m_seg * N)
+            * jnp.dtype(a_shard.dtype).itemsize,
+            transcendentals=0),
+        interpret=default_interpret(),
+    )
+    out_shapes = (
+        jax.ShapeDtypeStruct((m_seg, N), out_dtype),
+        jax.ShapeDtypeStruct((n, m_seg, N), acc_dtype),   # symm slots
+        jax.ShapeDtypeStruct((2, m_seg, N), acc_dtype),   # send stage
+    )
+    if ws_shard is None:
+        kernel = lambda a_r, b_r, o_r, ws_r, st_r, *sems: _gemm_rs_kernel(
+            axis, mesh_axes, cfg, acc_dtype, a_r, b_r, o_r, ws_r, st_r, *sems)
         out, _ws, _stage = pl.pallas_call(
             kernel,
-            out_shape=(
-                jax.ShapeDtypeStruct((m_seg, N), out_dtype),
-                jax.ShapeDtypeStruct((n, m_seg, N), acc_dtype),   # symm slots
-                jax.ShapeDtypeStruct((2, m_seg, N), acc_dtype),   # send stage
-            ),
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY),
-                      pl.BlockSpec(memory_space=pl.ANY)],
-            out_specs=(pl.BlockSpec(memory_space=pl.ANY),
-                       pl.BlockSpec(memory_space=pl.ANY),
-                       pl.BlockSpec(memory_space=pl.ANY)),
-            scratch_shapes=[
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((n,)),
-            ],
-            compiler_params=pltpu.CompilerParams(
-                has_side_effects=True,
-                collective_id=collective_id_for("gemm_rs")),
-            cost_estimate=pl.CostEstimate(
-                flops=2 * M * N * k_local,
-                bytes_accessed=(a_shard.size + b_shard.size + m_seg * N)
-                * jnp.dtype(a_shard.dtype).itemsize,
-                transcendentals=0),
-            interpret=default_interpret(),
+            out_shape=out_shapes,
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 3,
+            **common,
         )(a_shard, b_shard)
+        return out, None, None
+    kernel = lambda a_r, b_r, ws_in, st_in, o_r, ws_r, st_r, *sems: \
+        _gemm_rs_kernel(axis, mesh_axes, cfg, acc_dtype,
+                        a_r, b_r, o_r, ws_r, st_r, *sems)
+    out, ws_out, stage_out = pl.pallas_call(
+        kernel,
+        out_shape=out_shapes,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 3,
+        input_output_aliases={2: 1, 3: 2},
+        **common,
+    )(a_shard, b_shard, ws_shard, stage_shard)
+    return out, ws_out, stage_out
+
+
+def gemm_rs(ctx: ShmemContext, a: jax.Array, b: jax.Array,
+            axis: str | None = None, cfg: GemmConfig | None = None,
+            out_dtype=None) -> jax.Array:
+    """Row-parallel GEMM + ReduceScatter: ``a`` [M, K] sharded P(None, axis),
+    ``b`` [K, N] sharded P(axis, None). Returns sum_r(a_r @ b_r) scattered
+    over M — global [M, N] sharded P(axis). Entry analog: ``gemm_rs``
+    (gemm_reduce_scatter.py:524-538); golden: dot + psum_scatter.
+
+    Allocates fresh workspace/stage buffers per call; for repeated calls use
+    ``gemm_rs_ws`` / ``GemmRsContext`` (reference parity:
+    create_gemm_rs_context, gemm_reduce_scatter.py:77-87)."""
+    axis = axis or ctx.axis_names[0]
+    cfg = cfg or GemmConfig()
+    out_dtype = out_dtype or a.dtype
+    acc_dtype = jnp.float32 if out_dtype == jnp.bfloat16 else out_dtype
+    mesh_axes = ctx.axis_names
+    n, M, K, N, m_seg, cfg = _validate(ctx, a, b, axis, cfg)
+
+    def f(a_shard, b_shard):
+        out, _, _ = _pallas_gemm_rs(axis, mesh_axes, cfg, acc_dtype,
+                                    out_dtype, n, M, N, m_seg,
+                                    a_shard, b_shard)
         return out
 
     sm = ctx.shard_map(f, in_specs=(P(None, axis), P(axis, None)),
@@ -153,4 +188,87 @@ def gemm_rs(ctx: ShmemContext, a: jax.Array, b: jax.Array,
     return sm(a, b)
 
 
-__all__ = ["gemm_rs"]
+def gemm_rs_ws(ctx: ShmemContext, a: jax.Array, b: jax.Array,
+               ws: jax.Array, stage: jax.Array,
+               axis: str | None = None, cfg: GemmConfig | None = None,
+               out_dtype=None) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Workspace-threading GEMM-RS: symmetric slots + send stage are explicit
+    aliased operands, returned for re-threading. Jit with ``donate_argnums``
+    on both (or carry through ``lax.scan``) for zero per-call allocation.
+    Create them with ``create_gemm_rs_workspace``."""
+    axis = axis or ctx.axis_names[0]
+    cfg = cfg or GemmConfig()
+    out_dtype = out_dtype or a.dtype
+    acc_dtype = jnp.float32 if out_dtype == jnp.bfloat16 else out_dtype
+    mesh_axes = ctx.axis_names
+    n, M, K, N, m_seg, cfg = _validate(ctx, a, b, axis, cfg)
+    assert ws.shape == (n, n, m_seg, N) and ws.dtype == acc_dtype, (
+        f"ws {ws.shape}/{ws.dtype} != ({n}, {n}, {m_seg}, {N})/{acc_dtype}")
+    assert stage.shape == (n, 2, m_seg, N) and stage.dtype == acc_dtype, (
+        f"stage {stage.shape}/{stage.dtype} != ({n}, 2, {m_seg}, {N})/"
+        f"{acc_dtype}")
+
+    def f(a_shard, b_shard, ws_shard, stage_shard):
+        out, ws_out, stage_out = _pallas_gemm_rs(
+            axis, mesh_axes, cfg, acc_dtype, out_dtype, n, M, N, m_seg,
+            a_shard, b_shard, ws_shard.reshape(n, m_seg, N),
+            stage_shard.reshape(2, m_seg, N))
+        return (out, ws_out.reshape(ws_shard.shape),
+                stage_out.reshape(stage_shard.shape))
+
+    sm = ctx.shard_map(
+        f, in_specs=(P(None, axis), P(axis, None), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P(axis)))
+    return sm(a, b, ws, stage)
+
+
+def create_gemm_rs_workspace(ctx: ShmemContext, m_seg: int, n_cols: int,
+                             out_dtype=jnp.bfloat16,
+                             axis: str | None = None
+                             ) -> tuple[jax.Array, jax.Array]:
+    """(symm partial slots, send stage) for ``gemm_rs_ws``; dtypes follow the
+    accumulator rule (f32 for bf16 outputs)."""
+    axis = axis or ctx.axis_names[0]
+    n = ctx.axis_size(axis)
+    acc_dtype = jnp.float32 if out_dtype == jnp.bfloat16 else out_dtype
+    ws = ctx.create_symm_tensor((n, m_seg, n_cols), acc_dtype, axis=axis)
+    stage = ctx.create_symm_tensor((2, m_seg, n_cols), acc_dtype, axis=axis)
+    return ws, stage
+
+
+@dataclasses.dataclass
+class GemmRsContext:
+    """Stateful sugar over ``gemm_rs_ws`` — see ``AgGemmContext``."""
+    ctx: ShmemContext
+    axis: str
+    ws: jax.Array
+    stage: jax.Array
+    _steps: dict = dataclasses.field(default_factory=dict)
+
+    def __call__(self, a: jax.Array, b: jax.Array,
+                 cfg: GemmConfig | None = None, out_dtype=None) -> jax.Array:
+        from jax._src import core as jcore
+        assert jcore.trace_state_clean(), (
+            "GemmRsContext must not be called under jit/vmap tracing; "
+            "use gemm_rs_ws and thread the workspace explicitly")
+        key = (a.shape, b.shape, str(a.dtype), cfg, out_dtype)
+        if key not in self._steps:
+            self._steps[key] = jax.jit(
+                lambda ws, stage, a, b: gemm_rs_ws(
+                    self.ctx, a, b, ws, stage, axis=self.axis, cfg=cfg,
+                    out_dtype=out_dtype),
+                donate_argnums=(0, 1))
+        c, self.ws, self.stage = self._steps[key](self.ws, self.stage, a, b)
+        return c
+
+
+def create_gemm_rs_context(ctx: ShmemContext, m_seg: int, n_cols: int,
+                           out_dtype=jnp.bfloat16,
+                           axis: str | None = None) -> GemmRsContext:
+    axis = axis or ctx.axis_names[0]
+    ws, stage = create_gemm_rs_workspace(ctx, m_seg, n_cols, out_dtype, axis)
+    return GemmRsContext(ctx=ctx, axis=axis, ws=ws, stage=stage)
+
+
+__all__ = ["gemm_rs", "gemm_rs_ws", "create_gemm_rs_workspace",
+           "create_gemm_rs_context", "GemmRsContext"]
