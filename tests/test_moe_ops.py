@@ -72,6 +72,24 @@ def test_ag_moe_group_gemm(ctx):
     assert_allclose(np.asarray(out), golden, atol=1e-3, rtol=1e-3)
 
 
+def test_moe_reduce_rs_ragged_n(ctx):
+    """N=192 is not a multiple of the 128-lane tile — the reduction and the
+    grouped pipeline must fall back to a divisor, not drop columns."""
+    n = ctx.num_ranks
+    E, K, N, T, topk = 4, n * 32, 192, n * 8, 2
+    tokens = jax.random.normal(jax.random.key(0), (T * topk, K), jnp.float32)
+    ids = jax.random.randint(jax.random.key(1), (T * topk,), 0, E)
+    tw = jax.nn.softmax(jax.random.normal(jax.random.key(2), (T, topk)), -1)
+    weights = jax.random.normal(jax.random.key(3), (E, K, N), jnp.float32) * 0.1
+    out = jax.jit(lambda t, i, w, ww: moe_reduce_rs(
+        ctx, ctx.shard(t, P(None, "x")), i, ww,
+        ctx.shard(w, P(None, "x", None)), block_m=16))(tokens, ids, weights, tw)
+    t, idn, wn = np.asarray(tokens), np.asarray(ids), np.asarray(weights)
+    rows = np.stack([t[r] @ wn[idn[r]] for r in range(T * topk)])
+    golden = (rows.reshape(T, topk, N) * np.asarray(tw)[..., None]).sum(axis=1)
+    assert_allclose(np.asarray(out), golden, atol=1e-3, rtol=1e-3)
+
+
 def test_moe_reduce_rs(ctx):
     n = ctx.num_ranks
     E, K, N, T, topk = 4, n * 32, 64, n * 8, 2

@@ -45,24 +45,25 @@ from triton_dist_tpu.shmem.context import ShmemContext
 from triton_dist_tpu.utils import default_interpret
 
 
-def _ag_gemm_kernel(axis, mesh_axes, cfg, out_dtype,
-                    a_ref, b_ref, out_ref, ws_ref,
-                    send_sems, recv_sems):
-    # ws_ref is the symmetric workspace: either a context-owned persistent
-    # buffer (aliased input→output, see ag_gemm_ws) or a discarded fresh
-    # HBM output (legacy jit-anywhere path; interpret mode cannot allocate
-    # ANY-space scratch, so an output covers both backends).
+def ag_overlap_protocol(axis, mesh_axes, a_ref, ws_ref, send_sems, recv_sems,
+                        emit):
+    """The shared AllGather-overlap kernel protocol (one copy — AG-GEMM and
+    the fused MoE AG-GroupGEMM both run it):
+
+    1. Entry barrier: nobody puts into a peer's workspace before that peer
+       has entered this call (workspace slots + semaphores are reused).
+    2. Producer: non-blocking puts of ``a_ref`` into every peer's ws slot
+       ``me``; our own segment never touches the workspace.
+    3. Consumer: swizzled start-local segment loop — s=0 is statically the
+       local segment, fed by ``a_ref`` with zero wait; each remote segment
+       is waited once, then ``emit(src_ref, seg)`` computes on it.
+    4. Quiet: drain our outstanding sends.
+    """
     me = shd.my_pe(axis)
     n = shd.n_pes(axis)
-    m_local = a_ref.shape[0]
-
-    # entry barrier: nobody puts into a peer's workspace before that peer
-    # has entered this call (workspace slots are reused across calls)
     shd.barrier_all(axis if isinstance(axis, tuple) else (axis,),
                     mesh_axes=mesh_axes)
 
-    # producer phase: puts to every peer (non-blocking); our own segment
-    # never touches the workspace (consumed straight from a_ref below)
     rdmas = []
     for p in range(1, n):
         dst = lax.rem(me + p, n)
@@ -70,18 +71,30 @@ def _ag_gemm_kernel(axis, mesh_axes, cfg, out_dtype,
         rdmas.append(shd.putmem_nbi(ws_ref.at[me], a_ref,
                                     send_sems.at[dst], recv_sems.at[me], pid))
 
-    # consumer phase: swizzled segment loop — s=0 is statically the local
-    # segment (seg == me), fed by a_ref with zero wait
-    emit_gemm(a_ref, b_ref, out_ref.at[pl.ds(me * m_local, m_local)], cfg,
-              out_dtype)
+    emit(a_ref, me)
     for s in range(1, n):
         seg = lax.rem(me + s, n)
         shd.wait_recv(ws_ref.at[seg], recv_sems.at[seg])
-        emit_gemm(ws_ref.at[seg], b_ref,
-                  out_ref.at[pl.ds(seg * m_local, m_local)], cfg,
-                  out_dtype)
+        emit(ws_ref.at[seg], seg)
 
     shd.quiet(*rdmas)
+
+
+def _ag_gemm_kernel(axis, mesh_axes, cfg, out_dtype,
+                    a_ref, b_ref, out_ref, ws_ref,
+                    send_sems, recv_sems):
+    # ws_ref is the symmetric workspace: either a context-owned persistent
+    # buffer (aliased input→output, see ag_gemm_ws) or a discarded fresh
+    # HBM output (legacy jit-anywhere path; interpret mode cannot allocate
+    # ANY-space scratch, so an output covers both backends).
+    m_local = a_ref.shape[0]
+
+    def emit(src_ref, seg):
+        emit_gemm(src_ref, b_ref, out_ref.at[pl.ds(seg * m_local, m_local)],
+                  cfg, out_dtype)
+
+    ag_overlap_protocol(axis, mesh_axes, a_ref, ws_ref, send_sems, recv_sems,
+                        emit)
 
 
 def _validate(ctx, a, b, axis, cfg):

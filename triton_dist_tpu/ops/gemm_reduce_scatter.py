@@ -40,13 +40,24 @@ from triton_dist_tpu.shmem.context import ShmemContext
 from triton_dist_tpu.utils import default_interpret
 
 
-def _gemm_rs_kernel(axis, mesh_axes, cfg, acc_dtype,
-                    a_ref, b_ref, out_ref, ws_ref, stage_ref,
-                    send_sems, recv_sems):
+def rs_overlap_protocol(axis, mesh_axes, ws_ref, stage_ref,
+                        send_sems, recv_sems, emit):
+    """The shared GEMM-ReduceScatter producer protocol (one copy — GEMM-RS
+    and the fused MoE GroupGEMM-RS both run it):
+
+    1. Entry barrier (slots + semaphores are reused across calls).
+    2. Own-segment-last swizzle: for each remote segment,
+       ``emit(seg, dst_ref)`` computes that segment's partial into a
+       double-buffered stage slot (reused every 2 steps, guarded by the
+       send semaphore of the put issued 2 steps earlier), then a
+       non-blocking put ships it to the owner's symm slot ``me``.
+    3. Own segment: emitted straight into our own slot (never travels).
+    4. Drain the last sends, wait each peer's arrival once.
+
+    The caller runs its reduction over ``ws_ref``'s n slots afterwards.
+    """
     me = shd.my_pe(axis)
     n = shd.n_pes(axis)
-    m_seg = out_ref.shape[0]
-
     shd.barrier_all((axis,), mesh_axes=mesh_axes)
 
     rdmas = [None] * max(n - 1, 0)
@@ -55,15 +66,12 @@ def _gemm_rs_kernel(axis, mesh_axes, cfg, acc_dtype,
         slot = s % 2
         if s >= 2:
             rdmas[s - 2].wait_send()  # stage slot free again
-        emit_gemm(a_ref.at[pl.ds(seg * m_seg, m_seg)], b_ref,
-                  stage_ref.at[slot], cfg, acc_dtype)
+        emit(seg, stage_ref.at[slot])
         pid = shd.pe_at(mesh_axes, axis, seg)
         rdmas[s] = shd.putmem_nbi(ws_ref.at[me], stage_ref.at[slot],
                                   send_sems.at[slot], recv_sems.at[me], pid)
 
-    # own segment straight into our own slot
-    emit_gemm(a_ref.at[pl.ds(me * m_seg, m_seg)], b_ref,
-              ws_ref.at[me], cfg, acc_dtype)
+    emit(me, ws_ref.at[me])
 
     for s in range(max(n - 3, 0), n - 1):
         rdmas[s].wait_send()
@@ -71,10 +79,17 @@ def _gemm_rs_kernel(axis, mesh_axes, cfg, acc_dtype,
         src = lax.rem(me + p, n)
         shd.wait_recv(ws_ref.at[src], recv_sems.at[src])
 
-    # reduction over the n partial slots (VPU), pipelined over output tiles
-    bm = min(cfg.block_m, m_seg)
-    N = out_ref.shape[1]
-    bn = min(cfg.block_n, N)
+
+def emit_slot_reduction(ws_ref, out_ref, bm: int, bn: int):
+    """Pipelined VPU sum over ``ws_ref``'s [n, M, N] partial slots into
+    ``out_ref`` [M, N]. Tile sizes fall back to divisors of the actual
+    shape so ragged dims never silently drop rows/columns."""
+    import math
+
+    n = ws_ref.shape[0]
+    m_seg, N = out_ref.shape
+    bm = math.gcd(min(bm, m_seg), m_seg)
+    bn = math.gcd(min(bn, N), N)
 
     def body(ws_blk, o_blk):
         o_blk[...] = jnp.sum(
@@ -87,6 +102,20 @@ def _gemm_rs_kernel(axis, mesh_axes, cfg, acc_dtype,
         in_specs=[pl.BlockSpec((n, bm, bn), lambda i, j: (0, i, j))],
         out_specs=[pl.BlockSpec((bm, bn), lambda i, j: (i, j))],
     )(ws_ref, out_ref)
+
+
+def _gemm_rs_kernel(axis, mesh_axes, cfg, acc_dtype,
+                    a_ref, b_ref, out_ref, ws_ref, stage_ref,
+                    send_sems, recv_sems):
+    m_seg = out_ref.shape[0]
+
+    def emit(seg, dst_ref):
+        emit_gemm(a_ref.at[pl.ds(seg * m_seg, m_seg)], b_ref, dst_ref,
+                  cfg, acc_dtype)
+
+    rs_overlap_protocol(axis, mesh_axes, ws_ref, stage_ref,
+                        send_sems, recv_sems, emit)
+    emit_slot_reduction(ws_ref, out_ref, cfg.block_m, cfg.block_n)
 
 
 def _validate(ctx, a, b, axis, cfg):

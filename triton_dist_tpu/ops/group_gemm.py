@@ -66,6 +66,45 @@ def align_tokens_by_expert(ids: jax.Array, num_experts: int, block_m: int):
     return gather_idx, row_valid, block_expert
 
 
+def emit_grouped_gemm(t_ref, w_ref, o_ref, be_ref, base_blk,
+                      block_m: int, block_n: int, out_dtype=None):
+    """In-kernel pipelined grouped GEMM over HBM refs:
+    ``o[i*bm:(i+1)*bm] = t[i*bm:(i+1)*bm] @ w[be_ref[base_blk + i]]``.
+
+    ``be_ref`` is an SMEM int32 ref of per-block expert ids (flattened over
+    segments; ``base_blk`` offsets into it, may be a traced value). The
+    dynamic index_map streams each block's expert weight tile HBM→VMEM
+    double-buffered — the in-kernel form of ``grouped_gemm`` that the fused
+    MoE overlap kernels call per *arrived segment*, the TPU analog of the
+    reference's per-token-block ``dl.wait`` + grouped ``tl.dot``
+    (kernel_consumer_m_parallel_scatter_group_gemm,
+    allgather_group_gemm.py:229-316)."""
+    import math
+
+    P, H = t_ref.shape
+    E, H2, N = w_ref.shape
+    assert H == H2, (H, H2)
+    block_n = math.gcd(min(block_n, N), N)
+    assert P % block_m == 0, (P, block_m)
+    out_dtype = out_dtype or o_ref.dtype
+
+    def body(t_blk, w_blk, o_blk):
+        o_blk[...] = jnp.dot(t_blk[...], w_blk[0],
+                             preferred_element_type=jnp.float32
+                             ).astype(out_dtype)
+
+    pltpu.emit_pipeline(
+        body,
+        grid=(P // block_m, N // block_n),
+        in_specs=[
+            pl.BlockSpec((block_m, H), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, H, block_n),
+                         lambda i, j: (be_ref[base_blk + i], 0, j)),
+        ],
+        out_specs=[pl.BlockSpec((block_m, block_n), lambda i, j: (i, j))],
+    )(t_ref, w_ref, o_ref)
+
+
 def grouped_gemm(tokens: jax.Array, weights: jax.Array,
                  block_expert: jax.Array, block_m: int = 128,
                  block_n: int = 128, out_dtype=None) -> jax.Array:
@@ -150,5 +189,5 @@ def moe_ffn_local(tokens: jax.Array, ids: jax.Array, w_up: jax.Array,
     return apply_grouped(tokens, ids, E, ffn, block_m=block_m)
 
 
-__all__ = ["align_tokens_by_expert", "grouped_gemm", "apply_grouped",
-           "moe_ffn_local"]
+__all__ = ["align_tokens_by_expert", "emit_grouped_gemm", "grouped_gemm",
+           "apply_grouped", "moe_ffn_local"]

@@ -2,34 +2,99 @@
 python/triton_dist/kernels/nvidia/allgather_group_gemm.py and
 moe_reduce_rs.py).
 
-- ``ag_moe_group_gemm``: AllGather token shards (+ routing ids) across the TP
-  group, then grouped expert GEMM against the local N-shard of every expert's
-  up-weights — the reference's "AG + GroupGEMM" stage
-  (allgather_group_gemm.py:317-770). Gather and compute are Pallas kernels;
-  their fusion into a single arrival-driven kernel (per-segment waits like
-  ag_gemm) is the planned optimization.
-- ``moe_reduce_rs``: grouped expert GEMM on the K-shard, topk-weighted
-  per-token reduction, then ReduceScatter of the result — the reference's
-  "GroupGEMM + topk-reduce + RS" stage (moe_reduce_rs.py:365-1027).
+Both ops are single arrival-driven Pallas kernels — the collective and the
+grouped expert GEMM genuinely overlap, matching the reference's defining
+capability:
 
-Routing ids ride the wire as lane-aligned int32 blocks (cf. the splits
-transfer in low_latency_all_to_all.py:75-86).
+- ``ag_moe_group_gemm`` reuses the AG-GEMM skeleton (allgather_gemm.py
+  here): non-blocking puts of the local token block to every peer, then a
+  swizzled start-local segment walk where each remote segment is waited
+  once and immediately fed to the in-kernel grouped GEMM
+  (``emit_grouped_gemm``). Reference:
+  kernel_consumer_m_parallel_scatter_group_gemm
+  (allgather_group_gemm.py:229-316) waits per token-block; TPU grids are
+  sequential per core, so the per-*segment* wait is the same granularity
+  the hardware can exploit.
+- ``moe_reduce_rs`` reuses the GEMM-RS skeleton (gemm_reduce_scatter.py):
+  own-segment-last swizzle, per-segment grouped GEMM into a
+  double-buffered send stage, non-blocking put of each partial to its
+  owner, then a pipelined reduction over the n arrived partials.
+  Reference: producer grouped-GEMM scatter kernel + topk-reduce-RS
+  consumer (moe_reduce_rs.py:365-548).
+
+TPU-native routing design — *sender-side alignment*: each segment's tokens
+are sorted by expert and block-padded BEFORE they ride the wire, so every
+wire block is expert-pure and the consumer needs only a scalar-prefetch
+``block_expert`` table (no receiver-side row gather, which TPU DMA does
+poorly). Routing ids are allgathered first as a small lane-aligned int32
+wire (the reference distributes topk ids ahead of the fused kernel the same
+way, allgather_group_gemm.py:317-440); all alignment metadata is then
+recomputed identically on every rank from the gathered ids. For
+``moe_reduce_rs``, the topk fold commutes with the cross-rank sum, so the
+ring reduces *aligned* rows and the fold + unscramble run once at the end.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from triton_dist_tpu.ops.allgather import all_gather
-from triton_dist_tpu.ops.group_gemm import apply_grouped, grouped_gemm
-from triton_dist_tpu.ops.reduce_scatter import reduce_scatter
+from triton_dist_tpu.ops.allgather_gemm import ag_overlap_protocol
+from triton_dist_tpu.ops.common import collective_id_for
+from triton_dist_tpu.ops.gemm_reduce_scatter import (emit_slot_reduction,
+                                                     rs_overlap_protocol)
+from triton_dist_tpu.ops.group_gemm import (align_tokens_by_expert,
+                                            emit_grouped_gemm)
 from triton_dist_tpu.shmem.context import ShmemContext
+from triton_dist_tpu.utils import default_interpret
 
 
 def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
+
+
+def _gather_ids(ctx: ShmemContext, ids: jax.Array, axis: str, t_local: int):
+    """AllGather routing ids as a lane-aligned int32 wire block; returns the
+    [n, t_local] gathered id matrix (replicated)."""
+    n = ctx.axis_size(axis)
+    pad = _round_up(t_local, 128) - t_local
+
+    def pack(ids_shard):
+        w = jnp.pad(ids_shard, (0, pad), constant_values=-1)
+        return w.reshape(-1, 128)
+
+    ids_wire = ctx.shard_map(pack, in_specs=P(axis), out_specs=P(axis))(ids)
+    g = all_gather(ctx, ids_wire, axis=axis, method="push")
+    return g.reshape(n, -1)[:, :t_local]
+
+
+def _segment_alignment(gids: jax.Array, num_experts: int, block_m: int):
+    """Per-segment sender-side alignment metadata from the gathered ids
+    [n, t_seg_rows] — identical on every rank by construction."""
+    return jax.vmap(
+        lambda i: align_tokens_by_expert(i, num_experts, block_m))(gids)
+
+
+# ---------------------------------------------------------------------------
+# AG + GroupGEMM (fused)
+# ---------------------------------------------------------------------------
+
+def _ag_moe_kernel(axis, mesh_axes, bm, bn, out_dtype, n_blocks,
+                   x_ref, w_ref, be_ref, out_ref, ws_ref,
+                   send_sems, recv_sems):
+    P_s = x_ref.shape[0]
+
+    def emit(src_ref, seg):
+        emit_grouped_gemm(src_ref, w_ref, out_ref.at[pl.ds(seg * P_s, P_s)],
+                          be_ref, seg * n_blocks, bm, bn, out_dtype)
+
+    ag_overlap_protocol(axis, mesh_axes, x_ref, ws_ref, send_sems, recv_sems,
+                        emit)
 
 
 def ag_moe_group_gemm(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
@@ -39,34 +104,92 @@ def ag_moe_group_gemm(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
     weights [E, H, N] sharded P(None, None, axis) (N column-parallel).
     Returns all ranks' tokens processed by their experts against the local
     weight shard: [T, N_local] per device → global [T, N] sharded
-    P(None, axis). Golden: all_gather + dense per-expert matmul."""
+    P(None, axis). Golden: all_gather + dense per-expert matmul.
+    Entry analog: ag_group_gemm_intra_node
+    (allgather_group_gemm.py:317-770)."""
     axis = axis or ctx.axis_names[0]
     n = ctx.axis_size(axis)
+    mesh_axes = ctx.axis_names
     T, H = tokens.shape
+    E = weights.shape[0]
     assert T % n == 0
     t_local = T // n
-    pad = _round_up(t_local, 128) - t_local
+    bm = block_m
+    P_s = _round_up(t_local, bm) + E * bm
+    n_blocks = P_s // bm
+    out_dtype = tokens.dtype
 
-    def pack(ids_shard):
-        w = jnp.pad(ids_shard, (0, pad), constant_values=-1)
-        return w.reshape(-1, 128)
+    gids = _gather_ids(ctx, ids, axis, t_local)               # [n, t_local]
+    gi, rv, be = _segment_alignment(gids, E, bm)              # [n, P_s] ×2, [n, n_blocks]
+    be_flat = be.reshape(-1)
 
-    ids_wire = ctx.shard_map(pack, in_specs=P(axis), out_specs=P(axis))(ids)
-    g_tokens = all_gather(ctx, tokens, axis=axis, method="ring")
-    g_ids_wire = all_gather(ctx, ids_wire, axis=axis, method="ring")
+    def f(tok_shard, gi_full, rv_full, be_full, w_shard):
+        me = lax.axis_index(axis)
+        # sender-side alignment of MY segment's tokens
+        gi_me = lax.dynamic_index_in_dim(gi_full, me, keepdims=False)
+        rv_me = lax.dynamic_index_in_dim(rv_full, me, keepdims=False)
+        x = tok_shard[gi_me] * rv_me[:, None].astype(tok_shard.dtype)
 
-    def compute(gt, gi, w_shard):
-        gids = gi.reshape(n, -1)[:, :t_local].reshape(-1)
-        E = w_shard.shape[0]
-        return apply_grouped(
-            gt, gids, E,
-            lambda x, be: grouped_gemm(x, w_shard, be, block_m=block_m),
-            block_m=block_m)
+        n_local = w_shard.shape[-1]
+        kernel = lambda *refs: _ag_moe_kernel(axis, mesh_axes, bm,
+                                              min(128, n_local), out_dtype,
+                                              n_blocks, *refs)
+        y, _ws = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((n * P_s, n_local), out_dtype),
+                jax.ShapeDtypeStruct((n, P_s, H), tok_shard.dtype),  # symm ws
+            ),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pltpu.SMEM)],
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pl.ANY)),
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA((n,)),
+                pltpu.SemaphoreType.DMA((n,)),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=collective_id_for("ag_moe")),
+            cost_estimate=pl.CostEstimate(
+                flops=2 * n * P_s * H * n_local,
+                bytes_accessed=(n * P_s * (H + n_local) + E * H * n_local)
+                * jnp.dtype(tok_shard.dtype).itemsize,
+                transcendentals=0),
+            interpret=default_interpret(),
+        )(x, w_shard, be_full)
 
-    sm = ctx.shard_map(compute,
-                       in_specs=(P(None, None), P(None, None), P(None, None, axis)),
-                       out_specs=P(None, axis))
-    return sm(g_tokens, g_ids_wire, weights)
+        # unscramble: aligned rows → original token order (invalid → drop)
+        dest = jnp.arange(n, dtype=jnp.int32)[:, None] * t_local + gi_full
+        dest = jnp.where(rv_full, dest, T).reshape(-1)
+        valid = rv_full.reshape(-1)[:, None].astype(y.dtype)
+        return jnp.zeros((T, n_local), y.dtype).at[dest].add(
+            y * valid, mode="drop")
+
+    sm = ctx.shard_map(
+        f, in_specs=(P(axis), P(None, None), P(None, None), P(None),
+                     P(None, None, axis)),
+        out_specs=P(None, axis))
+    return sm(tokens, gi, rv, be_flat, weights)
+
+
+# ---------------------------------------------------------------------------
+# GroupGEMM + topk-reduce + RS (fused)
+# ---------------------------------------------------------------------------
+
+def _moe_rs_kernel(axis, mesh_axes, bm, bn, n_blocks,
+                   x_ref, w_ref, be_ref, out_ref, ws_ref, stage_ref,
+                   send_sems, recv_sems):
+    P_seg = out_ref.shape[0]
+
+    def emit(seg, dst_ref):
+        emit_grouped_gemm(x_ref.at[pl.ds(seg * P_seg, P_seg)], w_ref,
+                          dst_ref, be_ref, seg * n_blocks, bm, bn)
+
+    rs_overlap_protocol(axis, mesh_axes, ws_ref, stage_ref,
+                        send_sems, recv_sems, emit)
+    emit_slot_reduction(ws_ref, out_ref, bm, bn)
 
 
 def moe_reduce_rs(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
@@ -74,35 +197,87 @@ def moe_reduce_rs(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
                   axis: str | None = None, block_m: int = 128) -> jax.Array:
     """Second MoE-TP stage: ``tokens`` [T*topk, K] sharded P(None, axis) on K
     (the up-projection's activations, one row per (token, k) pair);
-    ``ids`` [T*topk] global expert of each row; ``topk_weights`` [T, topk];
-    ``weights`` [E, K, N] sharded P(None, axis, None). Computes the grouped
-    down-GEMM partial on each rank, folds topk rows into per-token rows
-    (weighted sum), then ReduceScatters token rows across the group →
-    [T, N] sharded P(axis). Golden: dense compute + psum_scatter
+    ``ids`` [T*topk] global expert of each row (replicated);
+    ``topk_weights`` [T, topk]; ``weights`` [E, K, N] sharded
+    P(None, axis, None). Computes the grouped down-GEMM partial per output
+    segment, ring-scatters partials to their owners overlapped with compute,
+    reduces, then folds topk rows into per-token rows → [T, N] sharded
+    P(axis). Golden: dense compute + psum_scatter
     (cf. moe_reduce_rs.py:889-1027)."""
     axis = axis or ctx.axis_names[0]
     n = ctx.axis_size(axis)
+    mesh_axes = ctx.axis_names
     Tk, K = tokens.shape
     T, topk = topk_weights.shape
     assert Tk == T * topk
-    E = weights.shape[0]
+    assert T % n == 0, f"T={T} not divisible by ranks {n}"
+    t_seg = T // n
+    seg_rows = t_seg * topk
+    E, _, N = weights.shape
+    bm = min(block_m, _round_up(seg_rows, 8))
+    P_seg = _round_up(seg_rows, bm) + E * bm
+    n_blocks = P_seg // bm
 
-    def partial(tok_shard, ids_full, w_shard, tw):
-        rows = apply_grouped(
-            tok_shard, ids_full, E,
-            lambda x, be: grouped_gemm(x, w_shard, be, block_m=block_m),
-            block_m=block_m).astype(jnp.float32)
-        # topk-weighted fold: [T*topk, N] -> [T, N]
-        rows = rows.reshape(T, topk, -1) * tw[..., None].astype(jnp.float32)
-        return jnp.sum(rows, axis=1).astype(tokens.dtype)
+    # ids are replicated → every rank computes identical per-segment
+    # alignment; the ring reduces ALIGNED rows (topk fold commutes with the
+    # cross-rank sum and runs once at the end)
+    gi, rv, be = _segment_alignment(ids.reshape(n, seg_rows), E, bm)
+    be_flat = be.reshape(-1)
+
+    def f(tok_shard, gi_full, rv_full, be_full, tw_full, w_shard):
+        me = lax.axis_index(axis)
+        # aligned rows for every segment, from my K-shard of the tokens
+        base = (jnp.arange(n, dtype=jnp.int32) * seg_rows)[:, None]
+        rows = jnp.clip(base + gi_full, 0, Tk - 1).reshape(-1)
+        x = (tok_shard[rows]
+             * rv_full.reshape(-1)[:, None].astype(tok_shard.dtype))
+
+        bn = min(128, N)
+        kernel = lambda *refs: _moe_rs_kernel(axis, mesh_axes, bm, bn,
+                                              n_blocks, *refs)
+        y, _ws, _stage = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((P_seg, N), jnp.float32),
+                jax.ShapeDtypeStruct((n, P_seg, N), jnp.float32),  # symm
+                jax.ShapeDtypeStruct((2, P_seg, N), jnp.float32),  # stage
+            ),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pltpu.SMEM)],
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 3,
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((n,)),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=collective_id_for("moe_rs")),
+            cost_estimate=pl.CostEstimate(
+                flops=2 * n * P_seg * tok_shard.shape[1] * N,
+                bytes_accessed=(n * P_seg * (tok_shard.shape[1] + N))
+                * jnp.dtype(tok_shard.dtype).itemsize,
+                transcendentals=0),
+            interpret=default_interpret(),
+        )(x, w_shard, be_full)
+
+        # my segment's metadata: unscramble aligned rows → (token, k) rows
+        gi_me = lax.dynamic_index_in_dim(gi_full, me, keepdims=False)
+        rv_me = lax.dynamic_index_in_dim(rv_full, me, keepdims=False)
+        dest = jnp.where(rv_me, gi_me, seg_rows)
+        rows_out = jnp.zeros((seg_rows, N), jnp.float32).at[dest].add(
+            y * rv_me[:, None].astype(y.dtype), mode="drop")
+        # topk fold with my segment's weights
+        tw_me = lax.dynamic_slice_in_dim(tw_full, me * t_seg, t_seg)
+        folded = jnp.sum(rows_out.reshape(t_seg, topk, N)
+                         * tw_me[..., None].astype(jnp.float32), axis=1)
+        return folded.astype(tokens.dtype)
 
     sm = ctx.shard_map(
-        partial,
-        in_specs=(P(None, axis), P(None), P(None, axis, None), P(None, None)),
+        f, in_specs=(P(None, axis), P(None, None), P(None, None), P(None),
+                     P(None, None), P(None, axis, None)),
         out_specs=P(axis))
-    # each device's partial stacked along dim0 -> reduce_scatter input layout
-    partials = sm(tokens, ids, weights, topk_weights)
-    return reduce_scatter(ctx, partials, axis=axis)
+    return sm(tokens, gi, rv, be_flat, topk_weights, weights)
 
 
 __all__ = ["ag_moe_group_gemm", "moe_reduce_rs"]
