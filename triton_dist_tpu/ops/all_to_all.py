@@ -81,15 +81,23 @@ def _a2a_kernel(axis, mesh_axes, n_arrays, refs):
 
 
 def all_to_all_push(ctx: ShmemContext, *arrays: jax.Array,
-                    axis: str | None = None) -> tuple[jax.Array, ...]:
-    """Generic low-latency All-to-All: each input is globally
-    ``[n*n, ...]`` sharded P(axis) — locally ``[n, ...]`` where slot p is the
-    payload destined for peer p. Returns same-shaped arrays where local slot
-    p holds the payload *received from* peer p. One kernel, one put per
-    (peer, array), arrival = DMA semaphore."""
+                    axis: str | None = None,
+                    spec: P | None = None) -> tuple[jax.Array, ...]:
+    """Generic low-latency All-to-All: each input is locally ``[n, ...]``
+    where slot p is the payload destined for peer p along ``axis``. Returns
+    same-shaped arrays where local slot p holds the payload *received from*
+    peer p. One kernel, one put per (peer, array), arrival = DMA semaphore.
+
+    ``spec`` is the dim-0 sharding of the global arrays. The default
+    ``P(axis)`` means globally ``[n*n, ...]`` with devices differing only on
+    other mesh axes holding replicas (data-parallel semantics). Pass
+    ``P(mesh_axes)`` (flat, globally ``[n_devices*n, ...]``) when every
+    device holds distinct payloads — e.g. one tier of the hierarchical
+    dispatch."""
     axis = axis or ctx.axis_names[0]
     n = ctx.axis_size(axis)
     mesh_axes = ctx.axis_names
+    spec = spec if spec is not None else P(axis)
     n_arrays = len(arrays)
 
     def f(*shards):
@@ -112,8 +120,8 @@ def all_to_all_push(ctx: ShmemContext, *arrays: jax.Array,
         )(*shards)
         return out if isinstance(out, tuple) else (out,)
 
-    sm = ctx.shard_map(f, in_specs=tuple(P(axis) for _ in arrays),
-                       out_specs=tuple(P(axis) for _ in arrays))
+    sm = ctx.shard_map(f, in_specs=tuple(spec for _ in arrays),
+                       out_specs=tuple(spec for _ in arrays))
     return sm(*arrays)
 
 
@@ -157,9 +165,7 @@ def create_all_to_all_context(ctx: ShmemContext, max_tokens: int, hidden: int,
     assert num_experts % n == 0, (num_experts, n)
     if capacity is None:
         capacity = max_tokens * topk  # worst case: everything to one rank
-    # round up to the bf16 sublane count so [capacity, hidden] DMA slices
-    # meet Mosaic's tiling alignment on real TPUs
-    capacity = (capacity + 15) // 16 * 16
+    capacity = _cap_round(capacity)
     assert hidden % 128 == 0, f"hidden={hidden} must be a lane multiple (128)"
     return EpAllToAllContext(ctx=ctx, axis=axis, max_tokens=max_tokens,
                              hidden=hidden, topk=topk,
@@ -201,7 +207,7 @@ def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
     assert topk_ids.shape == (n * a2a.max_tokens, k), (
         f"dispatch: topk_ids {topk_ids.shape} != ({n * a2a.max_tokens}, {k})")
 
-    id_cols = max((cap + 127) // 128 * 128, 128)  # lane-aligned ids lane
+    id_cols = _id_cols(cap)  # lane-aligned ids wire
 
     def build(tok_shard, ids_shard):
         dest, slot, valid = route_tokens(a2a, ids_shard)
@@ -262,5 +268,203 @@ def combine(a2a: EpAllToAllContext, processed: jax.Array, layout,
     return sm(back, dest, slot, valid, topk_weights)
 
 
+# ---------------------------------------------------------------------------
+# 2-tier hierarchical EP dispatch / combine (multi-axis mesh: DCN x ICI)
+# ---------------------------------------------------------------------------
+
+def _cap_round(cap: int) -> int:
+    """Round a slot capacity up to the bf16 sublane count (16) so
+    [capacity, hidden] DMA slices meet Mosaic's tiling alignment."""
+    return (cap + 15) // 16 * 16
+
+
+def _id_cols(cap: int) -> int:
+    """Lane-aligned (128) column count for an int32 id wire of ``cap``."""
+    return max((cap + 127) // 128 * 128, 128)
+
+
+def _slot_assign(dest_flat: jax.Array, n: int, cap: int, valid=None):
+    """Exclusive-cumsum slot allocation per destination (the static-shape
+    replacement for the reference's per-warp atomic slot counters,
+    ep_a2a.py:64-147). Returns (slot, ok) — ``ok`` False for over-capacity
+    or already-invalid rows."""
+    one_hot = jax.nn.one_hot(jnp.clip(dest_flat, 0, n - 1), n,
+                             dtype=jnp.int32)
+    if valid is not None:
+        one_hot = one_hot * valid[:, None].astype(jnp.int32)
+    slots = jnp.cumsum(one_hot, axis=0) - one_hot
+    slot = jnp.take_along_axis(
+        slots, jnp.clip(dest_flat, 0, n - 1)[:, None], axis=1)[:, 0]
+    ok = slot < cap
+    if valid is not None:
+        ok = ok & valid
+    return slot, ok
+
+
+@dataclasses.dataclass(frozen=True)
+class Ep2dAllToAllContext:
+    """2-tier EP A2A over a (major, minor) mesh — the TPU shape of the
+    reference's hierarchical inter-node dispatch (ep_a2a.py:35-147:
+    inter-node token forward, then local scatter by expert). Tier 1 crosses
+    the major (slow/DCN) axis once to the target major-row; tier 2 scatters
+    along the minor (fast/ICI) axis to the expert's rank. Experts are
+    sharded over the flattened (major, minor) rank order."""
+    ctx: ShmemContext
+    axes: tuple[str, str]      # (major, minor)
+    max_tokens: int
+    hidden: int
+    topk: int
+    num_experts: int
+    cap1: int                  # tier-1 slots per (src, dst-major-row)
+    cap2: int                  # tier-2 slots per (src, dst-minor) pair
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def n_major(self) -> int:
+        return self.ctx.axis_size(self.axes[0])
+
+    @property
+    def n_minor(self) -> int:
+        return self.ctx.axis_size(self.axes[1])
+
+    @property
+    def n_ranks(self) -> int:
+        return self.n_major * self.n_minor
+
+    @property
+    def experts_per_rank(self) -> int:
+        return self.num_experts // self.n_ranks
+
+
+def create_all_to_all_context_2d(ctx: ShmemContext, max_tokens: int,
+                                 hidden: int, topk: int, num_experts: int,
+                                 axes: tuple[str, str] | None = None,
+                                 cap1: int | None = None,
+                                 cap2: int | None = None,
+                                 dtype=jnp.bfloat16) -> Ep2dAllToAllContext:
+    axes = axes or (ctx.axis_names[0], ctx.axis_names[1])
+    n = ctx.axis_size(axes[0]) * ctx.axis_size(axes[1])
+    assert num_experts % n == 0, (num_experts, n)
+    assert hidden % 128 == 0, f"hidden={hidden} must be a lane multiple (128)"
+    if cap1 is None:
+        cap1 = max_tokens * topk
+    cap1 = _cap_round(cap1)
+    if cap2 is None:
+        cap2 = ctx.axis_size(axes[0]) * cap1
+    cap2 = _cap_round(cap2)
+    return Ep2dAllToAllContext(ctx=ctx, axes=tuple(axes),
+                               max_tokens=max_tokens, hidden=hidden,
+                               topk=topk, num_experts=num_experts,
+                               cap1=cap1, cap2=cap2, dtype=jnp.dtype(dtype))
+
+
+def dispatch_2d(a2a: Ep2dAllToAllContext, tokens: jax.Array,
+                topk_ids: jax.Array):
+    """2-tier EP dispatch. Global inputs sharded P((major, minor)):
+    ``tokens`` [n*T, H], ``topk_ids`` [n*T, topk] (global expert ids).
+    Returns (recv_tokens [n, n_minor, cap2, H] P((major, minor)),
+    recv_ids — local expert per slot (or -1), layouts for ``combine_2d``).
+
+    Tier 1 (major/DCN): each token hops once to the device with its target
+    major coordinate (same minor coordinate as the source). Tier 2
+    (minor/ICI): the intermediate re-slots arrivals by target minor
+    coordinate and scatters. The reference's two-kernel structure
+    (inter-node putmem forward + local expert scatter, ep_a2a.py:35-147)
+    maps to two ``all_to_all_push`` tiers with VPU slot allocation."""
+    ctx = a2a.ctx
+    major, minor = a2a.axes
+    nM, nm = a2a.n_major, a2a.n_minor
+    epr = a2a.experts_per_rank
+    T, H, k = a2a.max_tokens, a2a.hidden, a2a.topk
+    cap1, cap2 = a2a.cap1, a2a.cap2
+    c1_cols, c2_cols = _id_cols(cap1), _id_cols(cap2)
+    both = P((major, minor))
+
+    def build1(tok_shard, ids_shard):
+        eid = ids_shard.reshape(-1)                          # [T*k] global
+        rank = eid // epr
+        a_dst = rank // nm
+        slot, ok = _slot_assign(a_dst, nM, cap1)
+        tok_rep = jnp.repeat(tok_shard[:, None, :], k, axis=1).reshape(-1, H)
+        s_drop = jnp.where(ok, slot, cap1)
+        send = jnp.zeros((nM, cap1, H), a2a.dtype).at[a_dst, s_drop].set(
+            tok_rep.astype(a2a.dtype), mode="drop")
+        meta = jnp.full((nM, c1_cols), -1, jnp.int32).at[a_dst, s_drop].set(
+            eid, mode="drop")
+        return (send, meta.reshape(nM, c1_cols // 128, 128),
+                a_dst, slot, ok)
+
+    sm1 = ctx.shard_map(build1, in_specs=(both, both),
+                        out_specs=(both,) * 5)
+    send1, meta1w, a_dst, slot1, ok1 = sm1(tokens, topk_ids)
+    recv1, meta1r = all_to_all_push(ctx, send1, meta1w, axis=major,
+                                    spec=both)
+
+    def build2(r1_shard, m1_shard):
+        meta = m1_shard.reshape(nM, c1_cols)[:, :cap1].reshape(-1)
+        valid = meta >= 0
+        rank = jnp.where(valid, meta, 0) // epr
+        b_dst = rank % nm
+        slot, ok = _slot_assign(b_dst, nm, cap2, valid)
+        toks = r1_shard.reshape(nM * cap1, H)
+        s_drop = jnp.where(ok, slot, cap2)
+        send = jnp.zeros((nm, cap2, H), a2a.dtype).at[b_dst, s_drop].set(
+            toks, mode="drop")
+        meta2 = jnp.full((nm, c2_cols), -1, jnp.int32).at[b_dst, s_drop].set(
+            meta, mode="drop")
+        return (send, meta2.reshape(nm, c2_cols // 128, 128),
+                b_dst, slot, ok)
+
+    sm2 = ctx.shard_map(build2, in_specs=(both, both), out_specs=(both,) * 5)
+    send2, meta2w, b_dst, slot2, ok2 = sm2(recv1, meta1r)
+    recv2, meta2r = all_to_all_push(ctx, send2, meta2w, axis=minor,
+                                    spec=both)
+
+    unpack = ctx.shard_map(
+        lambda w: jnp.where(
+            w.reshape(nm, c2_cols)[:, :cap2] >= 0,
+            w.reshape(nm, c2_cols)[:, :cap2] % epr, -1),
+        in_specs=both, out_specs=both)
+    recv_ids = unpack(meta2r)
+    layouts = ((a_dst, slot1, ok1), (b_dst, slot2, ok2))
+    return recv2, recv_ids, layouts
+
+
+def combine_2d(a2a: Ep2dAllToAllContext, processed: jax.Array, layouts,
+               topk_weights: jax.Array) -> jax.Array:
+    """Reverse path of ``dispatch_2d``: minor-tier return, intermediate
+    re-gather to tier-1 arrival order, major-tier return, topk-weighted sum
+    at the source (analog of kernel_combine_token, ep_a2a.py:150-241)."""
+    ctx = a2a.ctx
+    major, minor = a2a.axes
+    nM, nm = a2a.n_major, a2a.n_minor
+    T, H, k = a2a.max_tokens, a2a.hidden, a2a.topk
+    cap1 = a2a.cap1
+    (a_dst, slot1, ok1), (b_dst, slot2, ok2) = layouts
+    both = P((major, minor))
+
+    (back2,) = all_to_all_push(ctx, processed, axis=minor, spec=both)
+
+    def regroup(b2_shard, bd, s2, ok):
+        tok = b2_shard[bd, jnp.where(ok, s2, 0)]
+        tok = jnp.where(ok[:, None], tok, 0).astype(a2a.dtype)
+        return tok.reshape(nM, cap1, H)
+
+    mid = ctx.shard_map(regroup, in_specs=(both,) * 4, out_specs=both)(
+        back2, b_dst, slot2, ok2)
+    (back1,) = all_to_all_push(ctx, mid, axis=major, spec=both)
+
+    def gather(b1_shard, ad, s1, ok, w):
+        tok = b1_shard[ad, jnp.where(ok, s1, 0)]
+        tok = jnp.where(ok[:, None], tok, 0).reshape(T, k, H)
+        return jnp.sum(tok.astype(jnp.float32)
+                       * w[..., None].astype(jnp.float32),
+                       axis=1).astype(a2a.dtype)
+
+    return ctx.shard_map(gather, in_specs=(both,) * 5, out_specs=both)(
+        back1, a_dst, slot1, ok1, topk_weights)
+
+
 __all__ = ["all_to_all_push", "EpAllToAllContext", "create_all_to_all_context",
-           "route_tokens", "dispatch", "combine"]
+           "route_tokens", "dispatch", "combine", "Ep2dAllToAllContext",
+           "create_all_to_all_context_2d", "dispatch_2d", "combine_2d"]

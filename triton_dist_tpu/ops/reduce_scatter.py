@@ -17,6 +17,7 @@ arrival signal.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -101,19 +102,70 @@ def _rs_call(axis: str, mesh_axes, n: int, shard):
     )(shard)
 
 
-def reduce_scatter(ctx: ShmemContext, x: jax.Array, axis: str | None = None):
+def reduce_scatter(ctx: ShmemContext, x: jax.Array, axis: str | None = None,
+                   method: str = "auto"):
     """Reduce(sum)-scatter over ``axis``. ``x`` is globally ``(n*M, ...)``
     sharded ``P(axis)`` — each device's local ``[M, ...]`` block is its own
     full-size contribution (e.g. a GEMM partial). Device i receives the sum
     of all contributions' segment i; the result is the ``(M, ...)`` global
     array sharded ``P(axis)``. Golden: ``jax.lax.psum_scatter`` inside
-    shard_map."""
+    shard_map.
+
+    ``method`` ∈ auto|ring|ring_2d. With ``axis=None`` on a multi-axis mesh
+    (or ``method="ring_2d"``), runs the 2-D hierarchical RS over
+    (major, minor) — the multi-tier analog of the reference's 2-D RS
+    (reduce_scatter.py:430-785: intra-node scatter + per-node reduce +
+    inter-node tier). The minor axis should be the faster tier (ICI)."""
+    if method == "auto":
+        method = "ring_2d" if (axis is None and len(ctx.axis_names) > 1) \
+            else "ring"
+    if method == "ring_2d":
+        if axis is not None:
+            raise ValueError(
+                "ring_2d reduce_scatter spans the full (major, minor) mesh; "
+                f"it cannot take axis={axis!r} — use method='ring' for a "
+                "single-axis RS")
+        if len(ctx.axis_names) < 2:
+            raise ValueError("ring_2d reduce_scatter needs a >=2-axis mesh; "
+                             f"mesh axes are {ctx.axis_names}")
+        return _rs_ring_2d(ctx, x)
     if axis is None:
         axis = ctx.axis_names[0]
     n = ctx.axis_size(axis)
     mesh_axes = ctx.axis_names
     f = lambda shard: _rs_call(axis, mesh_axes, n, shard)
     sm = ctx.shard_map(f, in_specs=P(axis), out_specs=P(axis))
+    return sm(x)
+
+
+def _rs_ring_2d(ctx: ShmemContext, x: jax.Array):
+    """Hierarchical RS over a (major, minor) mesh: ring-RS along the minor
+    (fast) axis first, then ring-RS of the surviving super-segment along the
+    major (slow) axis — each row crosses the slow tier exactly once, already
+    minor-reduced (the reference's intra-node-reduce-then-inter-node
+    structure, reduce_scatter.py:430-785).
+
+    Device (a, b) must end up owning global segment ``a*n_minor + b`` (the
+    P((major, minor)) layout), but the natural stage order leaves it with
+    segment ``b*n_major + a`` — so each contribution's segments are
+    pre-permuted (a VPU-local transpose) before the rings."""
+    major, minor = ctx.axis_names[0], ctx.axis_names[1]
+    mesh_axes = ctx.axis_names
+    n_major, n_minor = ctx.axis_size(major), ctx.axis_size(minor)
+    n = n_major * n_minor
+
+    def f(shard):
+        M = shard.shape[0]
+        assert M % n == 0, (M, n)
+        seg = M // n
+        # [n_major, n_minor, seg, ...] -> minor-major segment order
+        xr = shard.reshape((n_major, n_minor, seg) + shard.shape[1:])
+        xr = jnp.swapaxes(xr, 0, 1).reshape(shard.shape)
+        part = _rs_call(minor, mesh_axes, n_minor, xr)
+        return _rs_call(major, mesh_axes, n_major, part)
+
+    sm = ctx.shard_map(f, in_specs=P((major, minor)),
+                       out_specs=P((major, minor)))
     return sm(x)
 
 
