@@ -186,10 +186,13 @@ def bench_ag_gemm(ctx, n_dev: int, M: int, N: int, K: int, configs,
 
 
 def bench_a2a(ctx, tokens_per_rank: int, hidden: int, topk: int,
-              num_experts: int, i1: int, i2: int) -> tuple[float, float]:
+              num_experts: int, i1: int, i2: int,
+              wire_dtype=None) -> tuple[float, float]:
     """(dispatch_s, roundtrip_s) per call at the DeepSeek-infer A2A shape —
     the BASELINE.md second target (reference low_latency_all_to_all.py,
-    README.md:55). ``roundtrip`` = dispatch + combine chained."""
+    README.md:55; the reference's 137 µs number is fp8+scales, which
+    ``wire_dtype=jnp.float8_e4m3fn`` matches). ``roundtrip`` = dispatch +
+    combine chained."""
     from triton_dist_tpu.ops.all_to_all import (combine,
                                                 create_all_to_all_context,
                                                 dispatch)
@@ -198,7 +201,8 @@ def bench_a2a(ctx, tokens_per_rank: int, hidden: int, topk: int,
     n = ctx.axis_size(axis)
     a2a = create_all_to_all_context(ctx, max_tokens=tokens_per_rank,
                                     hidden=hidden, topk=topk,
-                                    num_experts=num_experts, axis=axis)
+                                    num_experts=num_experts, axis=axis,
+                                    wire_dtype=wire_dtype)
     T = n * tokens_per_rank
     tokens = ctx.shard(jax.random.normal(jax.random.key(0), (T, hidden),
                                          jnp.float32).astype(jnp.bfloat16),
@@ -276,6 +280,16 @@ def main():
         extras["a2a_roundtrip_us"] = round(roundtrip_s * 1e6, 1)
     except Exception as e:  # a2a failure must not sink the primary metric
         extras["a2a_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        # fp8 wire + scale side-channel — the reference's showcase protocol.
+        # At n=1 this measures pure quantize/dequant overhead (no wire to
+        # shrink); the halved wire bytes only pay off multi-chip.
+        d8, r8 = bench_a2a(ctx, i1=i1, i2=i2,
+                           wire_dtype=jnp.float8_e4m3fn, **a2a_shape)
+        extras["a2a_dispatch_fp8_us"] = round(d8 * 1e6, 1)
+        extras["a2a_roundtrip_fp8_us"] = round(r8 * 1e6, 1)
+    except Exception as e:
+        extras["a2a_fp8_error"] = f"{type(e).__name__}: {e}"[:200]
 
     print(json.dumps({
         "metric": "ag_gemm_tflops_per_chip",

@@ -136,7 +136,14 @@ class EpAllToAllContext:
     ``capacity`` is the per-(src,dst) token budget — tokens routed beyond it
     are dropped (standard expert-capacity semantics; the reference instead
     sizes buffers for the worst case, which equals
-    ``capacity = max_tokens * topk``)."""
+    ``capacity = max_tokens * topk``).
+
+    ``wire_dtype`` (e.g. ``jnp.float8_e4m3fn`` or ``jnp.int8``) enables the
+    quantized wire format: tokens ride the A2A as per-token symmetric
+    quantized rows plus an f32 scale side-channel payload, halving (vs bf16)
+    the wire bytes — the reference's fp8+scales showcase protocol
+    (low_latency_all_to_all.py:60-88, README.md:55). Dequantization happens
+    at the receiving edge; expert compute stays in ``dtype``."""
     ctx: ShmemContext
     axis: str
     max_tokens: int      # tokens per rank entering dispatch
@@ -145,6 +152,7 @@ class EpAllToAllContext:
     num_experts: int     # global expert count
     capacity: int        # slots per (src,dst) rank pair
     dtype: jnp.dtype = jnp.bfloat16
+    wire_dtype: jnp.dtype | None = None
 
     @property
     def n_ranks(self) -> int:
@@ -159,18 +167,22 @@ def create_all_to_all_context(ctx: ShmemContext, max_tokens: int, hidden: int,
                               topk: int, num_experts: int,
                               capacity: int | None = None,
                               axis: str | None = None,
-                              dtype=jnp.bfloat16) -> EpAllToAllContext:
+                              dtype=jnp.bfloat16,
+                              wire_dtype=None) -> EpAllToAllContext:
     axis = axis or ctx.axis_names[0]
     n = ctx.axis_size(axis)
     assert num_experts % n == 0, (num_experts, n)
     if capacity is None:
         capacity = max_tokens * topk  # worst case: everything to one rank
-    capacity = _cap_round(capacity)
+    wire_itemsize = jnp.dtype(wire_dtype or dtype).itemsize
+    capacity = _cap_round(capacity, wire_itemsize)
     assert hidden % 128 == 0, f"hidden={hidden} must be a lane multiple (128)"
     return EpAllToAllContext(ctx=ctx, axis=axis, max_tokens=max_tokens,
                              hidden=hidden, topk=topk,
                              num_experts=num_experts, capacity=capacity,
-                             dtype=jnp.dtype(dtype))
+                             dtype=jnp.dtype(dtype),
+                             wire_dtype=(jnp.dtype(wire_dtype)
+                                         if wire_dtype is not None else None))
 
 
 def route_tokens(a2a: EpAllToAllContext, topk_ids: jax.Array):
@@ -180,15 +192,9 @@ def route_tokens(a2a: EpAllToAllContext, topk_ids: jax.Array):
     ``slot`` is the token's position in the capacity-padded lane to rank
     ``dest``. Pure jnp — runs under jit/shard_map per device."""
     T, k = topk_ids.shape
-    n = a2a.n_ranks
     dest = topk_ids // a2a.experts_per_rank                      # [T,k]
-    flat_dest = dest.reshape(-1)                                  # [T*k]
-    one_hot = jax.nn.one_hot(flat_dest, n, dtype=jnp.int32)       # [T*k, n]
-    slot_flat = jnp.cumsum(one_hot, axis=0) - one_hot             # exclusive
-    slot = jnp.take_along_axis(slot_flat, flat_dest[:, None],
-                               axis=1)[:, 0].reshape(T, k)
-    valid = slot < a2a.capacity
-    return dest, slot, valid
+    slot, valid = _slot_assign(dest.reshape(-1), a2a.n_ranks, a2a.capacity)
+    return dest, slot.reshape(T, k), valid.reshape(T, k)
 
 
 def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
@@ -208,29 +214,54 @@ def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
         f"dispatch: topk_ids {topk_ids.shape} != ({n * a2a.max_tokens}, {k})")
 
     id_cols = _id_cols(cap)  # lane-aligned ids wire
+    wire = a2a.wire_dtype
 
     def build(tok_shard, ids_shard):
         dest, slot, valid = route_tokens(a2a, ids_shard)
-        send_buf = jnp.zeros((n, cap, H), a2a.dtype)
+        send_buf = jnp.zeros((n, cap, H), wire or a2a.dtype)
         send_ids = jnp.full((n, id_cols), -1, jnp.int32)
-        tok_rep = jnp.repeat(tok_shard[:, None, :], k, axis=1).reshape(-1, H)
+        if wire is not None:
+            # quantize the T unique tokens once, then fan out topk copies
+            q, s = _quant(tok_shard, wire)
+            tok_rep = jnp.repeat(q[:, None, :], k, axis=1).reshape(-1, H)
+            scales = jnp.repeat(s[:, None], k, axis=1).reshape(-1)
+        else:
+            tok_rep = jnp.repeat(tok_shard[:, None, :], k, axis=1
+                                 ).reshape(-1, H).astype(a2a.dtype)
         d_f, s_f, v_f = (x.reshape(-1) for x in (dest, slot, valid))
         # over-capacity tokens get an out-of-bounds slot -> dropped by the
         # scatter (never clobbering a valid slot)
         s_drop = jnp.where(v_f, s_f, cap)
         local_eid = (ids_shard % a2a.experts_per_rank).reshape(-1)
-        send_buf = send_buf.at[d_f, s_drop].set(
-            tok_rep.astype(a2a.dtype), mode="drop")
+        send_buf = send_buf.at[d_f, s_drop].set(tok_rep, mode="drop")
         send_ids = send_ids.at[d_f, s_drop].set(local_eid, mode="drop")
         # wire format: [n, rows, 128] so the per-peer DMA slice is
         # lane-aligned on real TPUs
-        return send_buf, send_ids.reshape(n, id_cols // 128, 128), dest, slot, valid
+        outs = (send_buf, send_ids.reshape(n, id_cols // 128, 128))
+        if wire is not None:
+            send_sc = jnp.ones((n, id_cols), jnp.float32).at[
+                d_f, s_drop].set(scales, mode="drop")
+            outs += (send_sc.reshape(n, -1, 128),)
+        return outs + (dest, slot, valid)
 
+    n_wire = 3 if wire is not None else 2
     sm = ctx.shard_map(build, in_specs=(P(axis), P(axis)),
-                       out_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)))
-    send_buf, send_ids, dest, slot, valid = sm(tokens, topk_ids)
-    recv_tokens, recv_ids_wire = all_to_all_push(ctx, send_buf, send_ids,
-                                                 axis=axis)
+                       out_specs=(P(axis),) * (n_wire + 3))
+    if wire is not None:
+        send_buf, send_ids, send_sc, dest, slot, valid = sm(tokens, topk_ids)
+    else:
+        send_buf, send_ids, dest, slot, valid = sm(tokens, topk_ids)
+    if wire is not None:
+        recv_q, recv_ids_wire, recv_sc = all_to_all_push(
+            ctx, send_buf, send_ids, send_sc, axis=axis)
+        dequant = ctx.shard_map(
+            lambda q, s: _dequant(q, s.reshape(n, id_cols)[:, :cap],
+                                  a2a.dtype),
+            in_specs=(P(axis), P(axis)), out_specs=P(axis))
+        recv_tokens = dequant(recv_q, recv_sc)
+    else:
+        recv_tokens, recv_ids_wire = all_to_all_push(ctx, send_buf, send_ids,
+                                                     axis=axis)
     unpack = ctx.shard_map(
         lambda w: w.reshape(n, id_cols)[:, :cap],
         in_specs=P(axis), out_specs=P(axis))
@@ -248,7 +279,26 @@ def combine(a2a: EpAllToAllContext, processed: jax.Array, layout,
     where slot (src, c) is the processed token for rank src's slot c."""
     ctx, axis = a2a.ctx, a2a.axis
     n, cap, H, k = a2a.n_ranks, a2a.capacity, a2a.hidden, a2a.topk
-    (back,) = all_to_all_push(ctx, processed, axis=axis)
+    wire = a2a.wire_dtype
+    if wire is not None:
+        # quantize the return trip too (reference sends fp8 both ways)
+        id_cols = _id_cols(cap)
+
+        def qpack(p_shard):
+            q, s = _quant(p_shard.reshape(n * cap, H), wire)
+            sc = jnp.ones((n, id_cols), jnp.float32).at[:, :cap].set(
+                s.reshape(n, cap))
+            return q.reshape(n, cap, H), sc.reshape(n, -1, 128)
+
+        pq, psc = ctx.shard_map(qpack, in_specs=P(axis),
+                                out_specs=(P(axis), P(axis)))(processed)
+        back_q, back_sc = all_to_all_push(ctx, pq, psc, axis=axis)
+        back = ctx.shard_map(
+            lambda q, s: _dequant(q, s.reshape(n, id_cols)[:, :cap],
+                                  a2a.dtype),
+            in_specs=(P(axis), P(axis)), out_specs=P(axis))(back_q, back_sc)
+    else:
+        (back,) = all_to_all_push(ctx, processed, axis=axis)
 
     def gather_back(back_shard, dest, slot, valid, w):
         # back_shard: [n, cap, H] — slot (d, c) = my token processed by rank d
@@ -272,10 +322,33 @@ def combine(a2a: EpAllToAllContext, processed: jax.Array, layout,
 # 2-tier hierarchical EP dispatch / combine (multi-axis mesh: DCN x ICI)
 # ---------------------------------------------------------------------------
 
-def _cap_round(cap: int) -> int:
-    """Round a slot capacity up to the bf16 sublane count (16) so
-    [capacity, hidden] DMA slices meet Mosaic's tiling alignment."""
-    return (cap + 15) // 16 * 16
+def _cap_round(cap: int, wire_itemsize: int = 2) -> int:
+    """Round a slot capacity up to the wire dtype's sublane tile (8 rows ×
+    4 bytes: 8 for f32, 16 for bf16, 32 for fp8/int8) so [capacity, hidden]
+    DMA slices meet Mosaic's tiling alignment."""
+    mult = 32 // wire_itemsize
+    return (cap + mult - 1) // mult * mult
+
+
+def _quant(x: jax.Array, wire_dtype) -> tuple[jax.Array, jax.Array]:
+    """Per-row symmetric quantization: (q rows in ``wire_dtype``,
+    f32 scale per row). Zero rows get scale 1 (quantize to zeros)."""
+    if jnp.issubdtype(wire_dtype, jnp.floating):
+        qmax = float(jnp.finfo(wire_dtype).max)
+    else:
+        qmax = float(jnp.iinfo(wire_dtype).max)
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
+    q = xf / scale[..., None]
+    if not jnp.issubdtype(wire_dtype, jnp.floating):
+        q = jnp.round(q)
+    return q.astype(wire_dtype), scale
+
+
+def _dequant(q: jax.Array, scale: jax.Array, out_dtype) -> jax.Array:
+    return (q.astype(jnp.float32) * scale[..., None].astype(jnp.float32)
+            ).astype(out_dtype)
 
 
 def _id_cols(cap: int) -> int:
@@ -346,12 +419,13 @@ def create_all_to_all_context_2d(ctx: ShmemContext, max_tokens: int,
     n = ctx.axis_size(axes[0]) * ctx.axis_size(axes[1])
     assert num_experts % n == 0, (num_experts, n)
     assert hidden % 128 == 0, f"hidden={hidden} must be a lane multiple (128)"
+    itemsize = jnp.dtype(dtype).itemsize
     if cap1 is None:
         cap1 = max_tokens * topk
-    cap1 = _cap_round(cap1)
+    cap1 = _cap_round(cap1, itemsize)
     if cap2 is None:
         cap2 = ctx.axis_size(axes[0]) * cap1
-    cap2 = _cap_round(cap2)
+    cap2 = _cap_round(cap2, itemsize)
     return Ep2dAllToAllContext(ctx=ctx, axes=tuple(axes),
                                max_tokens=max_tokens, hidden=hidden,
                                topk=topk, num_experts=num_experts,

@@ -129,6 +129,9 @@ def reduce_scatter(ctx: ShmemContext, x: jax.Array, axis: str | None = None,
             raise ValueError("ring_2d reduce_scatter needs a >=2-axis mesh; "
                              f"mesh axes are {ctx.axis_names}")
         return _rs_ring_2d(ctx, x)
+    if method != "ring":
+        raise ValueError(f"unknown reduce_scatter method {method!r}; "
+                         "expected auto|ring|ring_2d")
     if axis is None:
         axis = ctx.axis_names[0]
     n = ctx.axis_size(axis)
