@@ -235,6 +235,42 @@ def bench_a2a(ctx, tokens_per_rank: int, hidden: int, topk: int,
     return dispatch_s, roundtrip_s
 
 
+def bench_decode(ctx, i1: int, i2: int, B: int = 1, Hq: int = 32,
+                 Hkv: int = 8, D: int = 128, s_local: int = 1024
+                 ) -> dict[str, float]:
+    """SP flash-decode latency (batch=1, the reference's scaling-chart
+    workload, README.md:161-163) for the generic push AG + separate combine
+    vs the fused AG+merge latency paths."""
+    from triton_dist_tpu.ops.flash_decode import sp_gqa_flash_decode
+
+    axis = ctx.axis_names[0]
+    n = ctx.axis_size(axis)
+    S = n * s_local
+    q = jax.random.normal(jax.random.key(0), (B, Hq, D), jnp.float32
+                          ).astype(jnp.bfloat16)
+    k = jax.random.normal(jax.random.key(1), (B, Hkv, S, D), jnp.float32
+                          ).astype(jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), (B, Hkv, S, D), jnp.float32
+                          ).astype(jnp.bfloat16)
+    kv = jnp.array([S] * B, jnp.int32)
+    ks = ctx.shard(k, P(None, None, axis))
+    vs = ctx.shard(v, P(None, None, axis))
+
+    res = {}
+    for method in ("push", "fused"):
+        # decode output [B,Hq,D] feeds back as next q: self-chains
+        def step(qq, _m=method):
+            out = sp_gqa_flash_decode(ctx, qq, ks, vs, kv, axis=axis,
+                                      ag_method=_m)
+            return qq + (out * jnp.asarray(1e-20, out.dtype))
+
+        timer = make_chain_timer(lambda c, _b, s=step: s(c), q,
+                                 jnp.zeros((), jnp.bfloat16))
+        res[f"decode_{method}_us"] = round(
+            _per_iter(timer, i1, i2) * 1e6, 1)
+    return res
+
+
 def main():
     import math
 
@@ -280,6 +316,17 @@ def main():
         extras["a2a_roundtrip_us"] = round(roundtrip_s * 1e6, 1)
     except Exception as e:  # a2a failure must not sink the primary metric
         extras["a2a_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        # decode per-call latency is tens of µs, so the spread must be wider
+        # than the GEMM bench's for the differenced signal to clear the
+        # ~50 ms tunnel jitter
+        dec_shape = (dict(s_local=256, Hq=8, Hkv=2)
+                     if on_cpu() else dict(s_local=4096))
+        # target ≥ ~100 ms of differenced signal at tens-of-µs per call
+        di1, di2 = (i1, i2) if on_cpu() else (10, 3610)
+        extras.update(bench_decode(ctx, i1=di1, i2=di2, **dec_shape))
+    except Exception as e:
+        extras["decode_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
         # fp8 wire + scale side-channel — the reference's showcase protocol.
         # At n=1 this measures pure quantize/dequant overhead (no wire to

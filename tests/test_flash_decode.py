@@ -77,8 +77,10 @@ def test_decode_combine_matches_monolithic():
     assert_allclose(np.asarray(merged), golden, atol=1e-3, rtol=1e-3)
 
 
-def test_sp_flash_decode(ctx):
-    """Full SP pipeline on the mesh vs dense golden, ragged lengths."""
+@pytest.mark.parametrize("ag_method", ["push", "fused"])
+def test_sp_flash_decode(ctx, ag_method):
+    """Full SP pipeline on the mesh vs dense golden, ragged lengths —
+    over the generic push AG and the fused AG+merge latency path."""
     n = ctx.num_ranks
     B, Hq, Hkv, D = 2, 4, 2, 128
     s_local = 128
@@ -89,6 +91,10 @@ def test_sp_flash_decode(ctx):
     kv_lens = jnp.array([S, S // 2 + 17], jnp.int32)
     ks = ctx.shard(k, P(None, None, "x"))
     vs = ctx.shard(v, P(None, None, "x"))
-    out = jax.jit(lambda *a: sp_gqa_flash_decode(ctx, *a))(q, ks, vs, kv_lens)
+    f = jax.jit(lambda *a: sp_gqa_flash_decode(ctx, *a, ag_method=ag_method))
+    out = f(q, ks, vs, kv_lens)
     golden = _dense_golden(q, k, v, np.asarray(kv_lens))
     assert_allclose(np.asarray(out), golden, atol=1e-3, rtol=1e-3)
+    # repeated-call safety (ws buffer addresses are reused across calls)
+    out2 = f(q, ks, vs, kv_lens)
+    assert_allclose(np.asarray(out2), golden, atol=1e-3, rtol=1e-3)

@@ -24,7 +24,7 @@ class SpGQAFlashDecodeAttention:
     head_dim: int
     axis: str | None = None
     block_s: int = 128
-    ag_method: str = "push"   # latency-bound partials -> one-hop push
+    ag_method: str = "fused"  # fused partial-AG + lse-merge latency path
 
     def __call__(self, q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                  global_kv_lens: jax.Array) -> jax.Array:

@@ -197,6 +197,13 @@ def route_tokens(a2a: EpAllToAllContext, topk_ids: jax.Array):
     return dest, slot.reshape(T, k), valid.reshape(T, k)
 
 
+def _dequant_wire(ctx, axis, n, id_cols, cap, out_dtype):
+    """shard_map'd receive-edge dequant: (q wire, scale wire) → tokens."""
+    return ctx.shard_map(
+        lambda q, s: _dequant(q, s.reshape(n, id_cols)[:, :cap], out_dtype),
+        in_specs=(P(axis), P(axis)), out_specs=P(axis))
+
+
 def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
     """EP dispatch (analog of ``fast_all_to_all``,
     low_latency_all_to_all.py:189-248). Global inputs sharded P(axis):
@@ -254,11 +261,8 @@ def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
     if wire is not None:
         recv_q, recv_ids_wire, recv_sc = all_to_all_push(
             ctx, send_buf, send_ids, send_sc, axis=axis)
-        dequant = ctx.shard_map(
-            lambda q, s: _dequant(q, s.reshape(n, id_cols)[:, :cap],
-                                  a2a.dtype),
-            in_specs=(P(axis), P(axis)), out_specs=P(axis))
-        recv_tokens = dequant(recv_q, recv_sc)
+        recv_tokens = _dequant_wire(ctx, axis, n, id_cols, cap,
+                                    a2a.dtype)(recv_q, recv_sc)
     else:
         recv_tokens, recv_ids_wire = all_to_all_push(ctx, send_buf, send_ids,
                                                      axis=axis)
@@ -293,10 +297,8 @@ def combine(a2a: EpAllToAllContext, processed: jax.Array, layout,
         pq, psc = ctx.shard_map(qpack, in_specs=P(axis),
                                 out_specs=(P(axis), P(axis)))(processed)
         back_q, back_sc = all_to_all_push(ctx, pq, psc, axis=axis)
-        back = ctx.shard_map(
-            lambda q, s: _dequant(q, s.reshape(n, id_cols)[:, :cap],
-                                  a2a.dtype),
-            in_specs=(P(axis), P(axis)), out_specs=P(axis))(back_q, back_sc)
+        back = _dequant_wire(ctx, axis, n, id_cols, cap,
+                             a2a.dtype)(back_q, back_sc)
     else:
         (back,) = all_to_all_push(ctx, processed, axis=axis)
 

@@ -33,6 +33,8 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from triton_dist_tpu.ops.allgather import all_gather
+from triton_dist_tpu.ops.common import collective_id_for
+from triton_dist_tpu.shmem import device as shd
 from triton_dist_tpu.shmem.context import ShmemContext
 from triton_dist_tpu.utils import default_interpret
 
@@ -264,10 +266,106 @@ def decode_combine(partial_outs: jax.Array, partial_lses: jax.Array):
     )(partial_outs, partial_lses)
 
 
+def _ll_ag_merge_kernel(axis, mesh_axes, D, out_dtype,
+                        part_ref, out_ref, ws_ref, buf, obuf,
+                        send_sems, recv_sems):
+    """Fused low-latency partial-AG + lse-merge (the decode critical path).
+
+    Replaces the generic AG kernel + separate combine kernel with ONE
+    kernel: put my packed partial (out ‖ lse, f32) to every peer plus a
+    local copy into my own slot, then stream the online lse-merge over
+    partials in CANONICAL rank order (seg 0..n-1) — each segment waited
+    once. Canonical order makes the fp32 accumulation identical on every
+    rank, so the P(None) "replicated" output is bitwise consistent across
+    devices (a swizzled start-local order would merge in a different order
+    per rank and drift in the low bits, compounding across autoregressive
+    steps). The merge math is the running (max, denom, acc) rescaling —
+    the same online softmax the reference's inter-rank combine uses
+    (kernel_inter_rank_gqa_fwd_batch_decode_combine_kv,
+    flash_decode.py:481-566), fused behind the transport like the
+    reference's LL allgather layer (low_latency_allgather.py:531-621,
+    sp_flash_decode_layer.py:108-125).
+
+    The entry barrier is required: the ws arrival buffer address is reused
+    across calls by XLA, so without it a fast peer's call-k+1 put could
+    overwrite a slot this rank's call-k merge has not read yet.
+    """
+    me = shd.my_pe(axis)
+    n = shd.n_pes(axis)
+    shd.barrier_all((axis,), mesh_axes=mesh_axes)
+
+    local = pltpu.make_async_copy(part_ref, ws_ref.at[me], recv_sems.at[me])
+    local.start()
+    rdmas = []
+    for p in range(1, n):
+        dst = lax.rem(me + p, n)
+        pid = shd.pe_at(mesh_axes, axis, dst)
+        rdmas.append(shd.putmem_nbi(ws_ref.at[me], part_ref,
+                                    send_sems.at[dst], recv_sems.at[me], pid))
+
+    acc = m = denom = None
+    for seg in range(n):
+        shd.wait_recv(ws_ref.at[seg], recv_sems.at[seg])
+        pltpu.sync_copy(ws_ref.at[seg], buf)
+        x = buf[...]
+        o, lse = x[..., :D], x[..., D:D + 1]   # [B,Hq,D], [B,Hq,1]
+        if seg == 0:
+            acc, m, denom = o, lse, jnp.ones_like(lse)
+        else:
+            new_m = jnp.maximum(m, lse)
+            scale = jnp.exp(m - new_m)
+            w = jnp.exp(lse - new_m)
+            acc = acc * scale + o * w
+            denom = denom * scale + w
+            m = new_m
+
+    obuf[...] = (acc / jnp.where(denom > 0, denom, 1.0)).astype(out_dtype)
+    pltpu.sync_copy(obuf, out_ref)   # ANY-space outputs need a DMA store
+    shd.quiet(*rdmas)
+
+
+def ll_ag_merge(ctx: ShmemContext, packed: jax.Array, D: int,
+                out_dtype, axis: str):
+    """Host wrapper for the fused partial-AG + merge. ``packed`` is
+    [n, B, Hq, D+128] f32 sharded P(axis) (rank dim leading); returns
+    merged [B, Hq, D] replicated."""
+    n = ctx.axis_size(axis)
+    mesh_axes = ctx.axis_names
+
+    def f(pk):
+        B, Hq, W = pk.shape[1:]
+        kernel = lambda *refs: _ll_ag_merge_kernel(
+            axis, mesh_axes, D, out_dtype, *refs)
+        out, _ws = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((B, Hq, D), out_dtype),
+                jax.ShapeDtypeStruct((n, B, Hq, W), pk.dtype),  # arrival ws
+            ),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pl.ANY)),
+            scratch_shapes=[
+                pltpu.VMEM((B, Hq, W), pk.dtype),
+                pltpu.VMEM((B, Hq, D), out_dtype),
+                pltpu.SemaphoreType.DMA((n,)),
+                pltpu.SemaphoreType.DMA((n,)),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=collective_id_for("ll_ag_merge")),
+            interpret=default_interpret(),
+        )(pk[0])   # drop the leading rank dim: local block is [1, B, Hq, W]
+        return out
+
+    sm = ctx.shard_map(f, in_specs=P(axis), out_specs=P(None))
+    return sm(packed)
+
+
 def sp_gqa_flash_decode(ctx: ShmemContext, q: jax.Array, k_cache: jax.Array,
                         v_cache: jax.Array, global_kv_lens: jax.Array,
                         axis: str | None = None, block_s: int = 128,
-                        ag_method: str = "push") -> jax.Array:
+                        ag_method: str = "fused") -> jax.Array:
     """Sequence-parallel distributed flash-decode
     (analog of SpGQAFlashDecodeAttention.forward,
     sp_flash_decode_layer.py:78-184):
@@ -307,6 +405,12 @@ def sp_gqa_flash_decode(ctx: ShmemContext, q: jax.Array, k_cache: jax.Array,
                                  P(None, None, axis), P()),
                        out_specs=P(axis))
     packed = sm(q, k_cache, v_cache, global_kv_lens)   # [n, B, Hq, D+128]
+
+    if ag_method == "fused":
+        # latency path: one kernel does the partial AG and the streaming
+        # lse-merge (no gathered HBM round-trip, no second kernel launch)
+        return ll_ag_merge(ctx, packed, D, q.dtype, axis)
+
     g = all_gather(ctx, packed, axis=axis, method=ag_method)
 
     def merge(pk):
@@ -317,4 +421,4 @@ def sp_gqa_flash_decode(ctx: ShmemContext, q: jax.Array, k_cache: jax.Array,
 
 
 __all__ = ["gqa_decode_partial", "gqa_decode_paged", "decode_combine",
-           "sp_gqa_flash_decode"]
+           "ll_ag_merge", "sp_gqa_flash_decode"]
