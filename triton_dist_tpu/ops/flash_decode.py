@@ -308,7 +308,7 @@ def _ll_ag_merge_kernel(axis, mesh_axes, D, out_dtype,
         shd.wait_recv(ws_ref.at[seg], recv_sems.at[seg])
         pltpu.sync_copy(ws_ref.at[seg], buf)
         x = buf[...]
-        o, lse = x[..., :D], x[..., D:D + 1]   # [B,Hq,D], [B,Hq,1]
+        o, lse = x[..., :D], x[..., D:D + 1]   # [B*Hq,D], [B*Hq,1]
         if seg == 0:
             acc, m, denom = o, lse, jnp.ones_like(lse)
         else:
@@ -334,29 +334,33 @@ def ll_ag_merge(ctx: ShmemContext, packed: jax.Array, D: int,
 
     def f(pk):
         B, Hq, W = pk.shape[1:]
+        # flatten to 2-D rows: [B*Hq, W] keeps the sublane (second-minor)
+        # dim a row count Mosaic tiles cleanly; a 3-D [B, Hq<8, W] buffer
+        # silently mislays rows in VMEM↔HBM DMAs on real chips
+        R = B * Hq
         kernel = lambda *refs: _ll_ag_merge_kernel(
             axis, mesh_axes, D, out_dtype, *refs)
         out, _ws = pl.pallas_call(
             kernel,
             out_shape=(
-                jax.ShapeDtypeStruct((B, Hq, D), out_dtype),
-                jax.ShapeDtypeStruct((n, B, Hq, W), pk.dtype),  # arrival ws
+                jax.ShapeDtypeStruct((R, D), out_dtype),
+                jax.ShapeDtypeStruct((n, R, W), pk.dtype),  # arrival ws
             ),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
             out_specs=(pl.BlockSpec(memory_space=pl.ANY),
                        pl.BlockSpec(memory_space=pl.ANY)),
             scratch_shapes=[
-                pltpu.VMEM((B, Hq, W), pk.dtype),
-                pltpu.VMEM((B, Hq, D), out_dtype),
+                pltpu.VMEM((R, W), pk.dtype),
+                pltpu.VMEM((R, D), out_dtype),
                 pltpu.SemaphoreType.DMA((n,)),
                 pltpu.SemaphoreType.DMA((n,)),
             ],
             compiler_params=pltpu.CompilerParams(
                 has_side_effects=True,
-                collective_id=collective_id_for("ll_ag_merge")),
+                collective_id=collective_id_for(f"ll_ag_merge_{axis}")),
             interpret=default_interpret(),
-        )(pk[0])   # drop the leading rank dim: local block is [1, B, Hq, W]
-        return out
+        )(pk[0].reshape(R, W))  # local block is [1, B, Hq, W]
+        return out.reshape(B, Hq, D)
 
     sm = ctx.shard_map(f, in_specs=P(axis), out_specs=P(None))
     return sm(packed)

@@ -1,0 +1,15 @@
+"""Runnable per-op tutorials (analog of reference tutorials/01-10 and the
+per-op test entry scripts, test/nvidia/test_ag_gemm_intra_node.py:44-73).
+
+Each module runs standalone on a real TPU (any device count, including a
+single chip) or on a simulated multi-device CPU mesh:
+
+    python -m tutorials.t01_notify_wait --case correctness
+    python -m tutorials.t05_ag_gemm --case perf
+    python -m tutorials.t02_allgather --sim 4 --case correctness
+    python -m tutorials.t03_reduce_scatter --list
+
+``--sim N`` forces an N-device virtual CPU mesh (Pallas interpret mode) —
+the single-process cluster simulator the reference lacks (its tutorials
+need torchrun + real GPUs, tutorials/README.md:1-16).
+"""

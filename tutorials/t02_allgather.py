@@ -1,0 +1,78 @@
+"""Tutorial 02 — AllGather: full-mesh push, 1-D ring, hierarchical 2-D ring.
+
+Analog of reference tutorials/02 + kernels/nvidia/allgather.py. The push
+method is one hop (latency-optimal for small messages); the ring moves one
+segment per link per step (bandwidth-optimal); ring_2d runs ring-AG along
+the fast (minor) axis then along the slow (major) axis for multi-tier
+meshes.
+
+Run:  python -m tutorials.t02_allgather [--sim 6] [--case correctness|perf]
+"""
+
+from tutorials.common import (perf_report, register_case, time_op,
+                              tutorial_main, world_context)
+
+
+def _data(ctx, rows_per_rank=32):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    n = ctx.num_ranks
+    x = jax.random.normal(jax.random.key(0), (n * rows_per_rank, 256),
+                          jnp.float32)
+    return x, ctx.shard(x, P("x"))
+
+
+@register_case("correctness")
+def correctness():
+    import jax
+    import numpy as np
+
+    from triton_dist_tpu.ops import all_gather
+    ctx = world_context()
+    x, xs = _data(ctx)
+    for method in ("push", "ring"):
+        y = jax.jit(lambda v, m=method: all_gather(ctx, v, axis="x",
+                                                   method=m))(xs)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-6)
+        print(f"all_gather[{method}] == golden")
+
+
+@register_case("correctness_2d")
+def correctness_2d():
+    import jax
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from tutorials.common import world_size
+    from triton_dist_tpu.ops import all_gather
+    n_dev = world_size()
+    if n_dev < 4 or n_dev % 2:
+        raise SystemExit(f"need an even device count >= 4, have {n_dev} "
+                         "(try --sim 6)")
+    ctx = world_context(axis_names=("a", "b"), mesh_shape=(2, n_dev // 2))
+    import jax.numpy as jnp
+    x = jnp.arange(n_dev * 8 * 128, dtype=jnp.float32).reshape(n_dev * 8, 128)
+    xs = ctx.shard(x, P(("a", "b")))
+    y = jax.jit(lambda v: all_gather(ctx, v, method="ring_2d"))(xs)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+    print(f"hierarchical ring_2d over a (2, {n_dev // 2}) mesh == golden")
+
+
+@register_case("perf")
+def perf():
+    import jax
+
+    from triton_dist_tpu.ops import all_gather
+    ctx = world_context()
+    _, xs = _data(ctx, rows_per_rank=256)
+    for method in ("push", "ring"):
+        f = jax.jit(lambda v, m=method: all_gather(ctx, v, axis="x",
+                                                   method=m))
+        s = time_op(lambda: f(xs))
+        perf_report(f"all_gather[{method}]", s,
+                    f"({xs.nbytes / 1e6:.1f} MB global)")
+
+
+if __name__ == "__main__":
+    tutorial_main(__doc__)

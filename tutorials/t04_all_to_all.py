@@ -1,0 +1,92 @@
+"""Tutorial 04 — low-latency EP All-to-All dispatch/combine (+ fp8 wire).
+
+Analog of reference tutorials/04 + low_latency_all_to_all.py (the README
+showcase kernel: 137 µs vs DeepEP's 182 µs on 32 GPUs, fp8 + scale
+side-channel). Routing is a static-shape VPU cumsum (no atomic slot
+counters); the wire is one put per (peer, payload); fp8 mode quantizes
+tokens per-row with an f32 scale payload.
+
+Run:  python -m tutorials.t04_all_to_all [--sim 4]
+      python -m tutorials.t04_all_to_all --case correctness_fp8
+"""
+
+from tutorials.common import (perf_report, register_case, time_op,
+                              tutorial_main, world_context)
+
+
+def _roundtrip(ctx, wire_dtype=None):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from triton_dist_tpu.ops.all_to_all import (combine,
+                                                create_all_to_all_context,
+                                                dispatch)
+    n = ctx.num_ranks
+    T, H, topk = n * 16, 256, 2
+    a2a = create_all_to_all_context(ctx, max_tokens=T // n, hidden=H,
+                                    topk=topk, num_experts=2 * n, axis="x",
+                                    wire_dtype=wire_dtype)
+    tokens = jax.random.normal(jax.random.key(0), (T, H), jnp.float32
+                               ).astype(jnp.bfloat16)
+    ids = jax.random.randint(jax.random.key(1), (T, topk), 0, 2 * n)
+    w = jnp.ones((T, topk), jnp.float32) / topk
+
+    def run(t, i, ww):
+        recv, _, layout = dispatch(a2a, t, i)
+        return combine(a2a, recv, layout, ww)   # identity expert
+
+    out = jax.jit(run)(ctx.shard(tokens, P("x")), ctx.shard(ids, P("x")),
+                       ctx.shard(w, P("x")))
+    tol = 0.15 if wire_dtype is not None else 0.03
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(tokens, np.float32),
+                               rtol=tol, atol=tol)
+    return a2a
+
+
+@register_case("correctness")
+def correctness():
+    ctx = world_context()
+    a2a = _roundtrip(ctx)
+    print(f"dispatch→combine roundtrip over {a2a.n_ranks} PEs "
+          f"(cap={a2a.capacity}/pair) == identity")
+
+
+@register_case("correctness_fp8")
+def correctness_fp8():
+    import jax.numpy as jnp
+    ctx = world_context()
+    a2a = _roundtrip(ctx, wire_dtype=jnp.float8_e4m3fn)
+    print(f"fp8-wire roundtrip over {a2a.n_ranks} PEs within quantization "
+          "tolerance")
+
+
+@register_case("perf")
+def perf():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from triton_dist_tpu.ops.all_to_all import (create_all_to_all_context,
+                                                dispatch)
+    ctx = world_context()
+    n = ctx.num_ranks
+    # the DeepSeek-infer BASELINE shape (128 tok/rank, topk=8, h=7168)
+    T, H, topk, E = n * 128, 7168, 8, max(64, n)
+    a2a = create_all_to_all_context(ctx, max_tokens=T // n, hidden=H,
+                                    topk=topk, num_experts=E - E % n or n,
+                                    axis="x")
+    tokens = jax.random.normal(jax.random.key(0), (T, H), jnp.float32
+                               ).astype(jnp.bfloat16)
+    ids = jax.random.randint(jax.random.key(1), (T, topk), 0,
+                             a2a.num_experts)
+    f = jax.jit(lambda t, i: dispatch(a2a, t, i)[0])
+    s = time_op(lambda: f(ctx.shard(tokens, P("x")),
+                          ctx.shard(ids, P("x"))), iters=20)
+    perf_report("a2a dispatch (deepseek-infer shape)", s)
+
+
+if __name__ == "__main__":
+    tutorial_main(__doc__)
