@@ -17,19 +17,11 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
-_FLAG = "--xla_force_host_platform_device_count=8"
-if _FLAG not in os.environ.get("XLA_FLAGS", ""):
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
-
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-import jax._src.xla_bridge as _xb  # noqa: E402
+from triton_dist_tpu.utils.env import force_virtual_cpu_devices  # noqa: E402
 
-try:
-    _xb._clear_backends()
-except Exception:
-    pass
+force_virtual_cpu_devices(8, skip_if_satisfied=False)
 
 assert jax.device_count() == 8, (
     f"expected 8 virtual CPU devices, got {jax.devices()}"

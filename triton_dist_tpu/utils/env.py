@@ -16,6 +16,45 @@ import jax
 from jax.experimental.pallas import tpu as pltpu
 
 
+def force_virtual_cpu_devices(n: int, skip_if_satisfied: bool = True) -> None:
+    """Re-point jax at an ``n``-device virtual CPU platform, clearing any
+    live backend (the container's sitecustomize eagerly initializes a TPU
+    backend at interpreter start, so env vars alone are not enough). The
+    single shared copy of this order-sensitive recipe — used by
+    ``__graft_entry__``, ``tests/conftest`` and the tutorials' ``--sim``.
+
+    Order matters: drop the cached backends (including the memoized
+    ``get_backend`` — ``_clear_backends`` alone does not clear it on
+    jax>=0.9) BEFORE the config updates; ``jax_num_cpu_devices`` refuses to
+    change once it believes backends are live.
+
+    ``skip_if_satisfied``: no-op when the current platform already exposes
+    ``n`` devices (any platform — used by dryruns that accept real chips);
+    pass False to force the CPU simulator unconditionally."""
+    if skip_if_satisfied:
+        try:
+            if len(jax.devices()) >= n:
+                return
+        except Exception:
+            pass
+    import jax._src.xla_bridge as xb
+    try:
+        xb._clear_backends()
+        xb.get_backend.cache_clear()
+    except Exception:
+        pass
+    flag = f"--xla_force_host_platform_device_count={n}"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except Exception:
+        pass
+    backend_platform.cache_clear()
+
+
 @lru_cache(None)
 def backend_platform() -> str:
     return jax.devices()[0].platform.lower()

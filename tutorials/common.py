@@ -5,7 +5,6 @@ test/nvidia/test_ag_gemm_intra_node.py:44-73, plus ``--list``)."""
 from __future__ import annotations
 
 import argparse
-import os
 
 _CASES: dict = {}
 _SIM_WORLD: list = []   # set by --sim: mesh size (may be < device count)
@@ -19,30 +18,13 @@ def register_case(name: str):
 
 
 def _force_sim(n: int) -> None:
-    """Re-point jax at a virtual CPU platform BEFORE first use (same recipe
-    as __graft_entry__/tests/conftest — the container may have eagerly
-    initialized a TPU backend). More devices than mesh participants are
+    """Switch to the CPU simulator. More devices than mesh participants are
     created: the interpreter's device threads can deadlock in its internal
     allocator when every thread simultaneously blocks in a barrier (see
     tests/conftest.py), so the mesh runs over a prefix subset."""
     _SIM_WORLD.append(n)
-    n = max(8, n + 2)
-    flag = f"--xla_force_host_platform_device_count={n}"
-    if flag not in os.environ.get("XLA_FLAGS", ""):
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
-    import jax
-    import jax._src.xla_bridge as xb
-    try:
-        xb._clear_backends()
-        xb.get_backend.cache_clear()
-    except Exception:
-        pass
-    jax.config.update("jax_platforms", "cpu")
-    try:
-        jax.config.update("jax_num_cpu_devices", n)
-    except Exception:
-        pass
+    from triton_dist_tpu.utils.env import force_virtual_cpu_devices
+    force_virtual_cpu_devices(max(8, n + 2), skip_if_satisfied=False)
 
 
 def tutorial_main(description: str, default_case: str = "correctness"):
@@ -96,7 +78,12 @@ def world_size() -> int:
 
 def world_context(axis_names=("x",), mesh_shape=None):
     from triton_dist_tpu.shmem.context import initialize_distributed
-    if mesh_shape is None and len(axis_names) == 1:
+    if mesh_shape is None:
+        if len(axis_names) != 1:
+            raise ValueError(
+                "world_context needs an explicit mesh_shape for multi-axis "
+                f"meshes (axis_names={axis_names}) — the --sim world size "
+                "cannot be factorized implicitly")
         mesh_shape = (world_size(),)
     return initialize_distributed(axis_names=axis_names,
                                   mesh_shape=mesh_shape)

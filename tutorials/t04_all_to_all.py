@@ -82,9 +82,10 @@ def perf():
                                ).astype(jnp.bfloat16)
     ids = jax.random.randint(jax.random.key(1), (T, topk), 0,
                              a2a.num_experts)
+    ts = ctx.shard(tokens, P("x"))
+    ids_s = ctx.shard(ids, P("x"))
     f = jax.jit(lambda t, i: dispatch(a2a, t, i)[0])
-    s = time_op(lambda: f(ctx.shard(tokens, P("x")),
-                          ctx.shard(ids, P("x"))), iters=20)
+    s = time_op(lambda: f(ts, ids_s), iters=20)
     perf_report("a2a dispatch (deepseek-infer shape)", s)
 
 
