@@ -21,16 +21,19 @@ import jax  # noqa: E402
 
 from triton_dist_tpu.utils.env import force_virtual_cpu_devices  # noqa: E402
 
-force_virtual_cpu_devices(8, skip_if_satisfied=False)
+force_virtual_cpu_devices(12, skip_if_satisfied=False)
 
-assert jax.device_count() == 8, (
-    f"expected 8 virtual CPU devices, got {jax.devices()}"
+assert jax.device_count() == 12, (
+    f"expected 12 virtual CPU devices, got {jax.devices()}"
 )
 
-# NOTE: kernel tests build meshes over a 4-device *subset* of the 8 virtual
-# devices. On a single-core host, the Pallas TPU interpreter's device threads
-# can deadlock nondeterministically when >=7 of them block in semaphore
-# waits/barriers concurrently (threads pile up in the interpreter's internal
-# _barrier/_allocate_buffer); <=6 participating devices is reliable. The
-# kernels themselves are rank-count-generic.
+# NOTE: kernel tests build meshes over a *subset* of the 12 virtual devices.
+# The Pallas TPU interpreter's device threads can deadlock when every device
+# thread simultaneously blocks in semaphore waits/barriers (threads pile up
+# in the interpreter's internal _barrier/_allocate_buffer); keeping spare
+# non-participating devices avoids it — 8 participants out of 12 devices is
+# verified reliable, 8/8 is not. Most tests use a 4-way mesh for speed;
+# TEST_WORLD_WIDE exercises the driver's exact 8-way configuration
+# (tests/test_eight_way.py).
 TEST_WORLD = 4
+TEST_WORLD_WIDE = 8

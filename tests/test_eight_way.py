@@ -1,0 +1,103 @@
+"""Kernel tests at the driver's exact 8-way configuration.
+
+The multichip dryrun covers the pure-XLA training path at 8 devices; this
+module runs the hand-written Pallas collectives and overlap kernels on an
+8-participant mesh (over 12 virtual devices — see conftest on why spare
+device threads are required)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from conftest import TEST_WORLD_WIDE
+from triton_dist_tpu.ops import all_gather, reduce_scatter
+from triton_dist_tpu.ops.all_to_all import (combine,
+                                            create_all_to_all_context,
+                                            dispatch)
+from triton_dist_tpu.ops.allgather_gemm import ag_gemm
+from triton_dist_tpu.ops.flash_decode import sp_gqa_flash_decode
+from triton_dist_tpu.ops.gemm import GemmConfig
+from triton_dist_tpu.shmem.context import initialize_distributed
+from triton_dist_tpu.utils import assert_allclose
+
+
+@pytest.fixture(scope="module")
+def ctx8():
+    return initialize_distributed(axis_names=("x",),
+                                  mesh_shape=(TEST_WORLD_WIDE,))
+
+
+@pytest.mark.parametrize("method", ["push", "ring"])
+def test_all_gather_8way(ctx8, method):
+    n = ctx8.num_ranks
+    x = jax.random.normal(jax.random.key(0), (n * 8, 128), jnp.float32)
+    xs = ctx8.shard(x, P("x"))
+    y = jax.jit(lambda v: all_gather(ctx8, v, axis="x", method=method))(xs)
+    assert_allclose(np.asarray(y), np.asarray(x))
+
+
+def test_reduce_scatter_8way(ctx8):
+    n = ctx8.num_ranks
+    x = jnp.round(jax.random.normal(jax.random.key(1), (n * 8, 128)) * 4)
+    xs = ctx8.shard(x.astype(jnp.float32), P("x"))
+    got = jax.jit(lambda v: reduce_scatter(ctx8, v, axis="x"))(xs)
+    gold = jax.jit(ctx8.shard_map(
+        lambda s: jax.lax.psum_scatter(s, "x", scatter_dimension=0,
+                                       tiled=True),
+        in_specs=P("x"), out_specs=P("x")))(xs)
+    assert_allclose(np.asarray(got), np.asarray(gold))
+
+
+def test_ag_gemm_8way(ctx8):
+    n = ctx8.num_ranks
+    M = K = 8 * n
+    N = 128 * n
+    a = jax.random.normal(jax.random.key(0), (M, K), jnp.float32)
+    b = jax.random.normal(jax.random.key(1), (K, N), jnp.float32)
+    cfg = GemmConfig(M // n, 128)
+    c = jax.jit(lambda u, v: ag_gemm(ctx8, u, v, axis="x", cfg=cfg))(
+        ctx8.shard(a, P("x")), ctx8.shard(b, P(None, "x")))
+    assert_allclose(np.asarray(c, np.float32), np.asarray(a @ b),
+                    rtol=5e-2, atol=5e-1)
+
+
+def test_a2a_roundtrip_8way(ctx8):
+    n = ctx8.num_ranks
+    T, H, topk = n * 4, 128, 2
+    a2a = create_all_to_all_context(ctx8, max_tokens=T // n, hidden=H,
+                                    topk=topk, num_experts=2 * n, axis="x")
+    t = jax.random.normal(jax.random.key(2), (T, H), jnp.float32
+                          ).astype(jnp.bfloat16)
+    ids = jax.random.randint(jax.random.key(3), (T, topk), 0, 2 * n)
+    w = jnp.ones((T, topk), jnp.float32) / topk
+
+    def roundtrip(tt, ii, ww):
+        recv, _, layout = dispatch(a2a, tt, ii)
+        return combine(a2a, recv, layout, ww)
+
+    out = jax.jit(roundtrip)(ctx8.shard(t, P("x")), ctx8.shard(ids, P("x")),
+                             ctx8.shard(w, P("x")))
+    assert_allclose(np.asarray(out, np.float32), np.asarray(t, np.float32),
+                    rtol=3e-2, atol=3e-2)
+
+
+def test_sp_decode_fused_8way(ctx8):
+    n = ctx8.num_ranks
+    B, Hq, Hkv, D, s_local = 1, 4, 2, 128, 64
+    S = n * s_local
+    q = jax.random.normal(jax.random.key(0), (B, Hq, D), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (B, Hkv, S, D), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (B, Hkv, S, D), jnp.float32)
+    kv = jnp.array([S], jnp.int32)
+    out = jax.jit(lambda *a: sp_gqa_flash_decode(ctx8, *a,
+                                                 ag_method="fused"))(
+        q, ctx8.shard(k, P(None, None, "x")),
+        ctx8.shard(v, P(None, None, "x")), kv)
+    # golden via the generic push path (independently tested vs dense)
+    gold = jax.jit(lambda *a: sp_gqa_flash_decode(ctx8, *a,
+                                                  ag_method="push"))(
+        q, ctx8.shard(k, P(None, None, "x")),
+        ctx8.shard(v, P(None, None, "x")), kv)
+    assert_allclose(np.asarray(out), np.asarray(gold), atol=1e-4, rtol=1e-4)

@@ -136,3 +136,29 @@ def test_dispatch_2d_placement(ctx2d):
             e = int(idn[row, j])
             expect.append((e // epr, e % epr, float(row)))
     assert sorted(got) == sorted(expect)
+
+
+@pytest.fixture(scope="module")
+def ctx3d():
+    return initialize_distributed(axis_names=("a", "b", "c"),
+                                  mesh_shape=(2, 2, 2))
+
+
+def test_all_gather_3d(ctx3d):
+    """3-tier hierarchical AG on a (2,2,2) mesh (slice, torus-y, torus-x)."""
+    from triton_dist_tpu.ops import all_gather
+    x = jnp.arange(8 * 8 * 128, dtype=jnp.float32).reshape(8 * 8, 128)
+    xs = ctx3d.shard(x, P(("a", "b", "c")))
+    y = jax.jit(lambda v: all_gather(ctx3d, v, method="ring_2d"))(xs)
+    assert_allclose(np.asarray(y), np.asarray(x))
+
+
+def test_reduce_scatter_3d(ctx3d):
+    x = jnp.round(jax.random.normal(jax.random.key(5), (8 * 16, 128)) * 4)
+    xs = ctx3d.shard(x.astype(jnp.float32), P(("a", "b", "c")))
+    got = jax.jit(lambda v: reduce_scatter(ctx3d, v))(xs)
+    gold = jax.jit(ctx3d.shard_map(
+        lambda s: jax.lax.psum_scatter(s, ("a", "b", "c"),
+                                       scatter_dimension=0, tiled=True),
+        in_specs=P(("a", "b", "c")), out_specs=P(("a", "b", "c"))))(xs)
+    assert_allclose(np.asarray(got), np.asarray(gold))

@@ -160,22 +160,25 @@ def all_gather(ctx: ShmemContext, x: jax.Array, axis: str | None = None,
 
 
 def _ag_ring_2d(ctx: ShmemContext, x: jax.Array):
-    """Hierarchical AG over a 2-axis mesh (major, minor): ring along the
-    minor axis (gathering my major-row's shards into a contiguous
-    super-segment), then ring of super-segments along the major axis. The
-    minor axis should be the faster interconnect tier (ICI), the major the
-    slower (DCN/inter-slice), matching the reference's NUMA/internode split
-    (allgather.py:194-375). Both stages run inside one shard_map — the
-    intermediate is only row-replicated, never mesh-replicated."""
-    major, minor = ctx.axis_names[0], ctx.axis_names[1]
+    """Hierarchical AG over a multi-axis mesh, innermost axis first: ring
+    along the minor axis (gathering my row's shards into a contiguous
+    super-segment), then rings of super-segments along each outer axis in
+    turn. Works for any axis count >= 2 — e.g. (slice-major, torus-y,
+    torus-x). The innermost axis should be the fastest interconnect tier
+    (ICI), the outermost the slowest (DCN/inter-slice), matching the
+    reference's NUMA/internode split (allgather.py:194-375) and its 3-D
+    hierarchical push (low_latency_allgather.py:345-530). All stages run
+    inside one shard_map — intermediates are only partially replicated,
+    never mesh-replicated."""
     mesh_axes = ctx.axis_names
-    n_major, n_minor = ctx.axis_size(major), ctx.axis_size(minor)
 
     def f(shard):
-        row = _ag_call(minor, mesh_axes, n_minor, "ring", shard)
-        return _ag_call(major, mesh_axes, n_major, "ring", row)
+        out = shard
+        for axis in reversed(mesh_axes):
+            out = _ag_call(axis, mesh_axes, ctx.axis_size(axis), "ring", out)
+        return out
 
-    sm = ctx.shard_map(f, in_specs=P((major, minor)),
+    sm = ctx.shard_map(f, in_specs=P(mesh_axes),
                        out_specs=P(*([None] * x.ndim)))
     return sm(x)
 

@@ -112,17 +112,17 @@ def reduce_scatter(ctx: ShmemContext, x: jax.Array, axis: str | None = None,
     shard_map.
 
     ``method`` ∈ auto|ring|ring_2d. With ``axis=None`` on a multi-axis mesh
-    (or ``method="ring_2d"``), runs the 2-D hierarchical RS over
-    (major, minor) — the multi-tier analog of the reference's 2-D RS
-    (reduce_scatter.py:430-785: intra-node scatter + per-node reduce +
-    inter-node tier). The minor axis should be the faster tier (ICI)."""
+    (or ``method="ring_2d"``), runs the hierarchical RS over ALL mesh axes,
+    innermost (fastest tier, ICI) first — the multi-tier analog of the
+    reference's 2-D RS (reduce_scatter.py:430-785: intra-node scatter +
+    per-node reduce + inter-node tier), generalized to any axis count."""
     if method == "auto":
         method = "ring_2d" if (axis is None and len(ctx.axis_names) > 1) \
             else "ring"
     if method == "ring_2d":
         if axis is not None:
             raise ValueError(
-                "ring_2d reduce_scatter spans the full (major, minor) mesh; "
+                "ring_2d reduce_scatter spans ALL mesh axes; "
                 f"it cannot take axis={axis!r} — use method='ring' for a "
                 "single-axis RS")
         if len(ctx.axis_names) < 2:
@@ -142,33 +142,39 @@ def reduce_scatter(ctx: ShmemContext, x: jax.Array, axis: str | None = None,
 
 
 def _rs_ring_2d(ctx: ShmemContext, x: jax.Array):
-    """Hierarchical RS over a (major, minor) mesh: ring-RS along the minor
-    (fast) axis first, then ring-RS of the surviving super-segment along the
-    major (slow) axis — each row crosses the slow tier exactly once, already
-    minor-reduced (the reference's intra-node-reduce-then-inter-node
-    structure, reduce_scatter.py:430-785).
+    """Hierarchical RS over a multi-axis mesh: ring-RS along the minor
+    (fast) axis first, then ring-RS of the surviving super-segment along
+    each outer axis in turn — each row crosses a slower tier exactly once,
+    already reduced over all faster tiers (the reference's
+    intra-node-reduce-then-inter-node structure, reduce_scatter.py:430-785).
+    Works for any axis count >= 2.
 
-    Device (a, b) must end up owning global segment ``a*n_minor + b`` (the
-    P((major, minor)) layout), but the natural stage order leaves it with
-    segment ``b*n_major + a`` — so each contribution's segments are
-    pre-permuted (a VPU-local transpose) before the rings."""
-    major, minor = ctx.axis_names[0], ctx.axis_names[1]
+    Device (c0, …, c_{k-1}) must end up owning the row-major P(mesh_axes)
+    segment, but peeling stages innermost-first leaves it with the
+    reversed-order segment — so each contribution's segment blocks are
+    pre-permuted (a VPU-local transpose to [n_{k-1}, …, n0, seg] order)
+    before the rings; stage j then peels the leading dim by the j-th
+    innermost axis."""
     mesh_axes = ctx.axis_names
-    n_major, n_minor = ctx.axis_size(major), ctx.axis_size(minor)
-    n = n_major * n_minor
+    sizes = [ctx.axis_size(a) for a in mesh_axes]
+    n = 1
+    for s in sizes:
+        n *= s
 
     def f(shard):
         M = shard.shape[0]
         assert M % n == 0, (M, n)
         seg = M // n
-        # [n_major, n_minor, seg, ...] -> minor-major segment order
-        xr = shard.reshape((n_major, n_minor, seg) + shard.shape[1:])
-        xr = jnp.swapaxes(xr, 0, 1).reshape(shard.shape)
-        part = _rs_call(minor, mesh_axes, n_minor, xr)
-        return _rs_call(major, mesh_axes, n_major, part)
+        k = len(sizes)
+        xr = shard.reshape(tuple(sizes) + (seg,) + shard.shape[1:])
+        xr = jnp.transpose(
+            xr, tuple(range(k - 1, -1, -1)) + tuple(range(k, xr.ndim)))
+        out = xr.reshape(shard.shape)
+        for axis in reversed(mesh_axes):
+            out = _rs_call(axis, mesh_axes, ctx.axis_size(axis), out)
+        return out
 
-    sm = ctx.shard_map(f, in_specs=P((major, minor)),
-                       out_specs=P((major, minor)))
+    sm = ctx.shard_map(f, in_specs=P(mesh_axes), out_specs=P(mesh_axes))
     return sm(x)
 
 
