@@ -142,6 +142,55 @@ def test_ag_gemm_race_free_under_detector(ctx, monkeypatch):
     _assert_detector_ran_clean("ag_gemm")
 
 
+def test_fused_moe_race_free_under_detector(ctx, monkeypatch):
+    from triton_dist_tpu.ops.moe import ag_moe_group_gemm
+    monkeypatch.setenv("TDT_DETECT_RACES", "1")
+    n = ctx.num_ranks
+    E, H, N, T = 4, 128, n * 128, n * 32
+    tokens = jax.random.normal(jax.random.key(0), (T, H), jnp.float32)
+    ids = jax.random.randint(jax.random.key(1), (T,), 0, E)
+    w = jax.random.normal(jax.random.key(2), (E, H, N), jnp.float32) * 0.1
+    out = jax.jit(lambda t, i, ww: ag_moe_group_gemm(
+        ctx, ctx.shard(t, P("x")), ctx.shard(i, P("x")),
+        ctx.shard(ww, P(None, None, "x")), block_m=32))(tokens, ids, w)
+    jax.block_until_ready(out)
+    _assert_detector_ran_clean("ag_moe_group_gemm")
+
+
+def test_a2a_and_fused_decode_race_free_under_detector(ctx, monkeypatch):
+    from triton_dist_tpu.ops.flash_decode import sp_gqa_flash_decode
+    monkeypatch.setenv("TDT_DETECT_RACES", "1")
+    n = ctx.num_ranks
+    T, H, topk = n * 8, 128, 2
+    a2a = create_all_to_all_context(ctx, max_tokens=T // n, hidden=H,
+                                    topk=topk, num_experts=2 * n, axis="x")
+    t = jax.random.normal(jax.random.key(0), (T, H), jnp.float32
+                          ).astype(jnp.bfloat16)
+    ids = jax.random.randint(jax.random.key(1), (T, topk), 0, 2 * n)
+    w = jnp.ones((T, topk), jnp.float32) / topk
+
+    def roundtrip(tt, ii, ww):
+        recv, _, layout = dispatch(a2a, tt, ii)
+        return combine(a2a, recv, layout, ww)
+
+    out = jax.jit(roundtrip)(ctx.shard(t, P("x")), ctx.shard(ids, P("x")),
+                             ctx.shard(w, P("x")))
+    jax.block_until_ready(out)
+    _assert_detector_ran_clean("a2a dispatch/combine")
+
+    B, Hq, Hkv, D, s_local = 1, 4, 2, 128, 64
+    S = n * s_local
+    q = jax.random.normal(jax.random.key(2), (B, Hq, D), jnp.float32)
+    k = jax.random.normal(jax.random.key(3), (B, Hkv, S, D), jnp.float32)
+    v = jax.random.normal(jax.random.key(4), (B, Hkv, S, D), jnp.float32)
+    out2 = jax.jit(lambda *a: sp_gqa_flash_decode(ctx, *a,
+                                                  ag_method="fused"))(
+        q, ctx.shard(k, P(None, None, "x")), ctx.shard(v, P(None, None, "x")),
+        jnp.array([S], jnp.int32))
+    jax.block_until_ready(out2)
+    _assert_detector_ran_clean("fused sp decode")
+
+
 # -- producer-delay noise fuzzing (TDT_NOISE) -------------------------------
 
 def test_all_gather_correct_under_noise(ctx, monkeypatch):

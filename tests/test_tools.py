@@ -124,3 +124,39 @@ def test_autotuned_overlap_ops():
     for r in range(n):
         ref += a_np[:, r*(K//n):(r+1)*(K//n)] @ b_np[r*(K//n):(r+1)*(K//n)]
     np.testing.assert_allclose(np.asarray(c2), ref, atol=1e-3, rtol=1e-3)
+
+
+def test_autotuned_moe_ops():
+    """Autotuned fused MoE ops pick a valid block_m and stay correct."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from triton_dist_tpu.ops.autotuned import (ag_moe_group_gemm_autotuned,
+                                               moe_reduce_rs_autotuned)
+    from triton_dist_tpu.shmem.context import initialize_distributed
+
+    ctx = initialize_distributed(axis_names=("x",), mesh_shape=(TEST_WORLD,))
+    n = ctx.num_ranks
+    E, H, N, T = 4, 128, n * 128, n * 32
+    tokens = jax.random.normal(jax.random.key(0), (T, H), jnp.float32)
+    ids = jax.random.randint(jax.random.key(1), (T,), 0, E)
+    w = jax.random.normal(jax.random.key(2), (E, H, N), jnp.float32) * 0.1
+    out = ag_moe_group_gemm_autotuned(ctx, ctx.shard(tokens, P("x")),
+                                      ctx.shard(ids, P("x")),
+                                      ctx.shard(w, P(None, None, "x")), "x")
+    t, idn, wn = np.asarray(tokens), np.asarray(ids), np.asarray(w)
+    gold = np.stack([t[r] @ wn[idn[r]] for r in range(T)])
+    np.testing.assert_allclose(np.asarray(out), gold, atol=1e-3, rtol=1e-3)
+
+    topk = 2
+    K2, N2, T2 = n * 32, 64, n * 8
+    tok2 = jax.random.normal(jax.random.key(3), (T2 * topk, K2), jnp.float32)
+    ids2 = jax.random.randint(jax.random.key(4), (T2 * topk,), 0, E)
+    tw = jax.nn.softmax(jax.random.normal(jax.random.key(5), (T2, topk)), -1)
+    w2 = jax.random.normal(jax.random.key(6), (E, K2, N2), jnp.float32) * 0.1
+    out2 = moe_reduce_rs_autotuned(ctx, ctx.shard(tok2, P(None, "x")), ids2,
+                                   tw, ctx.shard(w2, P(None, "x", None)), "x")
+    t2, id2n, w2n = np.asarray(tok2), np.asarray(ids2), np.asarray(w2)
+    rows = np.stack([t2[r] @ w2n[id2n[r]] for r in range(T2 * topk)])
+    gold2 = (rows.reshape(T2, topk, N2) * np.asarray(tw)[..., None]).sum(1)
+    np.testing.assert_allclose(np.asarray(out2), gold2, atol=1e-3, rtol=1e-3)

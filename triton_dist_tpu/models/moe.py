@@ -255,6 +255,65 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
     return a2a_layer.combine(processed, layout, gate_vals)
 
 
+def moe_mlp_tp_overlap(ctx: ShmemContext, x2d: jax.Array,
+                       router_w: jax.Array, we_up: jax.Array,
+                       we_down: jax.Array, topk: int,
+                       axis: str | None = None,
+                       block_m: int = 128) -> jax.Array:
+    """The reference's MoE-TP inference block on the FUSED overlap kernels
+    (test_ag_moe + test_moe_reduce_rs composed, the
+    "AG+GroupGEMM → GroupGEMM+topk-reduce+RS" pipeline of
+    allgather_group_gemm.py + moe_reduce_rs.py):
+
+    1. router → top-k experts per token,
+    2. ``ag_moe_group_gemm``: tokens allgathered across the TP group while
+       the grouped up-projection streams arrived segments (weights
+       column-sharded [E, D, F] P(None, None, axis)),
+    3. activation,
+    4. ``moe_reduce_rs``: grouped down-projection on the F-shard
+       (weights row-sharded [E, F, D] P(None, axis, None)) ring-scattered
+       to token owners with the topk-weighted fold at the end.
+
+    x2d [T, D] sharded P(axis) on T; returns [T, D] sharded P(axis).
+    Every (token, k) pair is one row through both grouped GEMMs — the
+    reference's row expansion (moe_reduce_rs.py select_experts)."""
+    from triton_dist_tpu.ops.moe import ag_moe_group_gemm, moe_reduce_rs
+
+    axis = axis or ctx.axis_names[0]
+    n = ctx.axis_size(axis)
+    T, D = x2d.shape
+    k = topk
+
+    logits = x2d.astype(jnp.float32) @ router_w
+    gate_vals, gate_ids = lax.top_k(jax.nn.softmax(logits, -1), k)
+    gate_vals = gate_vals / jnp.sum(gate_vals, -1, keepdims=True)
+
+    # one row per (token, k) pair, keeping rows of one token adjacent
+    def expand(x_shard, ids_shard):
+        rep = jnp.repeat(x_shard[:, None, :], k, axis=1).reshape(-1, D)
+        return rep, ids_shard.reshape(-1)
+
+    rep, ids_flat = ctx.shard_map(
+        expand, in_specs=(P(axis), P(axis)),
+        out_specs=(P(axis), P(axis)))(x2d, gate_ids)
+
+    # up-projection overlapped with the token allgather; output
+    # [T*k, F] sharded P(None, axis)
+    h = ag_moe_group_gemm(ctx, rep, ids_flat, we_up, axis=axis,
+                          block_m=block_m)
+    h = jax.nn.silu(h.astype(jnp.float32)).astype(x2d.dtype)
+
+    # moe_reduce_rs needs the replicated global row→expert map; the fused
+    # kernel path gathered it internally already, here once more for the
+    # second stage (control-plane-sized: T*k ints)
+    ids_rep = ctx.shard_map(
+        lambda i: lax.all_gather(i, axis, tiled=True),
+        in_specs=P(axis), out_specs=P(None))(ids_flat)
+
+    return moe_reduce_rs(ctx, h, ids_rep, gate_vals, we_down, axis=axis,
+                         block_m=block_m)
+
+
 __all__ = ["MoEConfig", "init_moe_params", "moe_param_specs",
            "moe_mlp_gshard", "moe_block_apply", "moe_forward",
-           "moe_mlp_ep_overlap"]
+           "moe_mlp_ep_overlap", "moe_mlp_tp_overlap"]

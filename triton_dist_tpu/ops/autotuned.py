@@ -68,4 +68,53 @@ def gemm_rs_autotuned(ctx: ShmemContext, a: jax.Array, b: jax.Array,
     return _rs_jit(ctx, a, b, axis=axis, cfg=cfg, out_dtype=out_dtype)
 
 
-__all__ = ["ag_gemm_autotuned", "gemm_rs_autotuned"]
+_MOE_BLOCK_CANDIDATES = [32, 64, 128, 256]
+
+
+def _moe_vmem_ok(bm: int, k_local: int, itemsize: int) -> bool:
+    # VMEM per pipeline step: token block + one expert tile + out block,
+    # double-buffered (same budget rule as GemmConfig.vmem_ok)
+    return 2 * itemsize * (bm * k_local + k_local * 128
+                           + bm * 128) <= 12 * 2**20
+
+
+def _prune_moe_ag(bm: int, args, kw) -> bool:
+    tokens = args[1]   # [T, H] sharded on T — each device holds full H rows
+    return _moe_vmem_ok(bm, tokens.shape[-1],
+                        jnp.dtype(tokens.dtype).itemsize)
+
+
+def _prune_moe_rs(bm: int, args, kw) -> bool:
+    ctx, tokens = args[0], args[1]   # [T*topk, K] sharded P(None, axis) on K
+    axis = (args[5] if len(args) > 5 and args[5] is not None
+            else kw.get("axis")) or ctx.axis_names[0]
+    k_local = tokens.shape[-1] // ctx.axis_size(axis)
+    return _moe_vmem_ok(bm, k_local, jnp.dtype(tokens.dtype).itemsize)
+
+
+from triton_dist_tpu.ops.moe import (ag_moe_group_gemm,  # noqa: E402
+                                     moe_reduce_rs)
+
+_moe_ag_jit = jax.jit(ag_moe_group_gemm, static_argnums=(0,),
+                      static_argnames=("axis", "block_m"))
+_moe_rs_jit = jax.jit(moe_reduce_rs, static_argnums=(0,),
+                      static_argnames=("axis", "block_m"))
+
+
+@contextual_autotune(configs=_MOE_BLOCK_CANDIDATES, prune=_prune_moe_ag)
+def ag_moe_group_gemm_autotuned(ctx: ShmemContext, tokens, ids, weights,
+                                axis: str | None = None, cfg=None):
+    """``ag_moe_group_gemm`` with the grouped-GEMM block size tuned per
+    shape (cfg = block_m), reference-style (docs/autotuner.md)."""
+    return _moe_ag_jit(ctx, tokens, ids, weights, axis=axis, block_m=cfg)
+
+
+@contextual_autotune(configs=_MOE_BLOCK_CANDIDATES, prune=_prune_moe_rs)
+def moe_reduce_rs_autotuned(ctx: ShmemContext, tokens, ids, topk_weights,
+                            weights, axis: str | None = None, cfg=None):
+    return _moe_rs_jit(ctx, tokens, ids, topk_weights, weights, axis=axis,
+                       block_m=cfg)
+
+
+__all__ = ["ag_gemm_autotuned", "gemm_rs_autotuned",
+           "ag_moe_group_gemm_autotuned", "moe_reduce_rs_autotuned"]
