@@ -280,8 +280,7 @@ def moe_mlp_tp_overlap(ctx: ShmemContext, x2d: jax.Array,
     from triton_dist_tpu.ops.moe import ag_moe_group_gemm, moe_reduce_rs
 
     axis = axis or ctx.axis_names[0]
-    n = ctx.axis_size(axis)
-    T, D = x2d.shape
+    D = x2d.shape[1]
     k = topk
 
     logits = x2d.astype(jnp.float32) @ router_w

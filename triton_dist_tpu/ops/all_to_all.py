@@ -225,29 +225,31 @@ def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
 
     def build(tok_shard, ids_shard):
         dest, slot, valid = route_tokens(a2a, ids_shard)
-        send_buf = jnp.zeros((n, cap, H), wire or a2a.dtype)
-        send_ids = jnp.full((n, id_cols), -1, jnp.int32)
-        if wire is not None:
-            # quantize the T unique tokens once, then fan out topk copies
-            q, s = _quant(tok_shard, wire)
-            tok_rep = jnp.repeat(q[:, None, :], k, axis=1).reshape(-1, H)
-            scales = jnp.repeat(s[:, None], k, axis=1).reshape(-1)
-        else:
-            tok_rep = jnp.repeat(tok_shard[:, None, :], k, axis=1
-                                 ).reshape(-1, H).astype(a2a.dtype)
+        T = tok_shard.shape[0]
         d_f, s_f, v_f = (x.reshape(-1) for x in (dest, slot, valid))
         # over-capacity tokens get an out-of-bounds slot -> dropped by the
         # scatter (never clobbering a valid slot)
         s_drop = jnp.where(v_f, s_f, cap)
         local_eid = (ids_shard % a2a.experts_per_rank).reshape(-1)
-        send_buf = send_buf.at[d_f, s_drop].set(tok_rep, mode="drop")
-        send_ids = send_ids.at[d_f, s_drop].set(local_eid, mode="drop")
+
+        src = _slot_src_map(d_f, s_drop,
+                            jnp.arange(T * k, dtype=jnp.int32) // k,
+                            n, cap, T)
+        if wire is not None:
+            # quantize the T unique tokens once; scales ride the same map
+            q, s = _quant(tok_shard, wire)
+            send_buf = _slot_gather(q, src, wire)
+            sc = _slot_gather(s[:, None], src, jnp.float32)[..., 0]
+            send_sc = jnp.ones((n, id_cols), jnp.float32).at[:, :cap].set(
+                jnp.where(src < T, sc, 1.0))
+        else:
+            send_buf = _slot_gather(tok_shard, src, a2a.dtype)
+        send_ids = jnp.full((n, id_cols), -1, jnp.int32).at[
+            d_f, s_drop].set(local_eid, mode="drop")
         # wire format: [n, rows, 128] so the per-peer DMA slice is
         # lane-aligned on real TPUs
         outs = (send_buf, send_ids.reshape(n, id_cols // 128, 128))
         if wire is not None:
-            send_sc = jnp.ones((n, id_cols), jnp.float32).at[
-                d_f, s_drop].set(scales, mode="drop")
             outs += (send_sc.reshape(n, -1, 128),)
         return outs + (dest, slot, valid)
 
@@ -330,6 +332,26 @@ def _cap_round(cap: int, wire_itemsize: int = 2) -> int:
     DMA slices meet Mosaic's tiling alignment."""
     mult = 32 // wire_itemsize
     return (cap + mult - 1) // mult * mult
+
+
+def _slot_src_map(dest_flat, slot_drop, src_rows, n_dst, cap, n_rows):
+    """slot -> source-row map: a small int scatter ([n_dst, cap]); unfilled
+    slots hold ``n_rows`` (out of range)."""
+    return jnp.full((n_dst, cap), n_rows, jnp.int32).at[
+        dest_flat, slot_drop].set(src_rows, mode="drop")
+
+
+def _slot_gather(rows, src, out_dtype):
+    """Build a [n_dst, cap, H] send buffer by gathering ``rows`` [R, H]
+    through the slot->source-row map ``src`` [n_dst, cap] (value R =
+    unfilled -> zeros). One gather instead of zero-init + scattering
+    pre-expanded rows — half the HBM traffic on the dispatch critical
+    path."""
+    R = rows.shape[0]
+    filled = (src < R)[..., None]
+    take = jnp.take(rows, jnp.minimum(src, R - 1).reshape(-1), axis=0)
+    return jnp.where(filled, take.reshape(src.shape + rows.shape[1:]),
+                     0).astype(out_dtype)
 
 
 def _quant(x: jax.Array, wire_dtype) -> tuple[jax.Array, jax.Array]:
@@ -461,10 +483,11 @@ def dispatch_2d(a2a: Ep2dAllToAllContext, tokens: jax.Array,
         rank = eid // epr
         a_dst = rank // nm
         slot, ok = _slot_assign(a_dst, nM, cap1)
-        tok_rep = jnp.repeat(tok_shard[:, None, :], k, axis=1).reshape(-1, H)
         s_drop = jnp.where(ok, slot, cap1)
-        send = jnp.zeros((nM, cap1, H), a2a.dtype).at[a_dst, s_drop].set(
-            tok_rep.astype(a2a.dtype), mode="drop")
+        src = _slot_src_map(a_dst, s_drop,
+                            jnp.arange(T * k, dtype=jnp.int32) // k,
+                            nM, cap1, T)
+        send = _slot_gather(tok_shard, src, a2a.dtype)
         meta = jnp.full((nM, c1_cols), -1, jnp.int32).at[a_dst, s_drop].set(
             eid, mode="drop")
         return (send, meta.reshape(nM, c1_cols // 128, 128),
@@ -484,8 +507,10 @@ def dispatch_2d(a2a: Ep2dAllToAllContext, tokens: jax.Array,
         slot, ok = _slot_assign(b_dst, nm, cap2, valid)
         toks = r1_shard.reshape(nM * cap1, H)
         s_drop = jnp.where(ok, slot, cap2)
-        send = jnp.zeros((nm, cap2, H), a2a.dtype).at[b_dst, s_drop].set(
-            toks, mode="drop")
+        src = _slot_src_map(b_dst, s_drop,
+                            jnp.arange(nM * cap1, dtype=jnp.int32),
+                            nm, cap2, nM * cap1)
+        send = _slot_gather(toks, src, a2a.dtype)
         meta2 = jnp.full((nm, c2_cols), -1, jnp.int32).at[b_dst, s_drop].set(
             meta, mode="drop")
         return (send, meta2.reshape(nm, c2_cols // 128, 128),

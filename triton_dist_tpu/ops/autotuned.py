@@ -72,10 +72,9 @@ _MOE_BLOCK_CANDIDATES = [32, 64, 128, 256]
 
 
 def _moe_vmem_ok(bm: int, k_local: int, itemsize: int) -> bool:
-    # VMEM per pipeline step: token block + one expert tile + out block,
-    # double-buffered (same budget rule as GemmConfig.vmem_ok)
-    return 2 * itemsize * (bm * k_local + k_local * 128
-                           + bm * 128) <= 12 * 2**20
+    # the grouped pipeline streams (bm, k_local) token strips against
+    # (k_local, 128) expert tiles — same budget rule as the dense GEMM
+    return GemmConfig(bm, 128).vmem_ok(k_local, itemsize)
 
 
 def _prune_moe_ag(bm: int, args, kw) -> bool:

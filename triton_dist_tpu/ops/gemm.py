@@ -37,6 +37,11 @@ class GemmConfig:
         return 2 * bytes_per_el * (self.block_m * K + K * self.block_n
                                    + self.block_m * self.block_n)
 
+    # Budget calibrated to Mosaic's 16 MB scoped-VMEM stack limit (not the
+    # 128 MB physical VMEM), with headroom for the enclosing kernel's
+    # staging buffers. Measured on-chip: tiles above this bound either fail
+    # the scoped limit or (with vmem_limit_bytes raised) run SLOWER than
+    # (256, 256) at the 4096^3 bench shape — bigger is not better here.
     def vmem_ok(self, K: int, bytes_per_el: int, budget: int = 12 * 2**20) -> bool:
         return self.vmem_bytes(K, bytes_per_el) <= budget
 
