@@ -1,0 +1,132 @@
+"""Differentiable overlap ops — custom VJPs for the TP linears.
+
+The reference is an inference kernel library (torch, no autograd through
+its Triton kernels). On TPU the functional-transform story makes training
+composition natural: AG-GEMM and GEMM-RS are *each other's adjoints*, so
+the backward of each overlap op is the other overlap op:
+
+    y = AG(a) @ b            (column-parallel forward, ag_gemm)
+    da = RS(dy @ bᵀ)         → gemm_rs(dy, bᵀ)
+    db = AG(a)ᵀ @ dy         → local GEMM on a re-gathered a
+
+    y = RS(x @ w)            (row-parallel forward, gemm_rs)
+    dx = AG(dy) @ wᵀ         → ag_gemm(dy, wᵀ)
+    dw = xᵀ @ AG(dy)         → local GEMM on a re-gathered dy
+
+Every term keeps its operand's sharding (the dualities above are exact at
+the PartitionSpec level), so these drop into jax.grad/optax training loops
+with the hand-overlapped kernels on both passes. Activations are
+re-gathered in backward instead of saved gathered (rematerialization: an
+AG is cheap next to the saved-[M, K]-replicated memory).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.ops.allgather import all_gather
+from triton_dist_tpu.ops.allgather_gemm import (ag_gemm, ag_gemm_ws,
+                                                create_ag_gemm_workspace)
+from triton_dist_tpu.ops.gemm import GemmConfig
+from triton_dist_tpu.ops.gemm_reduce_scatter import gemm_rs
+from triton_dist_tpu.shmem.context import ShmemContext
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def ag_gemm_diff(ctx: ShmemContext, axis: str | None,
+                 cfg: GemmConfig | None, a: jax.Array,
+                 b: jax.Array) -> jax.Array:
+    """Differentiable column-parallel linear: C = all_gather(a) @ b.
+    a [M, K] P(axis); b [K, N] P(None, axis); C [M, N] P(None, axis)."""
+    return ag_gemm(ctx, a, b, axis=axis, cfg=cfg)
+
+
+def _ag_gemm_fwd(ctx, axis, cfg, a, b):
+    return ag_gemm(ctx, a, b, axis=axis, cfg=cfg), (a, b)
+
+
+def _bwd_cfg(cfg, rows_local: int, cols: int) -> GemmConfig:
+    """Tile config for a backward op whose output dims are the forward's
+    swapped — gcd-clamp so divisibility holds for any shape."""
+    base = cfg or GemmConfig()
+    return GemmConfig(math.gcd(base.block_m, rows_local),
+                      math.gcd(base.block_n, cols))
+
+
+def _ag_gemm_bwd(ctx, axis, cfg, res, dc):
+    a, b = res
+    n = ctx.axis_size(axis or ctx.axis_names[0])
+    # da = reduce_scatter(dc @ bᵀ): dc [M, N] P(None, axis) is exactly
+    # gemm_rs's K-sharded lhs; bᵀ [N, K] P(axis, None) its row-sharded rhs;
+    # result [M, K] P(axis) matches a.
+    da = gemm_rs(ctx, dc, jnp.swapaxes(b, 0, 1), axis=axis,
+                 cfg=_bwd_cfg(cfg, dc.shape[0] // n, b.shape[0]),
+                 out_dtype=a.dtype)
+    # db = AG(a)ᵀ @ dc: re-gather a (rematerialized), then a local GEMM —
+    # dc's N-sharding propagates to db [K, N] P(None, axis) with no comms.
+    a_g = all_gather(ctx, a, axis=axis)
+    db = jnp.dot(jnp.swapaxes(a_g, 0, 1), dc,
+                 preferred_element_type=jnp.float32).astype(b.dtype)
+    return da, db
+
+
+ag_gemm_diff.defvjp(_ag_gemm_fwd, _ag_gemm_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def gemm_rs_diff(ctx: ShmemContext, axis: str | None,
+                 cfg: GemmConfig | None, x: jax.Array,
+                 w: jax.Array) -> jax.Array:
+    """Differentiable row-parallel linear: y = reduce_scatter(x @ w).
+    x [M, K] P(None, axis); w [K, N] P(axis, None); y [M, N] P(axis)."""
+    return gemm_rs(ctx, x, w, axis=axis, cfg=cfg)
+
+
+def _gemm_rs_fwd(ctx, axis, cfg, x, w):
+    return gemm_rs(ctx, x, w, axis=axis, cfg=cfg), (x, w)
+
+
+def _gemm_rs_bwd(ctx, axis, cfg, res, dy):
+    x, w = res
+    ax = axis or ctx.axis_names[0]
+    n = ctx.axis_size(ax)
+    M, N = dy.shape
+    m_local = M // n
+    # dx = all_gather(dy) @ wᵀ: dy [M, N] P(axis) is exactly ag_gemm's
+    # M-sharded lhs; wᵀ [N, K] P(None, axis) its column-sharded rhs;
+    # result [M, K] P(None, axis) matches x. The workspace-threading form
+    # lets dw below reuse the gathered dy segments instead of a second
+    # all-gather of the same tensor (half the backward ICI traffic).
+    ws = create_ag_gemm_workspace(ctx, m_local, N, dy.dtype, axis=ax)
+    dx, ws = ag_gemm_ws(ctx, dy, jnp.swapaxes(w, 0, 1), ws, axis=ax,
+                        cfg=_bwd_cfg(cfg, m_local, w.shape[0] // n),
+                        out_dtype=x.dtype)
+
+    # reconstruct AG(dy) from the workspace: slot s holds rank s's segment
+    # for every s except our own (the local segment reads the input
+    # directly by design), which we fill from our dy shard
+    def rebuild(ws_local, dy_shard):
+        me = jax.lax.axis_index(ax)
+        g = ws_local.reshape(n, m_local, N).astype(dy_shard.dtype)
+        g = jax.lax.dynamic_update_index_in_dim(g, dy_shard, me, axis=0)
+        return g.reshape(M, N)
+
+    dy_g = ctx.shard_map(rebuild, in_specs=(P(ax), P(ax)),
+                         out_specs=P(None))(ws, dy)
+    # dw = xᵀ @ AG(dy): local GEMM; x's K-sharding propagates to
+    # dw [K, N] P(axis, None) with no comms.
+    dw = jnp.dot(jnp.swapaxes(x, 0, 1), dy_g,
+                 preferred_element_type=jnp.float32).astype(w.dtype)
+    return dx, dw
+
+
+gemm_rs_diff.defvjp(_gemm_rs_fwd, _gemm_rs_bwd)
+
+
+__all__ = ["ag_gemm_diff", "gemm_rs_diff"]
