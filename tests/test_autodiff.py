@@ -101,3 +101,34 @@ def test_tp_mlp_end_to_end_grads(ctx):
     grads_d = jax.jit(jax.grad(loss_dense, (0, 1, 2)))(x, w1, w2)
     for g, gd in zip(grads, grads_d):
         assert_allclose(np.asarray(g), np.asarray(gd), rtol=2e-3, atol=2e-3)
+
+
+def test_llama_mlp_tp_overlap_grads(ctx):
+    """Llama-style silu-gate MLP with the fused gate||up single-AG trick:
+    forward and grads vs the dense twin."""
+    from triton_dist_tpu.models.llama import mlp_tp_overlap
+
+    n = ctx.num_ranks
+    T, D, F = 16 * n, 32 * n, 32 * n
+    cfg = GemmConfig(16, 32)
+    x = jax.random.normal(jax.random.key(0), (T, D), jnp.float32) * 0.3
+    wg = jax.random.normal(jax.random.key(1), (D, F), jnp.float32) * 0.1
+    wu = jax.random.normal(jax.random.key(2), (D, F), jnp.float32) * 0.1
+    wd = jax.random.normal(jax.random.key(3), (F, D), jnp.float32) * 0.1
+
+    def loss(x, wg, wu, wd):
+        y = mlp_tp_overlap(ctx, x, wg, wu, wd, axis="x", gemm_cfg=cfg)
+        return jnp.mean(y.astype(jnp.float32) ** 2)
+
+    def loss_dense(x, wg, wu, wd):
+        ff = jax.nn.silu(x @ wg) * (x @ wu)
+        return jnp.mean((ff @ wd) ** 2)
+
+    args = (ctx.shard(x, P("x")), ctx.shard(wg, P(None, "x")),
+            ctx.shard(wu, P(None, "x")), ctx.shard(wd, P("x", None)))
+    val, grads = jax.jit(jax.value_and_grad(loss, (0, 1, 2, 3)))(*args)
+    val_d, grads_d = jax.jit(jax.value_and_grad(loss_dense, (0, 1, 2, 3)))(
+        x, wg, wu, wd)
+    assert_allclose(np.asarray(val), np.asarray(val_d), rtol=1e-4, atol=1e-5)
+    for g, gd in zip(grads, grads_d):
+        assert_allclose(np.asarray(g), np.asarray(gd), rtol=2e-3, atol=2e-3)

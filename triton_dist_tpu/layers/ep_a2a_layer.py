@@ -26,10 +26,15 @@ class EPAll2AllLayer:
     @classmethod
     def create(cls, ctx: ShmemContext, max_tokens: int, hidden: int,
                topk: int, num_experts: int, capacity: int | None = None,
-               axis: str | None = None, dtype=jnp.bfloat16):
+               axis: str | None = None, dtype=jnp.bfloat16,
+               wire_dtype=None):
+        """``wire_dtype=jnp.float8_e4m3fn`` enables the quantized wire with
+        the f32 scale side-channel (the reference's fp8 showcase protocol,
+        low_latency_all_to_all.py:60-88)."""
         return cls(a2a_ops.create_all_to_all_context(
             ctx, max_tokens, hidden, topk, num_experts,
-            capacity=capacity, axis=axis, dtype=dtype))
+            capacity=capacity, axis=axis, dtype=dtype,
+            wire_dtype=wire_dtype))
 
     def preprocess(self, topk_ids: jax.Array):
         """Routing plan for globally P(axis)-sharded ``topk_ids`` — the same

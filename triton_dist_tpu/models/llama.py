@@ -215,6 +215,44 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     return (x @ params["lm_head"]).astype(jnp.float32)
 
 
+def mlp_tp_overlap(ctx, x2d: jax.Array, w_gate: jax.Array, w_up: jax.Array,
+                   w_down: jax.Array, axis: str | None = None,
+                   gemm_cfg=None) -> jax.Array:
+    """Llama MLP over the differentiable overlap kernels, for the Megatron
+    sequence-parallel residual layout: x2d [T, D] sharded P(axis) on rows →
+    [T, D] sharded P(axis). Gate and up weights are fused per-shard into
+    one [D, 2F] operand so the sequence shard crosses the wire ONCE
+    (a single AG-GEMM instead of two); the down projection is the GEMM-RS
+    adjoint. Fully differentiable (ops.autodiff), so this is a *training*
+    MLP with hand-overlapped comms on both passes — beyond the reference's
+    inference-only scope."""
+    from triton_dist_tpu.ops.autodiff import ag_gemm_diff, gemm_rs_diff
+    from triton_dist_tpu.ops.gemm import GemmConfig
+
+    axis = axis or ctx.axis_names[0]
+    n = ctx.axis_size(axis)
+    D, F = w_gate.shape
+    assert F % n == 0, f"FFN width {F} not divisible by TP size {n}"
+    T_local = x2d.shape[0] // n
+    if gemm_cfg is not None:
+        cfg_ag = cfg_rs = gemm_cfg
+    else:  # largest power-of-two tiles ≤128 that divide each stage
+        cfg_ag = GemmConfig(math.gcd(128, T_local),
+                            math.gcd(128, 2 * (F // n)))
+        cfg_rs = GemmConfig(math.gcd(128, T_local), math.gcd(128, D))
+    # per-shard [gate_i ‖ up_i] interleave: all reshape/concat stay inside
+    # shards (no comms), and the fused output splits the same way
+    wf = jnp.concatenate([w_gate.reshape(D, n, F // n),
+                          w_up.reshape(D, n, F // n)], axis=2)
+    wf = wf.reshape(D, 2 * F)
+    h2 = ag_gemm_diff(ctx, axis, cfg_ag, x2d, wf)          # [T, 2F] P(None, ax)
+    h2 = h2.reshape(-1, n, 2 * (F // n))
+    gate, up = h2[..., :F // n], h2[..., F // n:]
+    ff = (jax.nn.silu(gate.astype(jnp.float32)).astype(x2d.dtype)
+          * up).reshape(-1, F)
+    return gemm_rs_diff(ctx, axis, cfg_rs, ff, w_down)     # [T, D] P(ax)
+
+
 # ---------------------------------------------------------------------------
 # decode / serving path (KV cache + flash-decode kernel)
 # ---------------------------------------------------------------------------
@@ -382,16 +420,14 @@ def forward_tp_overlap(ctx: ShmemContext, params: dict, tokens: jax.Array,
         xs = xs + row(attn.reshape(T, Hq * Dh), p["wo"])
 
         h = rmsnorm(xs, p["mlp_norm"], cfg.norm_eps)
-        wgu = jnp.concatenate([p["w_gate"], p["w_up"]], axis=1)
-        gu = col(h, wgu)
-        g, u = jnp.split(gu, 2, axis=1)
-        ff = jax.nn.silu(g.astype(jnp.float32)).astype(u.dtype) * u
-        xs = xs + row(ff, p["w_down"])
+        xs = xs + mlp_tp_overlap(ctx, h, p["w_gate"], p["w_up"],
+                                 p["w_down"], axis=axis)
 
     x = rmsnorm(xs.reshape(B, S, D), params["final_norm"], cfg.norm_eps)
     return (x @ params["lm_head"]).astype(jnp.float32)
 
 
 __all__ = ["LlamaConfig", "init_params", "param_specs", "forward",
-           "forward_tp_overlap", "rmsnorm", "rope", "block_apply",
-           "init_kv_cache", "prefill", "decode_step", "generate"]
+           "forward_tp_overlap", "mlp_tp_overlap", "rmsnorm", "rope",
+           "block_apply", "init_kv_cache", "prefill", "decode_step",
+           "generate"]
