@@ -409,10 +409,18 @@ def forward_tp_overlap(ctx: ShmemContext, params: dict, tokens: jax.Array,
     for l in range(cfg.n_layers):
         p = jax.tree.map(lambda a: a[l], blocks)
         h = rmsnorm(xs, p["attn_norm"], cfg.norm_eps)
-        # fused qkv column-parallel AG-GEMM (one gather, one wide GEMM)
-        wqkv = jnp.concatenate([p["wq"], p["wk"], p["wv"]], axis=1)
-        qkv = col(h, wqkv)
-        q, k, v = jnp.split(qkv, [Hq * Dh, (Hq + Hkv) * Dh], axis=1)
+        # fused qkv column-parallel AG-GEMM (one gather, one wide GEMM),
+        # interleaved PER SHARD — a plain concat of the TP-sharded weights
+        # would reshard them every layer (the gate‖up trick of
+        # mlp_tp_overlap, with heterogeneous widths)
+        qw, kw = Hq * Dh // nr, Hkv * Dh // nr
+        wqkv = jnp.concatenate(
+            [p["wq"].reshape(D, nr, qw), p["wk"].reshape(D, nr, kw),
+             p["wv"].reshape(D, nr, kw)], axis=2).reshape(D, -1)
+        qkv = col(h, wqkv).reshape(T, nr, qw + 2 * kw)
+        q = qkv[..., :qw].reshape(T, Hq * Dh)
+        k = qkv[..., qw:qw + kw].reshape(T, Hkv * Dh)
+        v = qkv[..., qw + kw:].reshape(T, Hkv * Dh)
         q = rope(q.reshape(B, S, Hq, Dh), positions, cfg.rope_theta)
         k = rope(k.reshape(B, S, Hkv, Dh), positions, cfg.rope_theta)
         attn = _attention(q, k, v.reshape(B, S, Hkv, Dh),
