@@ -92,20 +92,6 @@ def make_chain_timer(step_fn, a, b):
     return timer
 
 
-def calls_iters(out_bytes_per_call: int, i1: int, i2: int) -> tuple[int, int]:
-    """Iteration pair for back-to-back-dispatch timers: as wide as the
-    caller's (i1, i2) spread allows while keeping in-flight output buffers
-    under ~2 GB. Un-executed dispatches hold their outputs live, and a
-    mid-loop sync can't bound that (a true scalar pull costs a tunnel
-    round-trip that would not cancel in the differencing;
-    ``block_until_ready`` can return early here — see module docstring).
-    On small smoke shapes this returns (i1, i2) unchanged."""
-    cap = max(2, int(2e9 // max(out_bytes_per_call, 1)))
-    hi = max(min(i2, cap), 2)
-    lo = max(min(i1, max(2, cap // 8), hi - 1), 1)  # strictly below hi
-    return lo, hi
-
-
 def bench_ag_gemm(ctx, n_dev: int, M: int, N: int, K: int, configs,
                   i1: int, i2: int) -> float:
     """Best per-call seconds for the overlapping ``ag_gemm`` kernel, using
@@ -126,9 +112,8 @@ def bench_ag_gemm(ctx, n_dev: int, M: int, N: int, K: int, configs,
                           ).astype(jnp.bfloat16)
     a_s = ctx.shard(a, P("x"))
     b_s = ctx.shard(b, P(None, "x"))
-    ws0 = (create_ag_gemm_workspace(ctx, M // n_dev, K, jnp.bfloat16,
-                                    axis="x")
-           if n_dev == 1 and N == K else None)
+    ws0 = create_ag_gemm_workspace(ctx, M // n_dev, K, jnp.bfloat16,
+                                   axis="x")
 
     best_s = float("inf")
     for cfg in configs:
@@ -137,49 +122,29 @@ def bench_ag_gemm(ctx, n_dev: int, M: int, N: int, K: int, configs,
         if not cfg.vmem_ok(K, 2):
             continue
         try:
-            if n_dev == 1 and N == K:
-                # output [M, N] matches input a [M, K]: self-chains as a
-                # scan with (activation, workspace) carry — the tightest
-                # dispatch-free timing, buffers reused in place by XLA
-                cache = {}
+            # self-chain for ANY shape: feed an epsilon-scaled element of
+            # the output back into the activation — a real data dependency
+            # that lets the scan manage buffers (reused in place, no
+            # dispatch-pileup memory cap, no host-dispatch noise)
+            cache = {}
 
-                def timer(iters: int, c=cfg):
-                    if iters not in cache:
-                        def chain(a, b, ws):
-                            def body(carry, _):
-                                x, w = carry
-                                y, w = ag_gemm_ws(ctx, x, b, w, axis="x",
-                                                  cfg=c,
-                                                  out_dtype=jnp.bfloat16)
-                                return (y * jnp.asarray(0.01, y.dtype), w), None
-                            (y, _), _ = lax.scan(body, (a, ws), None,
-                                                 length=iters)
-                            return jnp.sum(y.astype(jnp.float32))
-                        cache[iters] = jax.jit(chain)
-                    return float(cache[iters](a_s, b_s, ws0))
+            def timer(iters: int, c=cfg):
+                if iters not in cache:
+                    def chain(a, b, ws):
+                        def body(carry, _):
+                            x, w = carry
+                            y, w = ag_gemm_ws(ctx, x, b, w, axis="x",
+                                              cfg=c, out_dtype=jnp.bfloat16)
+                            eps = (y[0, 0].astype(jnp.float32)
+                                   * 1e-30).astype(x.dtype)
+                            return (x + eps, w), None
+                        (x, _), _ = lax.scan(body, (a, ws), None,
+                                             length=iters)
+                        return jnp.sum(x.astype(jnp.float32))
+                    cache[iters] = jax.jit(chain)
+                return float(cache[iters](a_s, b_s, ws0))
 
-                best_s = min(best_s, _per_iter(timer, i1, i2))
-            else:
-                f = jax.jit(lambda w, a, b, c=cfg: ag_gemm_ws(
-                    ctx, a, b, w, axis="x", cfg=c, out_dtype=jnp.bfloat16),
-                    donate_argnums=(0,))
-                # fresh workspace per config: donation consumes the buffer,
-                # so ws0 can't be re-donated for a second config
-                ws = create_ag_gemm_workspace(ctx, M // n_dev, K,
-                                              jnp.bfloat16, axis="x")
-
-                def timer(iters: int):
-                    nonlocal ws
-                    out = None
-                    for _ in range(iters):
-                        out, ws = f(ws, a_s, b_s)
-                    return float(jnp.sum(out.astype(jnp.float32)))
-
-                # in-flight bytes/call: just the [M, N/n] output (the
-                # workspace is donated in place)
-                per_call = 2 * M * (N // n_dev)
-                best_s = min(best_s, _per_iter(timer,
-                                               *calls_iters(per_call, i1, i2)))
+            best_s = min(best_s, _per_iter(timer, i1, i2))
         except Exception:
             continue
     return best_s
@@ -271,6 +236,58 @@ def bench_decode(ctx, i1: int, i2: int, B: int = 1, Hq: int = 32,
     return res
 
 
+# The reference's perf-shape table (test_ag_gemm_intra_node.py:153-160):
+# AG-GEMM M/N/K per model family, M = 8192 token rows.
+MODEL_SHAPES = {
+    "LLaMA-7B": (8192, 11008, 4096),
+    "LLaMA-3.1-8B": (8192, 14336, 4096),
+    "LLaMA-3.1-70B": (8192, 28672, 8192),
+    "LLaMA-3.1-405B": (8192, 53248, 16384),
+    "Mistral-7B": (8192, 14336, 4096),
+    "Qwen2-72B": (8192, 29568, 8192),
+}
+
+
+def sweep():
+    """Per-model-family AG-GEMM sweep at the reference's perf shapes; one
+    JSON line per shape (informational — the driver parses main()'s single
+    line, so this runs only with --sweep)."""
+    from triton_dist_tpu.ops.gemm import GemmConfig
+    from triton_dist_tpu.shmem.context import initialize_distributed
+
+    n_dev = len(jax.devices())
+    ctx = initialize_distributed(axis_names=("x",), mesh_shape=(n_dev,))
+    peak = chip_peak_tflops()
+    # K-split candidates cover 405B-class K=16384 (full-K strips exceed the
+    # scoped-VMEM budget) and amortize B-strip reloads at large N via tall
+    # block_m (B traffic scales with M/block_m)
+    configs = [GemmConfig(128, 128), GemmConfig(256, 256),
+               GemmConfig(256, 256, 4096), GemmConfig(512, 256, 2048),
+               GemmConfig(1024, 256, 1024), GemmConfig(1024, 512, 1024),
+               # block_n=384 tall variants for N divisible by 3*128 but not
+               # 256 (e.g. Qwen2-72B's 29568; measured 169 vs 89 TFLOP/s
+               # against the narrow-tile fallback)
+               GemmConfig(512, 384, 2048), GemmConfig(1024, 384, 1024)]
+    for name, (M, N, K) in MODEL_SHAPES.items():
+        try:
+            # dedupe by effective tiling (block_k == K is the full-K path)
+            eff = {(c.block_m, c.block_n, min(c.block_k or K, K)): c
+                   for c in configs}
+            best_s = bench_ag_gemm(ctx, n_dev, M, N, K,
+                                   list(eff.values()), 10, 110)
+            if best_s == float("inf"):
+                raise RuntimeError("no candidate config fits this shape")
+            tflops = (2.0 * M * N * K / best_s) / max(n_dev, 1) / 1e12
+            print(json.dumps({
+                "model": name, "M": M, "N": N, "K": K,
+                "ag_gemm_tflops_per_chip": round(tflops, 2),
+                "mfu_pct": round(100 * tflops / peak, 1),
+            }))
+        except Exception as e:
+            print(json.dumps({"model": name,
+                              "error": f"{type(e).__name__}: {e}"[:150]}))
+
+
 def main():
     import math
 
@@ -292,7 +309,7 @@ def main():
         M = N = K = 4096
         n_dev = len(jax.devices())
         configs = [GemmConfig(128, 128), GemmConfig(256, 256),
-                   GemmConfig(512, 256)]
+                   GemmConfig(512, 256, 2048), GemmConfig(1024, 256, 1024)]
         # the tunnel's fixed round-trip jitters by ~50 ms; a wide iteration
         # spread keeps the differenced signal well above it
         i1, i2 = 10, 410
@@ -352,4 +369,8 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+    if "--sweep" in sys.argv:
+        sweep()
+    else:
+        main()

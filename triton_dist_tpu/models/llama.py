@@ -66,6 +66,16 @@ class LlamaConfig:
                    n_kv_heads=8, d_ff=28672, rope_theta=5e5)
 
     @classmethod
+    def llama3_405b(cls):
+        return cls(vocab_size=128256, d_model=16384, n_layers=126,
+                   n_heads=128, n_kv_heads=8, d_ff=53248, rope_theta=5e5)
+
+    @classmethod
+    def mistral_7b(cls):
+        return cls(vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+                   n_kv_heads=8, d_ff=14336)
+
+    @classmethod
     def qwen2_72b(cls):
         return cls(vocab_size=152064, d_model=8192, n_layers=80, n_heads=64,
                    n_kv_heads=8, d_ff=29568)

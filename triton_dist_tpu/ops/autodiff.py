@@ -56,7 +56,7 @@ def _bwd_cfg(cfg, rows_local: int, cols: int) -> GemmConfig:
     swapped — gcd-clamp so divisibility holds for any shape."""
     base = cfg or GemmConfig()
     return GemmConfig(math.gcd(base.block_m, rows_local),
-                      math.gcd(base.block_n, cols))
+                      math.gcd(base.block_n, cols), base.block_k)
 
 
 def _ag_gemm_bwd(ctx, axis, cfg, res, dc):

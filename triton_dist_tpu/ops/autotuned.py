@@ -21,6 +21,10 @@ _CANDIDATES = [
     GemmConfig(128, 128), GemmConfig(128, 256), GemmConfig(256, 128),
     GemmConfig(256, 256), GemmConfig(512, 256), GemmConfig(256, 512),
     GemmConfig(64, 128), GemmConfig(32, 64),
+    # tall K-split tiles: fit large K under the scoped-VMEM budget and
+    # amortize B-strip reloads at large N (measured ~2x at 70B/405B shapes)
+    GemmConfig(256, 256, 4096), GemmConfig(512, 256, 2048),
+    GemmConfig(1024, 256, 1024), GemmConfig(1024, 384, 1024),
 ]
 
 

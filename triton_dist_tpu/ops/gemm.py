@@ -25,16 +25,21 @@ from triton_dist_tpu.utils import default_interpret
 @dataclasses.dataclass(frozen=True)
 class GemmConfig:
     """Tile config (the analog of the reference's BLOCK_SIZE_M/N/K context
-    knobs, e.g. allgather_gemm.py:744-782). K is kept un-split per tile —
-    full-K VMEM strips keep the MXU busy without an accumulator round-trip;
-    ``vmem_ok`` guards the VMEM budget."""
+    knobs, e.g. allgather_gemm.py:744-782). ``block_k=None`` keeps K
+    un-split — full-K VMEM strips keep the MXU busy without an accumulator
+    round-trip; for large-K models (e.g. 405B-class d_model=16k) set
+    ``block_k`` so the strips fit the scoped-VMEM budget, at the cost of
+    cross-strip accumulation in the output dtype. ``vmem_ok`` guards the
+    budget."""
 
     block_m: int = 128
     block_n: int = 128
+    block_k: int | None = None
 
     def vmem_bytes(self, K: int, bytes_per_el: int) -> int:
         # A strip + B strip + out tile, double-buffered by emit_pipeline
-        return 2 * bytes_per_el * (self.block_m * K + K * self.block_n
+        k = min(self.block_k or K, K)
+        return 2 * bytes_per_el * (self.block_m * k + k * self.block_n
                                    + self.block_m * self.block_n)
 
     # Budget calibrated to Mosaic's 16 MB scoped-VMEM stack limit (not the
@@ -60,22 +65,55 @@ def emit_gemm(a_ref, b_ref, out_ref, cfg: GemmConfig, out_dtype=None):
         f"gemm shapes [{M},{K}]x[{K},{N}] not divisible by tile "
         f"({cfg.block_m},{cfg.block_n})")
     out_dtype = out_dtype or out_ref.dtype
+    bk = min(cfg.block_k or K, K)
 
     def body(a_blk, b_blk, o_blk):
         o_blk[...] = jnp.dot(a_blk[...], b_blk[...],
                              preferred_element_type=jnp.float32
                              ).astype(out_dtype)
 
-    grid = (M // cfg.block_m, N // cfg.block_n)
+    if bk == K:
+        pltpu.emit_pipeline(
+            body,
+            grid=(M // cfg.block_m, N // cfg.block_n),
+            in_specs=[
+                pl.BlockSpec((cfg.block_m, K), lambda i, j: (i, 0)),
+                pl.BlockSpec((K, cfg.block_n), lambda i, j: (0, j)),
+            ],
+            out_specs=[pl.BlockSpec((cfg.block_m, cfg.block_n),
+                                    lambda i, j: (i, j))],
+        )(a_ref, b_ref, out_ref)
+        return
+
+    # K-split: k innermost so each output tile stays resident while its
+    # K/bk partial products accumulate; the body zero-inits at k == 0 via
+    # the pipeline's virtual grid index (cross-strip sums land in
+    # ``out_dtype`` — use an f32 out for strict accuracy at large K)
+    assert K % bk == 0, f"K={K} not divisible by block_k {bk}"
+
+    def body_acc(a_blk, b_blk, o_blk):
+        k = pl.program_id(2)
+        part = jnp.dot(a_blk[...], b_blk[...],
+                       preferred_element_type=jnp.float32)
+
+        @pl.when(k == 0)
+        def _():
+            o_blk[...] = part.astype(out_dtype)
+
+        @pl.when(k > 0)
+        def _():
+            o_blk[...] = (o_blk[...].astype(jnp.float32)
+                          + part).astype(out_dtype)
+
     pltpu.emit_pipeline(
-        body,
-        grid=grid,
+        body_acc,
+        grid=(M // cfg.block_m, N // cfg.block_n, K // bk),
         in_specs=[
-            pl.BlockSpec((cfg.block_m, K), lambda i, j: (i, 0)),
-            pl.BlockSpec((K, cfg.block_n), lambda i, j: (0, j)),
+            pl.BlockSpec((cfg.block_m, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, cfg.block_n), lambda i, j, k: (k, j)),
         ],
         out_specs=[pl.BlockSpec((cfg.block_m, cfg.block_n),
-                                lambda i, j: (i, j))],
+                                lambda i, j, k: (i, j))],
     )(a_ref, b_ref, out_ref)
 
 

@@ -127,7 +127,7 @@ def _validate(ctx, a, b, axis, cfg):
     m_seg = M // n
     # clamp tiles to the segment, then require exact divisibility
     cfg = GemmConfig(block_m=min(cfg.block_m, m_seg),
-                     block_n=min(cfg.block_n, N))
+                     block_n=min(cfg.block_n, N), block_k=cfg.block_k)
     assert m_seg % cfg.block_m == 0, (
         f"segment rows {m_seg} not divisible by block_m {cfg.block_m}")
     assert N % cfg.block_n == 0, (
