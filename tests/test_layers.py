@@ -83,3 +83,27 @@ def test_sp_decode_layer(ctx):
                         ctx.shard(vc, P(None, None, "x")), lens)
     assert out.shape == (B, Hq, D)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_ep_layer_2d_roundtrip():
+    """EPAll2AllLayer over a (major, minor) axis tuple routes through the
+    hierarchical dispatch_2d/combine_2d (reference layer's inter-node path,
+    ep_a2a_layer.py:187-240)."""
+    ctx2 = initialize_distributed(axis_names=("a", "b"), mesh_shape=(2, 3))
+    n = 6
+    T, H, k, E = 8, 128, 2, n * 2
+    layer = EPAll2AllLayer.create(ctx2, max_tokens=T, hidden=H, topk=k,
+                                  num_experts=E, axis=("a", "b"),
+                                  dtype=jnp.float32)
+    assert layer.is_2d
+    tokens = jax.random.normal(jax.random.key(0), (n * T, H), jnp.float32)
+    ids = jax.random.randint(jax.random.key(1), (n * T, k), 0, E)
+    w = jnp.full((n * T, k), 1.0 / k)
+    spec = P(("a", "b"))
+    ts, is_, ws = (ctx2.shard(t, spec) for t in (tokens, ids, w))
+    recv_tok, recv_ids, layouts = layer.dispatch(ts, is_)
+    out = layer.combine(recv_tok, layouts, ws)  # identity experts
+    assert_allclose(np.asarray(out), np.asarray(tokens), atol=1e-4,
+                    rtol=1e-4)
+    with pytest.raises(NotImplementedError):
+        layer.preprocess(is_)

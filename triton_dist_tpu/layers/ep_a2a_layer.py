@@ -21,20 +21,38 @@ class EPAll2AllLayer:
     ``dispatch`` and passed to ``combine`` explicitly (it contains traced
     arrays; stashing it on the layer would leak tracers across jit
     boundaries)."""
-    a2a: a2a_ops.EpAllToAllContext
+    a2a: "a2a_ops.EpAllToAllContext | a2a_ops.Ep2dAllToAllContext"
 
     @classmethod
     def create(cls, ctx: ShmemContext, max_tokens: int, hidden: int,
                topk: int, num_experts: int, capacity: int | None = None,
-               axis: str | None = None, dtype=jnp.bfloat16,
-               wire_dtype=None):
+               axis=None, dtype=jnp.bfloat16, wire_dtype=None):
         """``wire_dtype=jnp.float8_e4m3fn`` enables the quantized wire with
         the f32 scale side-channel (the reference's fp8 showcase protocol,
-        low_latency_all_to_all.py:60-88)."""
+        low_latency_all_to_all.py:60-88).
+
+        ``axis`` may be a 2-tuple ``(major, minor)`` — the layer then runs
+        the hierarchical 2-tier dispatch/combine (slow-tier hop + fast-tier
+        expert scatter; the reference layer's inter-node path,
+        ep_a2a_layer.py:187-240 over ep_a2a.py:35-147). The 2-tier kernels
+        use the native wire dtype (no fp8 side-channel)."""
+        if axis is not None and not isinstance(axis, str):
+            axes = tuple(axis)
+            assert len(axes) == 2, (
+                f"2-tier A2A takes exactly (major, minor) axes, got {axes}")
+            assert wire_dtype is None, (
+                "wire_dtype is not supported on the 2-tier path")
+            return cls(a2a_ops.create_all_to_all_context_2d(
+                ctx, max_tokens, hidden, topk, num_experts, axes=axes,
+                cap1=capacity, dtype=dtype))
         return cls(a2a_ops.create_all_to_all_context(
             ctx, max_tokens, hidden, topk, num_experts,
             capacity=capacity, axis=axis, dtype=dtype,
             wire_dtype=wire_dtype))
+
+    @property
+    def is_2d(self) -> bool:
+        return isinstance(self.a2a, a2a_ops.Ep2dAllToAllContext)
 
     def preprocess(self, topk_ids: jax.Array):
         """Routing plan for globally P(axis)-sharded ``topk_ids`` — the same
@@ -43,6 +61,11 @@ class EPAll2AllLayer:
         this must run under shard_map — calling ``route_tokens`` on the
         global array would count slots across ranks jointly and disagree
         with dispatch's capacity-drop decisions."""
+        if self.is_2d:
+            raise NotImplementedError(
+                "preprocess() exposes the 1-tier routing plan; the 2-tier "
+                "path computes per-tier plans inside dispatch_2d (they are "
+                "returned as `layouts`)")
         ctx, axis = self.a2a.ctx, self.a2a.axis
         from jax.sharding import PartitionSpec as P
         sm = ctx.shard_map(lambda ids: a2a_ops.route_tokens(self.a2a, ids),
@@ -53,8 +76,13 @@ class EPAll2AllLayer:
     def dispatch(self, tokens: jax.Array, topk_ids: jax.Array):
         """Returns (recv_tokens, recv_ids, layout); thread ``layout`` into
         ``combine``."""
+        if self.is_2d:
+            return a2a_ops.dispatch_2d(self.a2a, tokens, topk_ids)
         return a2a_ops.dispatch(self.a2a, tokens, topk_ids)
 
     def combine(self, processed: jax.Array, layout,
                 topk_weights: jax.Array) -> jax.Array:
+        if self.is_2d:
+            return a2a_ops.combine_2d(self.a2a, processed, layout,
+                                      topk_weights)
         return a2a_ops.combine(self.a2a, processed, layout, topk_weights)

@@ -38,7 +38,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
-from triton_dist_tpu.ops.common import collective_id_for
+from triton_dist_tpu.ops.common import collective_id_for, norm_axis as _norm_axis
 from triton_dist_tpu.ops.gemm import GemmConfig, emit_gemm
 from triton_dist_tpu.shmem import device as shd
 from triton_dist_tpu.shmem.context import ShmemContext
@@ -80,6 +80,91 @@ def ag_overlap_protocol(axis, mesh_axes, a_ref, ws_ref, send_sems, recv_sems,
     shd.quiet(*rdmas)
 
 
+def ag_overlap_protocol_2d(axes, mesh_axes, a_ref, ws_ref,
+                           send_sems, recv_sems, emit):
+    """Two-tier AllGather-overlap protocol for multi-axis meshes — the
+    inter-node analog of ``ag_overlap_protocol`` (reference
+    ``ag_gemm_inter_node`` + 2-D ring AG, allgather_gemm.py:938-975,
+    allgather.py:291-375).
+
+    ``axes = (outer, *inner)``: the outer axis is the slow tier (DCN /
+    inter-slice), the inner axes the fast tier (ICI), flattened into one
+    PE group of size ``ni``. Global segment id ``seg = r * ni + j`` for
+    outer row ``r``, inner index ``j`` — matching a ``P(axes)`` sharding.
+
+    Same-inner-index ring relay (the reference's same-local-rank inter-node
+    p2p): each device is the relay for its own inner index ``mi`` —
+
+    1. Entry barrier over the whole group (slots + sems are reused).
+    2. Own shard → every inner peer (fast full push) and, in parallel, to
+       the outer-right neighbor (ring hop 1).
+    3. Consume rows in swizzled order ``mo, mo-1, …`` — row ``mo`` starts
+       with our own shard read directly from ``a_ref`` (zero wait). For a
+       remote row ``r``: wait the outer arrival of ``(r, mi)``, immediately
+       re-forward it outer-right (until it has made ``no-1`` hops) AND
+       distribute it to our inner peers, then compute — so the slow-tier
+       relay and fast-tier distribution of row ``r`` ride behind the
+       compute of rows ``> r``. Segments ``(r, j≠mi)`` arrive from their
+       own relays ``(mo, j)`` over the fast tier.
+    4. Quiet: drain our outstanding sends.
+
+    Per-outer-link traffic is ``no-1`` shards (ring-optimal, split across
+    the ``ni`` parallel same-inner-index rings); every device receives each
+    foreign segment exactly once.
+    """
+    outer, inner = axes[0], tuple(axes[1:])
+    mo, mi = shd.my_pe(outer), shd.my_pe(inner)
+    no, ni = shd.n_pes(outer), shd.n_pes(inner)
+    shd.barrier_all(axes, mesh_axes=mesh_axes)
+
+    my_seg = mo * ni + mi
+    rdmas = []
+    right = (shd.pe_at(mesh_axes, outer, lax.rem(mo + 1, no))
+             if no > 1 else None)
+
+    def put_inner(seg_idx, src_ref):
+        for s in range(1, ni):
+            j = lax.rem(mi + s, ni)
+            pid = shd.pe_at_group(mesh_axes, inner, j)
+            rdmas.append(shd.putmem_nbi(ws_ref.at[seg_idx], src_ref,
+                                        send_sems.at[seg_idx],
+                                        recv_sems.at[seg_idx], pid))
+
+    # own shard: fast-tier push + outer ring hop 1
+    put_inner(my_seg, a_ref)
+    if no > 1:
+        rdmas.append(shd.putmem_nbi(ws_ref.at[my_seg], a_ref,
+                                    send_sems.at[my_seg],
+                                    recv_sems.at[my_seg], right))
+
+    # row mo: local segment first (start-local swizzle), then inner arrivals
+    emit(a_ref, my_seg)
+    for s in range(1, ni):
+        j = lax.rem(mi + s, ni)
+        seg = mo * ni + j
+        shd.wait_recv(ws_ref.at[seg], recv_sems.at[seg])
+        emit(ws_ref.at[seg], seg)
+
+    # remote rows, nearest-first: relay + distribute before computing
+    for t in range(1, no):
+        r = lax.rem(mo - t + no, no)
+        seg_r = r * ni + mi
+        shd.wait_recv(ws_ref.at[seg_r], recv_sems.at[seg_r])
+        if t < no - 1:
+            rdmas.append(shd.putmem_nbi(ws_ref.at[seg_r], ws_ref.at[seg_r],
+                                        send_sems.at[seg_r],
+                                        recv_sems.at[seg_r], right))
+        put_inner(seg_r, ws_ref.at[seg_r])
+        emit(ws_ref.at[seg_r], seg_r)
+        for s in range(1, ni):
+            j = lax.rem(mi + s, ni)
+            seg = r * ni + j
+            shd.wait_recv(ws_ref.at[seg], recv_sems.at[seg])
+            emit(ws_ref.at[seg], seg)
+
+    shd.quiet(*rdmas)
+
+
 def _ag_gemm_kernel(axis, mesh_axes, cfg, out_dtype,
                     a_ref, b_ref, out_ref, ws_ref,
                     send_sems, recv_sems):
@@ -93,8 +178,12 @@ def _ag_gemm_kernel(axis, mesh_axes, cfg, out_dtype,
         emit_gemm(src_ref, b_ref, out_ref.at[pl.ds(seg * m_local, m_local)],
                   cfg, out_dtype)
 
-    ag_overlap_protocol(axis, mesh_axes, a_ref, ws_ref, send_sems, recv_sems,
-                        emit)
+    if isinstance(axis, tuple) and len(axis) > 1:
+        ag_overlap_protocol_2d(axis, mesh_axes, a_ref, ws_ref,
+                               send_sems, recv_sems, emit)
+    else:
+        ag_overlap_protocol(axis, mesh_axes, a_ref, ws_ref,
+                            send_sems, recv_sems, emit)
 
 
 def _validate(ctx, a, b, axis, cfg):
@@ -123,7 +212,10 @@ def _pallas_ag_gemm(axis, mesh_axes, cfg, out_dtype, n, M, K, m_local,
         ],
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True,
-            collective_id=collective_id_for("ag_gemm")),
+            # keyed by axis: the hierarchical form barriers a different PE
+            # group than the 1-D form — they must not share a physical
+            # barrier semaphore (cf. allgather.py's per-(family, axis) ids)
+            collective_id=collective_id_for(f"ag_gemm_{axis}")),
         cost_estimate=pl.CostEstimate(
             flops=flops,
             bytes_accessed=(a_shard.size + b_shard.size + M * n_local)
@@ -160,7 +252,7 @@ def _pallas_ag_gemm(axis, mesh_axes, cfg, out_dtype, n, M, K, m_local,
 
 
 def ag_gemm(ctx: ShmemContext, a: jax.Array, b: jax.Array,
-            axis: str | None = None, cfg: GemmConfig | None = None,
+            axis=None, cfg: GemmConfig | None = None,
             out_dtype=None) -> jax.Array:
     """Tensor-parallel AllGather-GEMM: ``a`` is [M, K] sharded P(axis) on M
     (each rank holds [M/n, K]); ``b`` is [K, N] sharded P(None, axis) on N
@@ -168,11 +260,17 @@ def ag_gemm(ctx: ShmemContext, a: jax.Array, b: jax.Array,
     P(None, axis). Entry analog: ``ag_gemm_intra_node``
     (allgather_gemm.py:835-880); golden: all_gather + dot.
 
+    ``axis`` may be a tuple ``(outer, inner…)`` spanning a multi-axis mesh —
+    the hierarchical 2-tier path (same-inner-index outer ring relay + inner
+    push, see ``ag_overlap_protocol_2d``), the TPU analog of
+    ``ag_gemm_inter_node`` (allgather_gemm.py:938-975). Put the slow tier
+    (DCN/inter-slice) first.
+
     This form allocates a fresh [n, M/n, K] workspace per call (discarded).
     For repeated calls, use ``ag_gemm_ws`` / ``AgGemmContext`` which reuse a
     context-owned symmetric workspace (reference parity:
     create_ag_gemm_intra_node_context, allgather_gemm.py:785-832)."""
-    axis = axis or ctx.axis_names[0]
+    axis = _norm_axis(ctx, axis)
     cfg = cfg or GemmConfig()
     out_dtype = out_dtype or a.dtype
     mesh_axes = ctx.axis_names
@@ -189,15 +287,16 @@ def ag_gemm(ctx: ShmemContext, a: jax.Array, b: jax.Array,
 
 
 def ag_gemm_ws(ctx: ShmemContext, a: jax.Array, b: jax.Array, ws: jax.Array,
-               axis: str | None = None, cfg: GemmConfig | None = None,
+               axis=None, cfg: GemmConfig | None = None,
                out_dtype=None) -> tuple[jax.Array, jax.Array]:
     """Workspace-threading AG-GEMM: like ``ag_gemm`` but the symmetric
     workspace is an explicit operand, aliased in place and returned.
     Functional-state idiom (like PRNG keys / optimizer state): jit with
     ``donate_argnums`` on ``ws`` (or carry it through ``lax.scan``) and the
     buffer is reused with zero per-call allocation. Create ``ws`` with
-    ``create_ag_gemm_workspace``."""
-    axis = axis or ctx.axis_names[0]
+    ``create_ag_gemm_workspace``. ``axis`` may be a tuple (hierarchical
+    2-tier path, see ``ag_gemm``)."""
+    axis = _norm_axis(ctx, axis)
     cfg = cfg or GemmConfig()
     out_dtype = out_dtype or a.dtype
     mesh_axes = ctx.axis_names
@@ -219,13 +318,12 @@ def ag_gemm_ws(ctx: ShmemContext, a: jax.Array, b: jax.Array, ws: jax.Array,
 
 
 def create_ag_gemm_workspace(ctx: ShmemContext, m_local: int, k: int,
-                             dtype=jnp.bfloat16,
-                             axis: str | None = None) -> jax.Array:
+                             dtype=jnp.bfloat16, axis=None) -> jax.Array:
     """Symmetric AG workspace: per-device [n, m_local, k] slots (one per
     source rank), global [n, n, m_local, k] sharded P(axis). Analog of the
     reference's per-context symm workspace tensor list
     (create_ag_gemm_intra_node_context, allgather_gemm.py:785-832)."""
-    axis = axis or ctx.axis_names[0]
+    axis = _norm_axis(ctx, axis)
     n = ctx.axis_size(axis)
     return ctx.create_symm_tensor((n, m_local, k), dtype, axis=axis)
 
@@ -261,9 +359,8 @@ class AgGemmContext:
 
 
 def create_ag_gemm_context(ctx: ShmemContext, m_local: int, k: int,
-                           dtype=jnp.bfloat16,
-                           axis: str | None = None) -> AgGemmContext:
-    axis = axis or ctx.axis_names[0]
+                           dtype=jnp.bfloat16, axis=None) -> AgGemmContext:
+    axis = _norm_axis(ctx, axis)
     ws = create_ag_gemm_workspace(ctx, m_local, k, dtype, axis)
     return AgGemmContext(ctx=ctx, axis=axis, ws=ws)
 

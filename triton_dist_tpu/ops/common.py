@@ -35,6 +35,18 @@ def collective_id_for(name: str) -> int:
     return _COLLECTIVE_IDS[name]
 
 
+def norm_axis(ctx: ShmemContext, axis):
+    """Normalize an op's ``axis`` argument: None → first mesh axis; a
+    1-tuple → its name; a multi-name tuple → tuple (the hierarchical 2-tier
+    path, outer/slow tier first)."""
+    if axis is None:
+        return ctx.axis_names[0]
+    if not isinstance(axis, str):
+        axis = tuple(axis)
+        return axis[0] if len(axis) == 1 else axis
+    return axis
+
+
 def barrier_all_op(ctx: ShmemContext, axis: str | None = None):
     """Host-level device barrier across the mesh — analog of
     ``barrier_all_on_stream`` (reference common_ops.py:162-175). Returns a

@@ -33,7 +33,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
-from triton_dist_tpu.ops.common import collective_id_for
+from triton_dist_tpu.ops.common import collective_id_for, norm_axis as _norm_axis
 from triton_dist_tpu.ops.gemm import GemmConfig, emit_gemm
 from triton_dist_tpu.shmem import device as shd
 from triton_dist_tpu.shmem.context import ShmemContext
@@ -55,10 +55,14 @@ def rs_overlap_protocol(axis, mesh_axes, ws_ref, stage_ref,
     4. Drain the last sends, wait each peer's arrival once.
 
     The caller runs its reduction over ``ws_ref``'s n slots afterwards.
+
+    ``axis`` may be a tuple of mesh axes — the PE group is their flattened
+    product (used by the hierarchical GEMM-RS for its fast-tier stage).
     """
     me = shd.my_pe(axis)
     n = shd.n_pes(axis)
-    shd.barrier_all((axis,), mesh_axes=mesh_axes)
+    group = (axis,) if isinstance(axis, str) else tuple(axis)
+    shd.barrier_all(group, mesh_axes=mesh_axes)
 
     rdmas = [None] * max(n - 1, 0)
     for s in range(n - 1):
@@ -67,7 +71,7 @@ def rs_overlap_protocol(axis, mesh_axes, ws_ref, stage_ref,
         if s >= 2:
             rdmas[s - 2].wait_send()  # stage slot free again
         emit(seg, stage_ref.at[slot])
-        pid = shd.pe_at(mesh_axes, axis, seg)
+        pid = shd.pe_at_group(mesh_axes, axis, seg)
         rdmas[s] = shd.putmem_nbi(ws_ref.at[me], stage_ref.at[slot],
                                   send_sems.at[slot], recv_sems.at[me], pid)
 
@@ -118,6 +122,81 @@ def _gemm_rs_kernel(axis, mesh_axes, cfg, acc_dtype,
     emit_slot_reduction(ws_ref, out_ref, cfg.block_m, cfg.block_n)
 
 
+def _gemm_rs_2d_stage_kernel(axes, mesh_axes, cfg, acc_dtype,
+                             a_ref, b_ref, red_ref, ws_ref, stage_ref,
+                             send_sems, recv_sems):
+    """Fast-tier stage of the hierarchical GEMM-RS: fused producer GEMM +
+    inner-group RS. The "segment" owned by inner peer ``j`` is the *strided*
+    row set {(r, j) : r < no} in outer-major block order, so the surviving
+    chunk is laid out ready for the outer-axis ring — no re-permute (the
+    role of the reference's scatter layout, reduce_scatter.py:527-561)."""
+    outer, inner = axes[0], tuple(axes[1:])
+    no = shd.n_pes(outer)
+    ni = shd.n_pes(inner)
+    m_seg = red_ref.shape[0] // no
+
+    def emit(j, dst_ref):
+        for r in range(no):
+            emit_gemm(a_ref.at[pl.ds((r * ni + j) * m_seg, m_seg)], b_ref,
+                      dst_ref.at[pl.ds(r * m_seg, m_seg)], cfg, acc_dtype)
+
+    rs_overlap_protocol(inner, mesh_axes, ws_ref, stage_ref,
+                        send_sems, recv_sems, emit)
+    emit_slot_reduction(ws_ref, red_ref, cfg.block_m, cfg.block_n)
+
+
+def _gemm_rs_2d(ctx, a, b, axes, cfg, out_dtype):
+    """Hierarchical 2-tier GEMM-RS over ``axes = (outer, *inner)`` — the
+    inter-node analog of ``gemm_rs`` (reference 2-D RS pipeline,
+    reduce_scatter.py:430-785: intra-node scatter + per-node reduce +
+    inter-node tier). Stage 1 fuses the producer GEMM into a fast-tier
+    (inner-group) RS; stage 2 ring-reduces the surviving chunk along the
+    slow outer axis — each row crosses the slow tier exactly once, already
+    reduced over the fast tier."""
+    from triton_dist_tpu.ops.reduce_scatter import _rs_call
+
+    cfg = cfg or GemmConfig()
+    out_dtype = out_dtype or a.dtype
+    acc_dtype = jnp.float32 if out_dtype == jnp.bfloat16 else out_dtype
+    mesh_axes = ctx.axis_names
+    outer, inner = axes[0], tuple(axes[1:])
+    no, ni = ctx.axis_size(outer), ctx.axis_size(inner)
+    n, M, _K, N, m_seg, cfg = _validate(ctx, a, b, axes, cfg)
+    chunk = no * m_seg
+
+    def f(a_shard, b_shard):
+        kernel = lambda a_r, b_r, red_r, ws_r, st_r, *sems: \
+            _gemm_rs_2d_stage_kernel(axes, mesh_axes, cfg, acc_dtype,
+                                     a_r, b_r, red_r, ws_r, st_r, *sems)
+        red, _ws, _st = pl.pallas_call(
+            kernel,
+            out_shape=(jax.ShapeDtypeStruct((chunk, N), acc_dtype),
+                       jax.ShapeDtypeStruct((ni, chunk, N), acc_dtype),
+                       jax.ShapeDtypeStruct((2, chunk, N), acc_dtype)),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 3,
+            scratch_shapes=[pltpu.SemaphoreType.DMA((2,)),
+                            pltpu.SemaphoreType.DMA((ni,))],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=collective_id_for(f"gemm_rs_{axes}")),
+            cost_estimate=pl.CostEstimate(
+                flops=2 * M * N * a_shard.shape[1],
+                bytes_accessed=(a_shard.size + b_shard.size)
+                * jnp.dtype(a_shard.dtype).itemsize
+                # red + ws[ni] + stage[2] outputs, all [chunk, N] acc-dtype
+                + (ni + 3) * chunk * N * jnp.dtype(acc_dtype).itemsize,
+                transcendentals=0),
+            interpret=default_interpret(),
+        )(a_shard, b_shard)
+        out = _rs_call(outer, mesh_axes, no, red)
+        return out.astype(out_dtype)
+
+    sm = ctx.shard_map(f, in_specs=(P(None, axes), P(axes, None)),
+                       out_specs=P(axes))
+    return sm(a, b)
+
+
 def _validate(ctx, a, b, axis, cfg):
     n = ctx.axis_size(axis)
     M, K = a.shape
@@ -150,7 +229,7 @@ def _pallas_gemm_rs(axis, mesh_axes, cfg, acc_dtype, out_dtype, n, M, N,
         ],
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True,
-            collective_id=collective_id_for("gemm_rs")),
+            collective_id=collective_id_for(f"gemm_rs_{axis}")),
         cost_estimate=pl.CostEstimate(
             flops=2 * M * N * k_local,
             bytes_accessed=(a_shard.size + b_shard.size + m_seg * N)
@@ -189,17 +268,25 @@ def _pallas_gemm_rs(axis, mesh_axes, cfg, acc_dtype, out_dtype, n, M, N,
 
 
 def gemm_rs(ctx: ShmemContext, a: jax.Array, b: jax.Array,
-            axis: str | None = None, cfg: GemmConfig | None = None,
+            axis=None, cfg: GemmConfig | None = None,
             out_dtype=None) -> jax.Array:
     """Row-parallel GEMM + ReduceScatter: ``a`` [M, K] sharded P(None, axis),
     ``b`` [K, N] sharded P(axis, None). Returns sum_r(a_r @ b_r) scattered
     over M — global [M, N] sharded P(axis). Entry analog: ``gemm_rs``
     (gemm_reduce_scatter.py:524-538); golden: dot + psum_scatter.
 
+    ``axis`` may be a tuple ``(outer, inner…)`` spanning a multi-axis mesh —
+    the hierarchical 2-tier path (fused GEMM + fast-tier RS, then a
+    slow-tier ring — see ``_gemm_rs_2d``), the TPU analog of the
+    reference's inter-node GEMM-RS (tutorial 08 + reduce_scatter.py:430-785).
+    Put the slow tier (DCN/inter-slice) first.
+
     Allocates fresh workspace/stage buffers per call; for repeated calls use
     ``gemm_rs_ws`` / ``GemmRsContext`` (reference parity:
     create_gemm_rs_context, gemm_reduce_scatter.py:77-87)."""
-    axis = axis or ctx.axis_names[0]
+    axis = _norm_axis(ctx, axis)
+    if isinstance(axis, tuple):
+        return _gemm_rs_2d(ctx, a, b, axis, cfg, out_dtype)
     cfg = cfg or GemmConfig()
     out_dtype = out_dtype or a.dtype
     acc_dtype = jnp.float32 if out_dtype == jnp.bfloat16 else out_dtype
@@ -225,7 +312,11 @@ def gemm_rs_ws(ctx: ShmemContext, a: jax.Array, b: jax.Array,
     aliased operands, returned for re-threading. Jit with ``donate_argnums``
     on both (or carry through ``lax.scan``) for zero per-call allocation.
     Create them with ``create_gemm_rs_workspace``."""
-    axis = axis or ctx.axis_names[0]
+    axis = _norm_axis(ctx, axis)
+    assert isinstance(axis, str), (
+        "gemm_rs_ws supports single-axis meshes only; the hierarchical "
+        "2-tier path (axis tuple) allocates its stage chunks per tier — "
+        "use gemm_rs(axis=(outer, inner)) for it")
     cfg = cfg or GemmConfig()
     out_dtype = out_dtype or a.dtype
     acc_dtype = jnp.float32 if out_dtype == jnp.bfloat16 else out_dtype

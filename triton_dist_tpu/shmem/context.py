@@ -91,9 +91,16 @@ class ShmemContext:
     def num_ranks(self) -> int:
         return self.mesh.devices.size
 
-    def axis_size(self, axis: str | None = None) -> int:
+    def axis_size(self, axis: str | Sequence[str] | None = None) -> int:
+        """Devices along ``axis`` — a name, a tuple of names (product, for
+        hierarchical multi-tier PE groups), or None (whole mesh)."""
         if axis is None:
             return self.num_ranks
+        if not isinstance(axis, str):
+            n = 1
+            for a in axis:
+                n *= self.mesh.shape[a]
+            return n
         return self.mesh.shape[axis]
 
     # -- symmetric heap -----------------------------------------------------

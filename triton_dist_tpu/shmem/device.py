@@ -114,6 +114,26 @@ def pe_at(axis_names: Sequence[str], axis: str, index):
     return pid
 
 
+def pe_at_group(mesh_axes: Sequence[str], group_axes: Sequence[str], index):
+    """Flat LOGICAL device id of the device at flattened coordinate ``index``
+    over ``group_axes`` (major-to-minor), other mesh coordinates equal ours.
+    Generalizes ``pe_at`` to a multi-axis PE group — the addressing the
+    hierarchical kernels use for their inner (fast-tier) group."""
+    if isinstance(group_axes, str):
+        group_axes = (group_axes,)
+    rem = index
+    coords = {}
+    for name in reversed(tuple(group_axes)):
+        sz = lax.axis_size(name)
+        coords[name] = lax.rem(rem, sz)
+        rem = rem // sz
+    pid = 0
+    for name in mesh_axes:
+        coord = coords.get(name, lax.axis_index(name))
+        pid = pid * lax.axis_size(name) + coord
+    return pid
+
+
 # -- one-sided puts ---------------------------------------------------------
 
 def putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, pe,):

@@ -76,6 +76,20 @@ def world_size() -> int:
     return _SIM_WORLD[0] if _SIM_WORLD else len(jax.devices())
 
 
+def world_context_2d(axis_names=("node", "x")):
+    """Factor the world into a 2-axis (outer, inner) mesh with the outer
+    ("node"/slow) axis taking the largest divisor ≤ sqrt(world) — the mesh
+    shape the multi-tier tutorials run on. A single chip degenerates to
+    (1, 1)."""
+    ws = world_size()
+    no = 1
+    for d in range(int(ws ** 0.5), 0, -1):
+        if ws % d == 0:
+            no = d
+            break
+    return world_context(axis_names=axis_names, mesh_shape=(no, ws // no))
+
+
 def world_context(axis_names=("x",), mesh_shape=None):
     from triton_dist_tpu.shmem.context import initialize_distributed
     if mesh_shape is None:
