@@ -179,9 +179,12 @@ def _attention(q, k, v, sm_scale: float) -> jax.Array:
 
 
 def block_apply(cfg: LlamaConfig, x: jax.Array, p: dict,
-                positions: jax.Array, act_spec: P | None = None) -> jax.Array:
+                positions: jax.Array, act_spec: P | None = None,
+                attn_fn=None) -> jax.Array:
     """One transformer block. x [B,S,D]. ``act_spec`` re-pins the residual
-    stream sharding after each sublayer (GSPMD sequence/data parallel)."""
+    stream sharding after each sublayer (GSPMD sequence/data parallel).
+    ``attn_fn(q, k, v, sm_scale)`` replaces the dense attention (e.g. the
+    context-parallel ring kernel, parallel.train cp plan)."""
     B, S, D = x.shape
     Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -196,7 +199,7 @@ def block_apply(cfg: LlamaConfig, x: jax.Array, p: dict,
     v = (h @ p["wv"]).reshape(B, S, Hkv, Dh)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    attn = _attention(q, k, v, 1.0 / math.sqrt(Dh))
+    attn = (attn_fn or _attention)(q, k, v, 1.0 / math.sqrt(Dh))
     x = pin(x + attn.reshape(B, S, Hq * Dh) @ p["wo"])
 
     h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps)
@@ -207,16 +210,18 @@ def block_apply(cfg: LlamaConfig, x: jax.Array, p: dict,
 
 
 def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
-            act_spec: P | None = None, remat: bool = False) -> jax.Array:
+            act_spec: P | None = None, remat: bool = False,
+            attn_fn=None) -> jax.Array:
     """Full-sequence forward → logits [B,S,V]. Pure jnp: under jit + sharded
     params, XLA inserts TP collectives (the compiler baseline the overlap
-    kernels race against, cf. tutorial 07's torch baseline)."""
+    kernels race against, cf. tutorial 07's torch baseline). ``attn_fn``
+    swaps in a distributed attention kernel (ring attention for cp)."""
     B, S = tokens.shape
     x = params["embed"][tokens].astype(cfg.dtype)
     positions = jnp.arange(S)[None, :].repeat(B, 0)
 
     def body(x, p):
-        return block_apply(cfg, x, p, positions, act_spec), None
+        return block_apply(cfg, x, p, positions, act_spec, attn_fn), None
 
     if remat:
         body = jax.checkpoint(body)

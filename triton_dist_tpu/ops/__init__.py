@@ -10,3 +10,5 @@ from triton_dist_tpu.ops.allgather_gemm import (  # noqa: F401
 from triton_dist_tpu.ops.gemm_reduce_scatter import (  # noqa: F401
     gemm_rs, gemm_rs_ws, create_gemm_rs_context, create_gemm_rs_workspace)
 from triton_dist_tpu.ops.autodiff import ag_gemm_diff, gemm_rs_diff  # noqa: F401
+from triton_dist_tpu.ops.ring_attention import (  # noqa: F401
+    ring_attention, ring_attention_fwd)

@@ -1,0 +1,691 @@
+"""Ring attention — context parallelism for long-sequence *training*.
+
+The reference scales decode-time sequence length only (KV-sharded
+flash-decode, SURVEY §5.7); its lse-weighted combine is exactly the ring
+attention merge step, and this module is the generalization the survey
+calls for: blockwise attention over a sequence-sharded KV cache where KV
+blocks travel a ring while the MXU computes on the block already present.
+
+One kernel per device (same transport idiom as ``ops/reduce_scatter``):
+
+1. Entry barrier (comm slots + semaphores are reused across calls).
+2. n ring steps. Step s computes blockwise attention of the local Q shard
+   against the KV block that originated at rank ``me - s``; before
+   computing, the block is forwarded right as a non-blocking DMA, so the
+   transfer of step s+1's data rides behind step s's compute (the
+   copy-engine-producer role). 2 relay slots with ack credits (regular
+   semaphore) provide the same flow control as the RS ring.
+3. Online softmax across steps: per-row running (max, denom, acc) state
+   lives in HBM ping-pong buffers packed as [acc ‖ m ‖ l] lanes, updated
+   by an ``emit_pipeline`` over (head, q-tile, kv-tile) blocks per step —
+   the blockwise flash pattern, with the ring as the outermost loop.
+4. Causal masking by *global* positions (q offset ``me*S``, kv offset
+   ``src*S``); fully-masked steps (src > me) skip compute with a single
+   state-copy DMA instead of the pipeline.
+
+Returns (out, lse): lse = m + log(l) per q row, the residual the backward
+pass and the decode combine both need (cf. reference
+flash_decode.py:481-566).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.ops.common import collective_id_for, norm_axis
+from triton_dist_tpu.shmem import device as shd
+from triton_dist_tpu.shmem.context import ShmemContext
+from triton_dist_tpu.utils import default_interpret
+
+_NEG = -1e30
+
+
+def _attn_step_pipeline(step_init, causal, sm_scale, D, bq, bk,
+                        q_off, kv_off, BH, Hq, Hkv, S,
+                        q_ref, k_src, v_src, st_in, st_out):
+    """One ring step's blockwise attention: grid (head, q-tile, kv-tile),
+    kv innermost so the packed [acc ‖ m ‖ l] state block stays resident
+    across the kv sweep. ``step_init`` (python-static) selects fresh-state
+    initialization (s == 0, the carry-in input is omitted entirely — no
+    wasted fetch of the uninitialized buffer) vs carry-in from the
+    previous step's buffer."""
+    g = Hq // Hkv
+    W = D + 256  # acc lanes ‖ m lanes ‖ l lanes
+
+    def kv_head(bh):
+        return (bh // Hq) * Hkv + (bh % Hq) // g
+
+    def body(q_blk, k_blk, v_blk, *st):
+        if step_init:
+            (out_blk,) = st
+        else:
+            in_blk, out_blk = st
+        kvi = pl.program_id(2)
+        qi = pl.program_id(1)
+
+        @pl.when(kvi == 0)
+        def _():
+            if step_init:
+                out_blk[:, :, :D] = jnp.zeros((1, bq, D), jnp.float32)
+                out_blk[:, :, D:D + 128] = jnp.full((1, bq, 128), _NEG,
+                                                    jnp.float32)
+                out_blk[:, :, D + 128:] = jnp.zeros((1, bq, 128),
+                                                    jnp.float32)
+            else:
+                out_blk[...] = in_blk[...]
+
+        qf = q_blk[0].astype(jnp.float32)
+        kf = k_blk[0].astype(jnp.float32)
+        s_ij = lax.dot_general(qf, kf, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+        s_ij = s_ij * sm_scale
+        if causal:
+            qpos = q_off + qi * bq + lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            kpos = kv_off + kvi * bk + lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            keep = kpos <= qpos
+            s_ij = jnp.where(keep, s_ij, _NEG)
+
+        acc_p = out_blk[0, :, :D]
+        m_p = jnp.max(out_blk[0, :, D:D + 128], axis=-1, keepdims=True)
+        l_p = jnp.max(out_blk[0, :, D + 128:], axis=-1, keepdims=True)
+
+        m_c = jnp.maximum(jnp.max(s_ij, axis=-1, keepdims=True), m_p)
+        p = jnp.exp(s_ij - m_c)
+        if causal:
+            # exp(-1e30 - (-1e30)) == 1 on fully-masked rows; re-mask
+            p = jnp.where(keep, p, 0.0)
+        alpha = jnp.exp(m_p - m_c)
+        l_c = l_p * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_c = acc_p * alpha + lax.dot_general(
+            p, v_blk[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        out_blk[0, :, :D] = acc_c
+        out_blk[0, :, D:D + 128] = jnp.broadcast_to(m_c, (bq, 128))
+        out_blk[0, :, D + 128:] = jnp.broadcast_to(l_c, (bq, 128))
+
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda bh, qi, kvi: (bh, qi, 0)),
+        pl.BlockSpec((1, bk, D),
+                     lambda bh, qi, kvi: (kv_head(bh), kvi, 0)),
+        pl.BlockSpec((1, bk, D),
+                     lambda bh, qi, kvi: (kv_head(bh), kvi, 0)),
+    ]
+    args = [q_ref, k_src, v_src]
+    if not step_init:
+        in_specs.append(pl.BlockSpec((1, bq, W),
+                                     lambda bh, qi, kvi: (bh, qi, 0)))
+        args.append(st_in)
+    pltpu.emit_pipeline(
+        body,
+        grid=(BH, S // bq, S // bk),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, bq, W),
+                                lambda bh, qi, kvi: (bh, qi, 0))],
+    )(*args, st_out)
+
+
+def _ring_fwd_kernel(axis, mesh_axes, causal, sm_scale, cfg_bq, cfg_bk,
+                     Hq, Hkv,
+                     q_ref, k_ref, v_ref, o_ref, lse_ref,
+                     st0, st1, kv_slots,
+                     send_sems, recv_sems, ack_sem):
+    me = shd.my_pe(axis)
+    n = shd.n_pes(axis)
+    BH, S, D = q_ref.shape
+    bq, bk = cfg_bq, cfg_bk
+    right = shd.pe_at(mesh_axes, axis, lax.rem(me + 1, n))
+    left = shd.pe_at(mesh_axes, axis, lax.rem(me - 1 + n, n))
+    q_off = me * S
+
+    shd.barrier_all((axis,), mesh_axes=mesh_axes)
+
+    states = (st0, st1)
+    for s in range(n):
+        slot = s % 2
+        src = lax.rem(me - s + n, n)
+        kv_off = src * S
+
+        if s >= 1:
+            shd.wait_recv(kv_slots.at[slot], recv_sems.at[slot])
+
+        rdma = None
+        if s < n - 1:
+            if s >= 2:
+                shd.signal_wait_until(ack_sem, 1)  # right freed slot (s+1)%2
+            nxt = (s + 1) % 2
+            if s == 0:
+                rd_k = shd.putmem_nbi(kv_slots.at[nxt, :, :, :D], k_ref,
+                                      send_sems.at[0], recv_sems.at[nxt],
+                                      right)
+                rd_v = shd.putmem_nbi(kv_slots.at[nxt, :, :, D:], v_ref,
+                                      send_sems.at[1], recv_sems.at[nxt],
+                                      right)
+                rdma = (rd_k, rd_v)
+            else:
+                rdma = (shd.putmem_nbi(kv_slots.at[nxt], kv_slots.at[slot],
+                                       send_sems.at[slot], recv_sems.at[nxt],
+                                       right),)
+
+        st_in, st_out = states[s % 2], states[(s + 1) % 2]
+        if s == 0:
+            k_src, v_src = k_ref, v_ref
+        else:
+            k_src = kv_slots.at[slot, :, :, :D]
+            v_src = kv_slots.at[slot, :, :, D:]
+
+        pipeline = functools.partial(
+            _attn_step_pipeline, s == 0, causal, sm_scale, D, bq, bk,
+            q_off, kv_off, BH, Hq, Hkv, S,
+            q_ref, k_src, v_src, st_in, st_out)
+        if causal and s > 0:
+            # src > me ⇒ every kv position is beyond every q position:
+            # skip the whole pipeline, carry the state forward with one DMA
+            @pl.when(src > me)
+            def _():
+                pltpu.sync_copy(st_in, st_out)
+
+            @pl.when(src <= me)
+            def _():
+                pipeline()
+        else:
+            pipeline()
+
+        if rdma is not None:
+            shd.quiet(*rdma)
+        if s >= 1:
+            shd.signal_op(ack_sem, 1, left)  # slot consumed + forwarded
+
+    # unwaited ack credits from our right neighbor (we stop waiting after
+    # the last send): steps s=1..n-1 acked, waits happened at s=2..n-2
+    if n > 1:
+        shd.signal_wait_until(ack_sem, min(n - 1, 2))
+
+    # epilogue: o = acc / l, lse = m + log l, from the final state buffer
+    final = states[n % 2]
+    W = D + 256
+
+    def epi(st_blk, o_blk, lse_blk):
+        acc = st_blk[0, :, :D]
+        m = jnp.max(st_blk[0, :, D:D + 128], axis=-1, keepdims=True)
+        l = jnp.max(st_blk[0, :, D + 128:], axis=-1, keepdims=True)
+        safe = jnp.where(l > 0, l, 1.0)
+        o_blk[...] = (acc / safe).astype(o_ref.dtype)[None]
+        lse_blk[...] = jnp.where(
+            l > 0, m + jnp.log(safe), _NEG).astype(jnp.float32).T[None]
+
+    pltpu.emit_pipeline(
+        epi,
+        grid=(BH, S // bq),
+        in_specs=[pl.BlockSpec((1, bq, W), lambda bh, qi: (bh, qi, 0))],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            # lse stored [BH, 1, S]: lane dim = sequence (128-tiled), the
+            # sublane-safe layout for per-row scalars (see verify notes on
+            # sub-8-row DMAs)
+            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
+        ],
+    )(final, o_ref, lse_ref)
+
+
+def ring_attention_fwd(ctx: ShmemContext, q: jax.Array, k: jax.Array,
+                       v: jax.Array, axis: str | None = None,
+                       causal: bool = True, sm_scale: float | None = None,
+                       block_q: int = 512, block_k: int = 512,
+                       batch_axis: str | None = None,
+                       head_axis: str | None = None):
+    """Forward ring attention. ``q`` [B, Hq, S, D], ``k``/``v``
+    [B, Hkv, S, D], all sharded P(batch_axis, head_axis, axis, None) —
+    sequence over the ring ``axis`` (global S = n * S local), optionally
+    batch over a dp axis and heads over a tp axis (each (dp, tp) row forms
+    an independent ring). Returns (out [B, Hq, S, D] sharded like q, lse
+    [B, Hq, S] f32 sharded the same) — lse is the backward/composition
+    residual.
+
+    Hq % Hkv == 0 per shard (GQA; a head_axis must divide both); S_local
+    divisible by block_q and block_k; D a lane multiple (128).
+    """
+    axis = norm_axis(ctx, axis)
+    assert isinstance(axis, str), "ring attention rings one axis"
+    n = ctx.axis_size(axis)
+    mesh_axes = ctx.axis_names
+    B, Hq, S, D = q.shape
+    _, Hkv, Sk, Dk = k.shape
+    assert (S, D) == (Sk, Dk) and v.shape == k.shape, (q.shape, k.shape)
+    assert S % n == 0, f"S={S} not divisible by ranks {n}"
+    assert D % 128 == 0, f"head dim {D} must be a lane multiple"
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+
+    def f(q_s, k_s, v_s):
+        Bl, Hql, s_loc, _ = q_s.shape
+        Hkvl = k_s.shape[1]
+        assert Hql % Hkvl == 0, (
+            f"per-shard GQA needs Hq % Hkv == 0, got {Hql}/{Hkvl}")
+        bq = math.gcd(block_q, s_loc)
+        bk = math.gcd(block_k, s_loc)
+        BH, BHkv = Bl * Hql, Bl * Hkvl
+        q3 = q_s.reshape(BH, s_loc, D)
+        k3 = k_s.reshape(BHkv, s_loc, D)
+        v3 = v_s.reshape(BHkv, s_loc, D)
+        W = D + 256
+        kernel = lambda *refs: _ring_fwd_kernel(
+            axis, mesh_axes, causal, scale, bq, bk, Hql, Hkvl, *refs)
+        out, lse, *_ = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((BH, s_loc, D), q_s.dtype),
+                jax.ShapeDtypeStruct((BH, 1, s_loc), jnp.float32),
+                jax.ShapeDtypeStruct((BH, s_loc, W), jnp.float32),  # st0
+                jax.ShapeDtypeStruct((BH, s_loc, W), jnp.float32),  # st1
+                jax.ShapeDtypeStruct((2, BHkv, s_loc, 2 * D), k_s.dtype),
+            ),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 5,
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.REGULAR,
+            ],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=collective_id_for(f"ring_attn_{axis}")),
+            cost_estimate=pl.CostEstimate(
+                flops=4 * BH * s_loc * (n * s_loc) * D,
+                bytes_accessed=(q3.size + n * (k3.size + v3.size)
+                                + BH * s_loc * D) * q_s.dtype.itemsize,
+                transcendentals=BH * s_loc * n * s_loc),
+            interpret=default_interpret(),
+        )(q3, k3, v3)
+        return (out.reshape(Bl, Hql, s_loc, D),
+                lse.reshape(Bl, Hql, s_loc))
+
+    spec = P(batch_axis, head_axis, axis, None)
+    sm = ctx.shard_map(
+        f, in_specs=(spec,) * 3,
+        out_specs=(spec, P(batch_axis, head_axis, axis)))
+    return sm(q, k, v)
+
+
+def _bwd_dq_pipeline(step_init, causal, scale, D, bq, bk, q_off, kv_off,
+                     BH, Hq, Hkv, S,
+                     q_ref, do_ref, lse_ref, dl_ref, k_src, v_src,
+                     dq_in, dq_out):
+    """dq accumulation for one ring step: grid (head, q-tile, kv-tile), kv
+    innermost so the dq block stays resident across the kv sweep."""
+    g = Hq // Hkv
+
+    def kv_head(bh):
+        return (bh // Hq) * Hkv + (bh % Hq) // g
+
+    def body(q_blk, do_blk, lse_blk, dl_blk, k_blk, v_blk, *st):
+        if step_init:
+            (dq_o,) = st
+        else:
+            dq_i, dq_o = st
+        kvi = pl.program_id(2)
+        qi = pl.program_id(1)
+
+        @pl.when(kvi == 0)
+        def _():
+            if step_init:
+                dq_o[...] = jnp.zeros((1, bq, D), jnp.float32)
+            else:
+                dq_o[...] = dq_i[...]
+
+        p, dS, keep = _recompute_p_ds(
+            causal, scale, bq, bk, q_off + qi * bq, kv_off + kvi * bk,
+            q_blk, do_blk, lse_blk, dl_blk, k_blk, v_blk)
+        dq_o[0] += lax.dot_general(
+            dS, k_blk[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda bh, qi, kvi: (bh, qi, 0)),
+        pl.BlockSpec((1, bq, D), lambda bh, qi, kvi: (bh, qi, 0)),
+        pl.BlockSpec((1, 1, bq), lambda bh, qi, kvi: (bh, 0, qi)),
+        pl.BlockSpec((1, 1, bq), lambda bh, qi, kvi: (bh, 0, qi)),
+        pl.BlockSpec((1, bk, D), lambda bh, qi, kvi: (kv_head(bh), kvi, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, qi, kvi: (kv_head(bh), kvi, 0)),
+    ]
+    args = [q_ref, do_ref, lse_ref, dl_ref, k_src, v_src]
+    if not step_init:
+        in_specs.append(pl.BlockSpec((1, bq, D),
+                                     lambda bh, qi, kvi: (bh, qi, 0)))
+        args.append(dq_in)
+    pltpu.emit_pipeline(
+        body, grid=(BH, S // bq, S // bk), in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, bq, D),
+                                lambda bh, qi, kvi: (bh, qi, 0))],
+    )(*args, dq_out)
+
+
+def _bwd_dkv_pipeline(step_init, causal, scale, D, bq, bk, q_off, kv_off,
+                      BHkv, Hq, Hkv, S,
+                      q_ref, do_ref, lse_ref, dl_ref, k_src, v_src,
+                      g_in, g_out):
+    """dk‖dv accumulation for one ring step: grid (kv-head, kv-tile,
+    group-member, q-tile) — the g block (dk ‖ dv lanes) stays resident
+    across the whole (group, q) sweep, initialized from the arriving
+    partial (or zeros at s == 0) and shipped onward afterwards."""
+    g = Hq // Hkv
+
+    def q_head(bhkv, hg):
+        return (bhkv // Hkv) * Hq + (bhkv % Hkv) * g + hg
+
+    def body(q_blk, do_blk, lse_blk, dl_blk, k_blk, v_blk, *st):
+        if step_init:
+            (g_o,) = st
+        else:
+            g_i, g_o = st
+        kvi = pl.program_id(1)
+        hg = pl.program_id(2)
+        qi = pl.program_id(3)
+
+        @pl.when(jnp.logical_and(hg == 0, qi == 0))
+        def _():
+            if step_init:
+                g_o[...] = jnp.zeros((1, bk, 2 * D), jnp.float32)
+            else:
+                g_o[...] = g_i[...]
+
+        p, dS, keep = _recompute_p_ds(
+            causal, scale, bq, bk, q_off + qi * bq, kv_off + kvi * bk,
+            q_blk, do_blk, lse_blk, dl_blk, k_blk, v_blk)
+        g_o[0, :, :D] += lax.dot_general(
+            dS, q_blk[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        g_o[0, :, D:] += lax.dot_general(
+            p, do_blk[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    in_specs = [
+        pl.BlockSpec((1, bq, D),
+                     lambda bhkv, kvi, hg, qi: (q_head(bhkv, hg), qi, 0)),
+        pl.BlockSpec((1, bq, D),
+                     lambda bhkv, kvi, hg, qi: (q_head(bhkv, hg), qi, 0)),
+        pl.BlockSpec((1, 1, bq),
+                     lambda bhkv, kvi, hg, qi: (q_head(bhkv, hg), 0, qi)),
+        pl.BlockSpec((1, 1, bq),
+                     lambda bhkv, kvi, hg, qi: (q_head(bhkv, hg), 0, qi)),
+        pl.BlockSpec((1, bk, D), lambda bhkv, kvi, hg, qi: (bhkv, kvi, 0)),
+        pl.BlockSpec((1, bk, D), lambda bhkv, kvi, hg, qi: (bhkv, kvi, 0)),
+    ]
+    args = [q_ref, do_ref, lse_ref, dl_ref, k_src, v_src]
+    if not step_init:
+        in_specs.append(pl.BlockSpec((1, bk, 2 * D),
+                                     lambda bhkv, kvi, hg, qi: (bhkv, kvi, 0)))
+        args.append(g_in)
+    pltpu.emit_pipeline(
+        body, grid=(BHkv, S // bk, g, S // bq), in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, bk, 2 * D),
+                                lambda bhkv, kvi, hg, qi: (bhkv, kvi, 0))],
+    )(*args, g_out)
+
+
+def _recompute_p_ds(causal, scale, bq, bk, q_pos0, kv_pos0,
+                    q_blk, do_blk, lse_blk, dl_blk, k_blk, v_blk):
+    """Shared backward-tile math: recompute p from (q, k, lse), then
+    dS = p * (do @ v^T - delta). Returns (p, dS, keep-mask)."""
+    qf = q_blk[0].astype(jnp.float32)
+    kf = k_blk[0].astype(jnp.float32)
+    dof = do_blk[0].astype(jnp.float32)
+    vf = v_blk[0].astype(jnp.float32)
+    s_ij = lax.dot_general(qf, kf, (((1,), (1,)), ((), ())),
+                           preferred_element_type=jnp.float32) * scale
+    lse_row = lse_blk[0].T          # [bq, 1]
+    delta_row = dl_blk[0].T         # [bq, 1]
+    p = jnp.exp(s_ij - lse_row)
+    keep = None
+    if causal:
+        qpos = q_pos0 + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        kpos = kv_pos0 + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        keep = kpos <= qpos
+        p = jnp.where(keep, p, 0.0)
+    dp = lax.dot_general(dof, vf, (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    dS = p * (dp - delta_row)
+    return p, dS, keep
+
+
+def _ring_bwd_kernel(axis, mesh_axes, causal, scale, bq, bk, Hq, Hkv,
+                     q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
+                     dq_ref, dk_ref, dv_ref,
+                     dl_ref, dst0, dst1, gacc, kv_slots, g_slots,
+                     kv_send, g_send, kv_recv, g_recv, ack_sem):
+    me = shd.my_pe(axis)
+    n = shd.n_pes(axis)
+    BH, S, D = q_ref.shape
+    right = shd.pe_at(mesh_axes, axis, lax.rem(me + 1, n))
+    left = shd.pe_at(mesh_axes, axis, lax.rem(me - 1 + n, n))
+    q_off = me * S
+
+    shd.barrier_all((axis,), mesh_axes=mesh_axes)
+
+    # delta = rowsum(do * o) per q row, stored lane-major like lse
+    def delta_body(do_blk, o_blk, dl_blk):
+        d = jnp.sum(do_blk[0].astype(jnp.float32)
+                    * o_blk[0].astype(jnp.float32), axis=-1, keepdims=True)
+        dl_blk[...] = d.T[None]
+
+    pltpu.emit_pipeline(
+        delta_body, grid=(BH, S // bq),
+        in_specs=[pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+                  pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0))],
+        out_specs=[pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi))],
+    )(do_ref, o_ref, dl_ref)
+
+    dstates = (dst0, dst1)
+    for s in range(n):
+        slot = s % 2
+        nxt = (s + 1) % 2
+        src = lax.rem(me - s + n, n)
+        kv_off = src * S
+
+        if s >= 1:
+            shd.wait_recv(kv_slots.at[slot], kv_recv.at[slot])
+            shd.wait_recv(g_slots.at[slot], g_recv.at[slot])
+
+        rdmas = []
+        if s >= 2:
+            shd.signal_wait_until(ack_sem, 1)  # right freed its nxt slots
+        if s < n - 1:
+            if s == 0:
+                rdmas.append(shd.putmem_nbi(kv_slots.at[nxt, :, :, :D],
+                                            k_ref, kv_send.at[0],
+                                            kv_recv.at[nxt], right))
+                rdmas.append(shd.putmem_nbi(kv_slots.at[nxt, :, :, D:],
+                                            v_ref, kv_send.at[1],
+                                            kv_recv.at[nxt], right))
+            else:
+                rdmas.append(shd.putmem_nbi(kv_slots.at[nxt],
+                                            kv_slots.at[slot],
+                                            kv_send.at[slot],
+                                            kv_recv.at[nxt], right))
+
+        if s == 0:
+            k_src, v_src = k_ref, v_ref
+        else:
+            k_src = kv_slots.at[slot, :, :, :D]
+            v_src = kv_slots.at[slot, :, :, D:]
+
+        dq_in, dq_out = dstates[slot], dstates[nxt]
+        run_a = functools.partial(
+            _bwd_dq_pipeline, s == 0, causal, scale, D, bq, bk, q_off,
+            kv_off, BH, Hq, Hkv, S, q_ref, do_ref, lse_ref, dl_ref,
+            k_src, v_src, dq_in, dq_out)
+        run_b = functools.partial(
+            _bwd_dkv_pipeline, s == 0, causal, scale, D, bq, bk, q_off,
+            kv_off, kv_slots.shape[1], Hq, Hkv, S, q_ref, do_ref, lse_ref,
+            dl_ref, k_src, v_src, g_slots.at[slot], gacc)
+
+        if causal and s > 0:
+            @pl.when(src > me)
+            def _():
+                pltpu.sync_copy(dq_in, dq_out)
+                pltpu.sync_copy(g_slots.at[slot], gacc)
+
+            @pl.when(src <= me)
+            def _():
+                run_a()
+                run_b()
+        else:
+            run_a()
+            run_b()
+
+        if n > 1:
+            # ship the accumulated dk‖dv onward; at s == n-1 this is the
+            # homecoming delivery of OUR block's finished gradients
+            rdmas.append(shd.putmem_nbi(g_slots.at[nxt], gacc,
+                                        g_send.at[slot], g_recv.at[nxt],
+                                        right))
+        shd.quiet(*rdmas)
+        if s >= 1:
+            shd.signal_op(ack_sem, 1, left)
+
+    if n > 1:
+        shd.signal_wait_until(ack_sem, 1)  # unwaited trailing credit
+        shd.wait_recv(g_slots.at[n % 2], g_recv.at[n % 2])
+        g_final = g_slots.at[n % 2]
+    else:
+        g_final = gacc
+
+    # epilogue: cast dq, split dk ‖ dv
+    def dq_epi(st_blk, dq_blk):
+        dq_blk[...] = st_blk[...].astype(dq_ref.dtype)
+
+    pltpu.emit_pipeline(
+        dq_epi, grid=(BH, S // bq),
+        in_specs=[pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0))],
+        out_specs=[pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0))],
+    )(dstates[n % 2], dq_ref)
+
+    def dkv_epi(g_blk, dk_blk, dv_blk):
+        dk_blk[...] = g_blk[:, :, :D].astype(dk_ref.dtype)
+        dv_blk[...] = g_blk[:, :, D:].astype(dv_ref.dtype)
+
+    BHkv = kv_slots.shape[1]
+    pltpu.emit_pipeline(
+        dkv_epi, grid=(BHkv, S // bk),
+        in_specs=[pl.BlockSpec((1, bk, 2 * D),
+                               lambda bh, ki: (bh, ki, 0))],
+        out_specs=[pl.BlockSpec((1, bk, D), lambda bh, ki: (bh, ki, 0)),
+                   pl.BlockSpec((1, bk, D), lambda bh, ki: (bh, ki, 0))],
+    )(g_final, dk_ref, dv_ref)
+
+
+def ring_attention_bwd(ctx: ShmemContext, q, k, v, o, lse, do,
+                       axis: str, causal: bool, sm_scale: float | None,
+                       block_q: int, block_k: int,
+                       batch_axis: str | None = None,
+                       head_axis: str | None = None):
+    """Backward ring attention: a second ring pass where each KV block
+    travels with its partial (dk ‖ dv) accumulator and arrives home after a
+    full circle, while dq accumulates locally — flash-attention backward
+    with the ring as the outer loop."""
+    mesh_axes = ctx.axis_names
+    n = ctx.axis_size(axis)
+    D = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+
+    def f(q_s, k_s, v_s, o_s, lse_s, do_s):
+        Bl, Hql, s_loc, _ = q_s.shape
+        Hkvl = k_s.shape[1]
+        bq = math.gcd(block_q, s_loc)
+        bk = math.gcd(block_k, s_loc)
+        BH, BHkv = Bl * Hql, Bl * Hkvl
+        q3 = q_s.reshape(BH, s_loc, D)
+        k3 = k_s.reshape(BHkv, s_loc, D)
+        v3 = v_s.reshape(BHkv, s_loc, D)
+        o3 = o_s.reshape(BH, s_loc, D)
+        lse3 = lse_s.reshape(BH, 1, s_loc)
+        do3 = do_s.reshape(BH, s_loc, D)
+        kernel = lambda *refs: _ring_bwd_kernel(
+            axis, mesh_axes, causal, scale, bq, bk, Hql, Hkvl, *refs)
+        dq, dk, dv, *_ = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((BH, s_loc, D), q_s.dtype),
+                jax.ShapeDtypeStruct((BHkv, s_loc, D), k_s.dtype),
+                jax.ShapeDtypeStruct((BHkv, s_loc, D), v_s.dtype),
+                jax.ShapeDtypeStruct((BH, 1, s_loc), jnp.float32),   # delta
+                jax.ShapeDtypeStruct((BH, s_loc, D), jnp.float32),   # dq st0
+                jax.ShapeDtypeStruct((BH, s_loc, D), jnp.float32),   # dq st1
+                jax.ShapeDtypeStruct((BHkv, s_loc, 2 * D), jnp.float32),
+                jax.ShapeDtypeStruct((2, BHkv, s_loc, 2 * D), k_s.dtype),
+                jax.ShapeDtypeStruct((2, BHkv, s_loc, 2 * D), jnp.float32),
+            ),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 6,
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 9,
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.REGULAR,
+            ],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=collective_id_for(f"ring_attn_bwd_{axis}")),
+            cost_estimate=pl.CostEstimate(
+                flops=10 * BH * s_loc * (n * s_loc) * D,
+                bytes_accessed=3 * (q3.size + 2 * n * k3.size)
+                * q_s.dtype.itemsize,
+                transcendentals=BH * s_loc * n * s_loc),
+            interpret=default_interpret(),
+        )(q3, k3, v3, o3, lse3, do3)
+        return (dq.reshape(Bl, Hql, s_loc, D),
+                dk.reshape(Bl, Hkvl, s_loc, D),
+                dv.reshape(Bl, Hkvl, s_loc, D))
+
+    spec = P(batch_axis, head_axis, axis, None)
+    lse_spec = P(batch_axis, head_axis, axis)
+    sm = ctx.shard_map(
+        f, in_specs=(spec, spec, spec, spec, lse_spec, spec),
+        out_specs=(spec,) * 3)
+    return sm(q, k, v, o, lse, do)
+
+
+def ring_attention(ctx: ShmemContext, q: jax.Array, k: jax.Array,
+                   v: jax.Array, axis: str | None = None,
+                   causal: bool = True, sm_scale: float | None = None,
+                   block_q: int = 512, block_k: int = 512,
+                   batch_axis: str | None = None,
+                   head_axis: str | None = None) -> jax.Array:
+    """Context-parallel blockwise attention over a ring (public,
+    differentiable entry). Golden: dense softmax attention on the gathered
+    sequence; gradient golden: jax.grad of the dense computation.
+    ``batch_axis``/``head_axis`` compose with dp/tp meshes (independent
+    rings per (dp, tp) row)."""
+    axis_n = norm_axis(ctx, axis)
+    kw = dict(axis=axis_n, causal=causal, sm_scale=sm_scale,
+              block_q=block_q, block_k=block_k, batch_axis=batch_axis,
+              head_axis=head_axis)
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        out, _ = ring_attention_fwd(ctx, q, k, v, **kw)
+        return out
+
+    def fwd(q, k, v):
+        out, lse = ring_attention_fwd(ctx, q, k, v, **kw)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, do):
+        q, k, v, out, lse = res
+        return ring_attention_bwd(ctx, q, k, v, out, lse, do, **kw)
+
+    attn.defvjp(fwd, bwd)
+    return attn(q, k, v)
+
+
+__all__ = ["ring_attention", "ring_attention_fwd", "ring_attention_bwd"]

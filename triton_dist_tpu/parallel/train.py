@@ -39,11 +39,16 @@ class ParallelPlan:
     tp: str | None = "tp"
     pp: str | None = None
     ep: str | None = None
+    cp: str | None = None    # context parallelism: ring attention over cp
     sp: bool = True          # sequence-shard the residual over the tp axis
     n_micro: int = 2         # pipeline microbatches (pp only)
     remat: bool = False
 
     def act_spec(self) -> P:
+        if self.cp is not None:
+            # the residual's sequence dim belongs to the cp ring; sp's
+            # tp-sharding of the same dim would conflict
+            return P(self.dp, self.cp, None)
         return P(self.dp, self.tp if self.sp else None, None)
 
 
@@ -75,6 +80,9 @@ def make_train_step(cfg, mesh, plan: ParallelPlan | None = None,
     plan = plan or ParallelPlan()
     optimizer = optimizer or optax.adamw(3e-4)
     is_moe = isinstance(cfg, moe_mod.MoEConfig)
+    if plan.cp is not None:
+        assert plan.pp is None and not is_moe, (
+            "cp (ring attention) composes with dp/tp only for now")
     if is_moe:
         assert plan.pp is None, "pp+MoE composition not wired yet"
         specs = moe_mod.moe_param_specs(cfg, tp=plan.tp, ep=plan.ep)
@@ -101,9 +109,31 @@ def make_train_step(cfg, mesh, plan: ParallelPlan | None = None,
                                               remat=plan.remat)
             return _xent(logits, tokens) + aux
     elif plan.pp is None:
+        attn_fn = None
+        if plan.cp is not None:
+            assert not plan.sp, "cp shards the sequence dim; disable sp"
+            assert cfg.head_dim % 128 == 0, (
+                "ring attention needs a lane-multiple head dim, got "
+                f"{cfg.head_dim}")
+            from triton_dist_tpu.ops.ring_attention import ring_attention
+            from triton_dist_tpu.shmem.context import ShmemContext
+            sctx = ShmemContext(mesh=mesh)
+
+            def attn_fn(q, k, v, sm_scale, _ctx=sctx):
+                # llama layout [B, S, H, Dh] → ring layout [B, H, S, Dh];
+                # heads ride the tp axis, batch the dp axis — each (dp, tp)
+                # row is an independent ring over cp
+                o = ring_attention(
+                    _ctx, q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                    v.transpose(0, 2, 1, 3), axis=plan.cp, causal=True,
+                    sm_scale=sm_scale, batch_axis=plan.dp,
+                    head_axis=plan.tp)
+                return o.transpose(0, 2, 1, 3)
+
         def loss_fn(params, tokens):
             logits = llama_mod.forward(params, tokens, cfg,
-                                       act_spec=act_spec, remat=plan.remat)
+                                       act_spec=act_spec, remat=plan.remat,
+                                       attn_fn=attn_fn)
             return _xent(logits, tokens)
     else:
         pp, n_micro = plan.pp, plan.n_micro

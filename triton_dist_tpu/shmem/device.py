@@ -245,19 +245,7 @@ def barrier_all(axis_names: Sequence[str], mesh_axes: Sequence[str] | None = Non
     me = my_pe(axis_names)
 
     def body(i, carry):
-        # Decompose flat group index i into coordinates along axis_names
-        # (major-to-minor), then linearize over the full mesh with our own
-        # coordinates on non-participating axes.
-        rem = i
-        coords = {}
-        for name in reversed(axis_names):
-            sz = lax.axis_size(name)
-            coords[name] = lax.rem(rem, sz)
-            rem = rem // sz
-        pid = 0
-        for name in mesh_axes:
-            coord = coords.get(name, lax.axis_index(name))
-            pid = pid * lax.axis_size(name) + coord
+        pid = pe_at_group(mesh_axes, axis_names, i)
 
         @pl.when(i != me)
         def _():
