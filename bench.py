@@ -264,6 +264,7 @@ def sweep():
     configs = [GemmConfig(128, 128), GemmConfig(256, 256),
                GemmConfig(256, 256, 4096), GemmConfig(512, 256, 2048),
                GemmConfig(1024, 256, 1024), GemmConfig(1024, 512, 1024),
+               GemmConfig(512, 512, 2048), GemmConfig(512, 1024, 1024),
                # block_n=384 tall variants for N divisible by 3*128 but not
                # 256 (e.g. Qwen2-72B's 29568; measured 169 vs 89 TFLOP/s
                # against the narrow-tile fallback)
@@ -308,8 +309,11 @@ def main():
     else:
         M = N = K = 4096
         n_dev = len(jax.devices())
+        # (512, 512, 2048) / (512, 1024, 1024) measured best at 4096^3 on
+        # v5e: 171 vs 158 TFLOP/s for the earlier K-split candidates
         configs = [GemmConfig(128, 128), GemmConfig(256, 256),
-                   GemmConfig(512, 256, 2048), GemmConfig(1024, 256, 1024)]
+                   GemmConfig(512, 256, 2048), GemmConfig(1024, 256, 1024),
+                   GemmConfig(512, 512, 2048), GemmConfig(512, 1024, 1024)]
         # the tunnel's fixed round-trip jitters by ~50 ms; a wide iteration
         # spread keeps the differenced signal well above it
         i1, i2 = 10, 410

@@ -66,3 +66,17 @@ def test_barrier_all_op(ctx):
     f = barrier_all_op(ctx)
     out = f()
     assert np.all(np.asarray(out) == 1)
+
+
+@pytest.mark.parametrize("root", [0, 2])
+def test_broadcast(ctx, root):
+    """One-to-all broadcast (device-API parity: the reference's raw
+    broadcast, exercised by test_nvshmem_api)."""
+    from triton_dist_tpu.ops import broadcast
+    n = ctx.num_ranks
+    x = jnp.stack([jnp.full((16, 128), float(i)) for i in range(n)])
+    xs = ctx.shard(x, P("x"))
+    f = jax.jit(lambda v: broadcast(ctx, v, axis="x", root=root))
+    for _ in range(2):  # repeated calls: entry barrier protects sem reuse
+        y = f(xs)
+        assert_allclose(np.asarray(y), np.asarray(x[root]))

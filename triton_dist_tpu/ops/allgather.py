@@ -159,6 +159,69 @@ def all_gather(ctx: ShmemContext, x: jax.Array, axis: str | None = None,
     return _ag_1d(ctx, x, axis, method)
 
 
+def _bcast_kernel(axis, mesh_axes, root, in_ref, out_ref,
+                  send_sems, recv_sem):
+    """One-to-all broadcast: the root puts its block into every peer's
+    output; peers wait one delivery. Analog of the device-API
+    ``broadcast(mem)`` the reference's raw-API tests exercise
+    (test_nvshmem_api; libnvshmem_device.py broadcast/fcollect family)."""
+    me = shd.my_pe(axis)
+    n = shd.n_pes(axis)
+    # entry barrier: recv_sem is reused across calls (see _ag_push_kernel)
+    shd.barrier_all((axis,), mesh_axes=mesh_axes)
+
+    @pl.when(me == root)
+    def _():
+        local = pltpu.make_async_copy(in_ref, out_ref, recv_sem)
+        local.start()
+        rdmas = []
+        for p in range(n):
+            if p == 0:
+                continue
+            dst = lax.rem(root + p, n)
+            pid = shd.pe_at(mesh_axes, axis, dst)
+            rdmas.append(shd.putmem_nbi(out_ref, in_ref, send_sems.at[dst],
+                                        recv_sem, pid))
+        local.wait()
+        shd.quiet(*rdmas)
+
+    @pl.when(me != root)
+    def _():
+        shd.wait_recv(out_ref, recv_sem)
+
+
+def broadcast(ctx: ShmemContext, x: jax.Array, axis: str | None = None,
+              root: int = 0) -> jax.Array:
+    """Broadcast the ``root`` device's block to all PEs along ``axis``.
+    ``x`` is global [n, ...] sharded P(axis) (one candidate block per
+    device); returns root's block [...] replicated. Golden: ``x[root]``."""
+    axis = axis or ctx.axis_names[0]
+    n = ctx.axis_size(axis)
+    assert 0 <= root < n, (root, n)
+    mesh_axes = ctx.axis_names
+    assert x.shape[0] == n, (x.shape, n)
+
+    def f(shard):
+        blk = shard.reshape(shard.shape[1:])
+        return pl.pallas_call(
+            lambda i, o, ss, rs: _bcast_kernel(axis, mesh_axes, root, i, o,
+                                               ss, rs),
+            out_shape=jax.ShapeDtypeStruct(blk.shape, blk.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((n,)),
+                            pltpu.SemaphoreType.DMA(())],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=collective_id_for(f"bcast_{axis}")),
+            interpret=default_interpret(),
+        )(blk)
+
+    sm = ctx.shard_map(f, in_specs=P(axis),
+                       out_specs=P(*([None] * (x.ndim - 1))))
+    return sm(x)
+
+
 def _ag_ring_2d(ctx: ShmemContext, x: jax.Array):
     """Hierarchical AG over a multi-axis mesh, innermost axis first: ring
     along the minor axis (gathering my row's shards into a contiguous
@@ -183,4 +246,4 @@ def _ag_ring_2d(ctx: ShmemContext, x: jax.Array):
     return sm(x)
 
 
-__all__ = ["all_gather"]
+__all__ = ["all_gather", "broadcast"]

@@ -25,6 +25,9 @@ _CANDIDATES = [
     # amortize B-strip reloads at large N (measured ~2x at 70B/405B shapes)
     GemmConfig(256, 256, 4096), GemmConfig(512, 256, 2048),
     GemmConfig(1024, 256, 1024), GemmConfig(1024, 384, 1024),
+    # square half-MB output tiles: best measured at the 4096^3 headline
+    # shape on v5e (179 vs 158 TFLOP/s, docs/benchmarks.md)
+    GemmConfig(512, 512, 2048), GemmConfig(512, 1024, 1024),
 ]
 
 

@@ -267,15 +267,16 @@ def decode_combine(partial_outs: jax.Array, partial_lses: jax.Array):
 
 
 def _ll_ag_merge_kernel(axis, mesh_axes, D, out_dtype,
-                        part_ref, out_ref, ws_ref, buf, obuf,
-                        send_sems, recv_sems):
+                        part_ref, out_ref, ws_ref, bufs, obuf,
+                        csems, send_sems, recv_sems):
     """Fused low-latency partial-AG + lse-merge (the decode critical path).
 
     Replaces the generic AG kernel + separate combine kernel with ONE
-    kernel: put my packed partial (out ‖ lse, f32) to every peer plus a
-    local copy into my own slot, then stream the online lse-merge over
-    partials in CANONICAL rank order (seg 0..n-1) — each segment waited
-    once. Canonical order makes the fp32 accumulation identical on every
+    kernel: put my packed partial (out ‖ lse, f32) to every peer (my own
+    segment reads part_ref directly — no ws round-trip), then stream the
+    online lse-merge over partials in CANONICAL rank order (seg 0..n-1),
+    each segment waited once and prefetched into a VMEM double buffer
+    behind the previous segment's merge math. Canonical order makes the fp32 accumulation identical on every
     rank, so the P(None) "replicated" output is bitwise consistent across
     devices (a swizzled start-local order would merge in a different order
     per rank and drift in the low bits, compounding across autoregressive
@@ -294,8 +295,6 @@ def _ll_ag_merge_kernel(axis, mesh_axes, D, out_dtype,
     n = shd.n_pes(axis)
     shd.barrier_all((axis,), mesh_axes=mesh_axes)
 
-    local = pltpu.make_async_copy(part_ref, ws_ref.at[me], recv_sems.at[me])
-    local.start()
     rdmas = []
     for p in range(1, n):
         dst = lax.rem(me + p, n)
@@ -303,11 +302,31 @@ def _ll_ag_merge_kernel(axis, mesh_axes, D, out_dtype,
         rdmas.append(shd.putmem_nbi(ws_ref.at[me], part_ref,
                                     send_sems.at[dst], recv_sems.at[me], pid))
 
+    # Double-buffered VMEM prefetch with own-segment bypass: segment `me`
+    # reads part_ref directly (our ws slot is never written — the ws
+    # round-trip the first version paid is gone), and segment seg+1's
+    # HBM→VMEM fetch rides behind segment seg's VPU merge.
+    def fetch(seg, slot):
+        @pl.when(seg == me)
+        def _():
+            pltpu.make_async_copy(part_ref, bufs.at[slot],
+                                  csems.at[slot]).start()
+
+        @pl.when(seg != me)
+        def _():
+            shd.wait_recv(ws_ref.at[seg], recv_sems.at[seg])
+            pltpu.make_async_copy(ws_ref.at[seg], bufs.at[slot],
+                                  csems.at[slot]).start()
+
+    fetch(0, 0)
     acc = m = denom = None
     for seg in range(n):
-        shd.wait_recv(ws_ref.at[seg], recv_sems.at[seg])
-        pltpu.sync_copy(ws_ref.at[seg], buf)
-        x = buf[...]
+        slot = seg % 2
+        if seg + 1 < n:
+            fetch(seg + 1, (seg + 1) % 2)
+        pltpu.make_async_copy(bufs.at[slot], bufs.at[slot],
+                              csems.at[slot]).wait()
+        x = bufs[slot]
         o, lse = x[..., :D], x[..., D:D + 1]   # [B*Hq,D], [B*Hq,1]
         if seg == 0:
             acc, m, denom = o, lse, jnp.ones_like(lse)
@@ -350,8 +369,9 @@ def ll_ag_merge(ctx: ShmemContext, packed: jax.Array, D: int,
             out_specs=(pl.BlockSpec(memory_space=pl.ANY),
                        pl.BlockSpec(memory_space=pl.ANY)),
             scratch_shapes=[
-                pltpu.VMEM((R, W), pk.dtype),
+                pltpu.VMEM((2, R, W), pk.dtype),   # prefetch double buffer
                 pltpu.VMEM((R, D), out_dtype),
+                pltpu.SemaphoreType.DMA((2,)),     # prefetch copy sems
                 pltpu.SemaphoreType.DMA((n,)),
                 pltpu.SemaphoreType.DMA((n,)),
             ],
