@@ -1,0 +1,52 @@
+// Host-native EP A2A routing preprocessing (analog of the reference's
+// host/device token-routing helpers: per-warp atomic slot allocation in
+// ep_a2a.py:64-147 and the csrc moe_utils alignment family). On TPU the
+// in-jit path is the one-hot-cumsum `_slot_assign` in ops/all_to_all.py;
+// this native version serves the host-side datapath (serving frontends /
+// CPU dataloaders that pre-route tokens before device dispatch) and is
+// cross-tested against the jnp implementation.
+//
+// Contract (identical to ops.all_to_all._slot_assign):
+//   slot[r] = number of earlier valid rows with the same destination
+//   ok[r]   = slot[r] < cap, and the row was valid (dest in range, valid[r])
+// Rows with out-of-range destinations keep slot of the clipped dest
+// (matching the jnp clip) but are only counted when in range and valid.
+
+#include <cstdint>
+#include <vector>
+
+extern "C" {
+
+// Returns 0 on success, nonzero on bad arguments.
+int32_t tdt_a2a_slot_assign(const int32_t* dest, int64_t R, int32_t n_dst,
+                            int32_t cap, const uint8_t* valid /*nullable*/,
+                            int32_t* slot, uint8_t* ok) {
+  if (R < 0 || n_dst <= 0 || cap < 0) return 1;
+  std::vector<int64_t> counters(n_dst, 0);
+  for (int64_t r = 0; r < R; ++r) {
+    int32_t d = dest[r];
+    int32_t dc = d < 0 ? 0 : (d >= n_dst ? n_dst - 1 : d);
+    bool v = (valid == nullptr) || (valid[r] != 0);
+    // jnp one-hot counts the CLIPPED destination for valid rows
+    int64_t s = counters[dc];
+    if (v) counters[dc]++;
+    slot[r] = (int32_t)s;
+    ok[r] = (v && s < cap) ? 1 : 0;
+  }
+  return 0;
+}
+
+// Per-destination token counts (the splits the reference ships on the wire,
+// low_latency_all_to_all.py:35-118). Out-of-range destinations are dropped.
+int32_t tdt_a2a_bincount(const int32_t* dest, int64_t R, int32_t n_dst,
+                         int32_t* counts) {
+  if (R < 0 || n_dst <= 0) return 1;
+  for (int32_t i = 0; i < n_dst; ++i) counts[i] = 0;
+  for (int64_t r = 0; r < R; ++r) {
+    int32_t d = dest[r];
+    if (d >= 0 && d < n_dst) counts[d]++;
+  }
+  return 0;
+}
+
+}  // extern "C"

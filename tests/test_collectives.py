@@ -80,3 +80,24 @@ def test_broadcast(ctx, root):
     for _ in range(2):  # repeated calls: entry barrier protects sem reuse
         y = f(xs)
         assert_allclose(np.asarray(y), np.asarray(x[root]))
+
+
+def test_all_gather_push_2d(ctx2d):
+    """Single-kernel hierarchical push AG (outer relay + inner push) on the
+    asymmetric (2,3) mesh, repeated calls."""
+    from triton_dist_tpu.ops import all_gather
+    x = jnp.arange(6 * 12 * 128, dtype=jnp.float32).reshape(6 * 12, 128)
+    xs = ctx2d.shard(x, P(("a", "b")))
+    f = jax.jit(lambda v: all_gather(ctx2d, v, method="push_2d"))
+    for _ in range(2):
+        assert_allclose(np.asarray(f(xs)), np.asarray(x))
+
+
+def test_all_gather_push_2d_3axis():
+    from triton_dist_tpu.ops import all_gather
+    ctx3 = initialize_distributed(axis_names=("a", "b", "c"),
+                                  mesh_shape=(2, 2, 2))
+    x = jnp.arange(8 * 8 * 128, dtype=jnp.float32).reshape(8 * 8, 128)
+    xs = ctx3.shard(x, P(("a", "b", "c")))
+    y = jax.jit(lambda v: all_gather(ctx3, v, method="push_2d"))(xs)
+    assert_allclose(np.asarray(y), np.asarray(x))

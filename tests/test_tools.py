@@ -160,3 +160,28 @@ def test_autotuned_moe_ops():
     rows = np.stack([t2[r] @ w2n[id2n[r]] for r in range(T2 * topk)])
     gold2 = (rows.reshape(T2, topk, N2) * np.asarray(tw)[..., None]).sum(1)
     np.testing.assert_allclose(np.asarray(out2), gold2, atol=1e-3, rtol=1e-3)
+
+
+def test_native_a2a_route_matches_jnp():
+    """C++ slot_assign/bincount vs the jnp one-hot-cumsum device path
+    (contract: ops.all_to_all._slot_assign)."""
+    import numpy as np
+
+    from triton_dist_tpu import csrc
+    from triton_dist_tpu.ops.all_to_all import _slot_assign
+    if csrc.get_lib() is None:
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(0)
+    R, n_dst, cap = 257, 6, 32
+    dest = rng.integers(-1, n_dst + 1, size=R).astype(np.int32)
+    valid = (rng.random(R) < 0.8).astype(np.uint8)
+    for v in (None, valid):
+        s_n, ok_n = csrc.a2a_slot_assign(dest, n_dst, cap, v)
+        s_j, ok_j = _slot_assign(
+            jnp.asarray(dest), n_dst, cap,
+            None if v is None else jnp.asarray(v.astype(bool)))
+        np.testing.assert_array_equal(s_n, np.asarray(s_j))
+        np.testing.assert_array_equal(ok_n, np.asarray(ok_j))
+    counts = csrc.a2a_bincount(dest, n_dst)
+    ref = np.bincount(dest[(dest >= 0) & (dest < n_dst)], minlength=n_dst)
+    np.testing.assert_array_equal(counts, ref)

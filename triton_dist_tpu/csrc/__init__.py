@@ -25,7 +25,8 @@ _lib = None
 
 def _build() -> None:
     os.makedirs(os.path.dirname(_SO), exist_ok=True)
-    srcs = [os.path.join(_SRC, "moe_align.cc")]
+    srcs = [os.path.join(_SRC, "moe_align.cc"),
+            os.path.join(_SRC, "a2a_route.cc")]
     cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-Wall",
            *srcs, "-o", _SO]
     subprocess.run(cmd, check=True, capture_output=True, text=True)
@@ -42,7 +43,8 @@ def get_lib() -> ctypes.CDLL | None:
     try:
         if not os.path.exists(_SO) or any(
                 os.path.getmtime(s) > os.path.getmtime(_SO)
-                for s in [os.path.join(_SRC, "moe_align.cc")]):
+                for s in [os.path.join(_SRC, "moe_align.cc"),
+                          os.path.join(_SRC, "a2a_route.cc")]):
             _build()
         lib = ctypes.CDLL(_SO)
     except (OSError, subprocess.CalledProcessError):
@@ -55,6 +57,15 @@ def get_lib() -> ctypes.CDLL | None:
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
         ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32)]
+    lib.tdt_a2a_slot_assign.restype = ctypes.c_int32
+    lib.tdt_a2a_slot_assign.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8)]
+    lib.tdt_a2a_bincount.restype = ctypes.c_int32
+    lib.tdt_a2a_bincount.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32)]
     _lib = lib
     return _lib
 
@@ -83,4 +94,45 @@ def moe_align_block_size(ids: np.ndarray, num_experts: int, block_m: int):
     return gather_idx, row_valid.astype(bool), block_expert
 
 
-__all__ = ["get_lib", "moe_align_block_size"]
+def a2a_slot_assign(dest: np.ndarray, n_dst: int, cap: int,
+                    valid: np.ndarray | None = None
+                    ) -> tuple[np.ndarray, np.ndarray]:
+    """Host-native slot allocation (contract-identical to
+    ops.all_to_all._slot_assign; cross-tested). Returns (slot, ok)."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable "
+                           "(TDT_NO_NATIVE=1 or no toolchain)")
+    dest = np.ascontiguousarray(dest, dtype=np.int32)
+    R = dest.shape[0]
+    slot = np.zeros(R, np.int32)
+    ok = np.zeros(R, np.uint8)
+    vptr = None
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, dtype=np.uint8)
+        vptr = valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    rc = lib.tdt_a2a_slot_assign(
+        dest.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), R, n_dst, cap,
+        vptr, slot.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    assert rc == 0, f"tdt_a2a_slot_assign failed: rc={rc}"
+    return slot, ok.astype(bool)
+
+
+def a2a_bincount(dest: np.ndarray, n_dst: int) -> np.ndarray:
+    """Host-native per-destination token counts (the wire `splits`)."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable "
+                           "(TDT_NO_NATIVE=1 or no toolchain)")
+    dest = np.ascontiguousarray(dest, dtype=np.int32)
+    counts = np.zeros(n_dst, np.int32)
+    rc = lib.tdt_a2a_bincount(
+        dest.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), dest.shape[0],
+        n_dst, counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    assert rc == 0, f"tdt_a2a_bincount failed: rc={rc}"
+    return counts
+
+
+__all__ = ["get_lib", "moe_align_block_size", "a2a_slot_assign",
+           "a2a_bincount"]

@@ -31,8 +31,14 @@ class AllGatherLayer:
 
     def forward_ring_2d(self, x: jax.Array) -> jax.Array:
         """Hierarchical 2-D ring for multi-axis meshes (≈ forward_push_numa_2d
-        / the multinode variants)."""
+        / the multinode variants) — bandwidth-oriented."""
         return all_gather(self.ctx, x, method="ring_2d")
+
+    def forward_push_2d(self, x: jax.Array) -> jax.Array:
+        """Single-kernel hierarchical push (outer same-inner-index relay +
+        inner push) — the latency-oriented multi-axis path
+        (≈ forward_push_2d/push_3d, low_latency_allgather_layer.py:63-125)."""
+        return all_gather(self.ctx, x, method="push_2d")
 
     def __call__(self, x: jax.Array) -> jax.Array:
         return all_gather(self.ctx, x, axis=self.axis, method="auto")

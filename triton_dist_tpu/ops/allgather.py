@@ -136,9 +136,12 @@ def _ag_1d(ctx: ShmemContext, x: jax.Array, axis: str, method: str):
 def all_gather(ctx: ShmemContext, x: jax.Array, axis: str | None = None,
                method: str = "auto"):
     """AllGather ``x`` (sharded on dim 0 along ``axis``) → replicated global
-    array. ``method`` ∈ auto|push|ring|ring_2d. Analog of the reference's
-    ``cp_engine_producer_all_gather_*`` dispatch (allgather.py:54-69, which
-    auto-picks by NVLink/NUMA topology; here by mesh rank-count/axes)."""
+    array. ``method`` ∈ auto|push|ring|ring_2d|push_2d. Analog of the
+    reference's ``cp_engine_producer_all_gather_*`` dispatch
+    (allgather.py:54-69, which auto-picks by NVLink/NUMA topology; here by
+    mesh rank-count/axes). ``ring_2d`` is the bandwidth-oriented multi-axis
+    path (per-axis rings), ``push_2d`` the latency-oriented one (single
+    kernel, outer relay + inner push)."""
     axis_names = ctx.axis_names
     if axis is None and len(axis_names) == 1:
         axis = axis_names[0]
@@ -147,16 +150,69 @@ def all_gather(ctx: ShmemContext, x: jax.Array, axis: str | None = None,
             method = "ring_2d"
         else:
             method = "push" if ctx.axis_size(axis) <= 4 else "ring"
-    if method == "ring_2d":
-        if len(axis_names) < 2:
-            raise ValueError("ring_2d allgather needs a >=2-axis mesh; "
+    if method in ("ring_2d", "push_2d"):
+        if len(axis_names) < 2 and not (isinstance(axis, tuple)
+                                        and len(axis) > 1):
+            raise ValueError(f"{method} allgather needs a >=2-axis mesh; "
                              f"mesh axes are {axis_names}")
-        return _ag_ring_2d(ctx, x)
+        if method == "ring_2d":
+            return _ag_ring_2d(ctx, x)
+        return _ag_push_2d(ctx, x, axis)
     if axis is None:
         raise ValueError(
             f"all_gather(method={method!r}) on a multi-axis mesh "
             f"{axis_names} requires an explicit axis=")
     return _ag_1d(ctx, x, axis, method)
+
+
+def _ag_push_2d(ctx: ShmemContext, x: jax.Array, axis=None):
+    mesh_axes = ctx.axis_names
+    axes = tuple(axis) if isinstance(axis, tuple) else tuple(mesh_axes)
+    n = ctx.axis_size(axes)
+
+    def f(shard):
+        m = shard.shape[0]
+        slots = pl.pallas_call(
+            lambda i, o, ss, rs: _ag_push_2d_kernel(axes, mesh_axes, i, o,
+                                                    ss, rs),
+            out_shape=jax.ShapeDtypeStruct((n,) + shard.shape, shard.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((n,)),
+                            pltpu.SemaphoreType.DMA((n,))],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=collective_id_for(f"ag_push2d_{axes}")),
+            interpret=default_interpret(),
+        )(shard)
+        return slots.reshape((n * m,) + shard.shape[1:])
+
+    sm = ctx.shard_map(f, in_specs=P(axes), out_specs=P(*([None] * x.ndim)))
+    return sm(x)
+
+
+def _ag_push_2d_kernel(axes, mesh_axes, in_ref, slots_ref,
+                       send_sems, recv_sems):
+    """Single-kernel hierarchical push AG: the 2-tier relay protocol
+    (same-inner-index outer ring + inner push, ops.allgather_gemm.
+    ag_overlap_protocol_2d) with arrivals landing DIRECTLY in the output's
+    [n, m, ...] slots — one kernel, no inter-stage compile boundary, vs
+    ``ring_2d``'s two sequential ring kernels. The latency-oriented
+    multi-axis path (analog of the reference's hierarchical 2-D/3-D push
+    variants, low_latency_allgather.py:345-530)."""
+    from triton_dist_tpu.ops.allgather_gemm import ag_overlap_protocol_2d
+
+    state = {"local_emit": True}
+
+    def emit(src_ref, seg):
+        # the protocol's first emit call is statically the LOCAL segment
+        # (src_ref is in_ref); remote segments already sit in their slots
+        if state["local_emit"]:
+            state["local_emit"] = False
+            pltpu.sync_copy(src_ref, slots_ref.at[seg])
+
+    ag_overlap_protocol_2d(axes, mesh_axes, in_ref, slots_ref,
+                           send_sems, recv_sems, emit)
 
 
 def _bcast_kernel(axis, mesh_axes, root, in_ref, out_ref,
