@@ -264,3 +264,44 @@ def test_gemm_rs_2d_repeated(ctx2d):
                                           jnp.float32), P(axes, None))
         assert_allclose(np.asarray(f(a, b)), np.asarray(gold(a, b)),
                         atol=1e-4, rtol=1e-4)
+
+
+def test_ag_moe_group_gemm_2d(ctx2d):
+    """Hierarchical fused MoE AG+GroupGEMM on the (2,3) mesh (inter-node
+    analog: allgather_group_gemm.py:171-228)."""
+    from triton_dist_tpu.ops.moe import ag_moe_group_gemm
+    n, axes = 6, ("a", "b")
+    T, H, E = n * 8, 128, 4
+    Nw = n * 16
+    tokens = jax.random.normal(jax.random.key(0), (T, H), jnp.float32)
+    ids = jax.random.randint(jax.random.key(1), (T,), 0, E)
+    w = jax.random.normal(jax.random.key(2), (E, H, Nw), jnp.float32) * 0.2
+    ts = ctx2d.shard(tokens, P(axes))
+    ws = ctx2d.shard(w, P(None, None, axes))
+    y = jax.jit(lambda t, w_: ag_moe_group_gemm(ctx2d, t, ids, w_,
+                                                axis=axes, block_m=8)
+                )(ts, ws)
+    golden = np.stack([np.asarray(tokens)[i] @ np.asarray(w)[int(ids[i])]
+                       for i in range(T)])
+    assert_allclose(np.asarray(y), golden, atol=1e-3, rtol=1e-3)
+
+
+def test_moe_reduce_rs_2d(ctx2d):
+    """Hierarchical fused GroupGEMM+RS on the (2,3) mesh (inter-node
+    analog: moe_reduce_rs.py:590-670)."""
+    from triton_dist_tpu.ops.moe import moe_reduce_rs
+    n, axes = 6, ("a", "b")
+    T, topk, K, Nw, E = n * 4, 2, n * 32, 64, 4
+    Tk = T * topk
+    tokens = jax.random.normal(jax.random.key(0), (Tk, K), jnp.float32) * 0.3
+    ids = jax.random.randint(jax.random.key(1), (Tk,), 0, E)
+    tw = jax.nn.softmax(jax.random.normal(jax.random.key(2), (T, topk)), -1)
+    w = jax.random.normal(jax.random.key(3), (E, K, Nw), jnp.float32) * 0.2
+    ts = ctx2d.shard(tokens, P(None, axes))
+    wsh = ctx2d.shard(w, P(None, axes, None))
+    y = jax.jit(lambda t, w_: moe_reduce_rs(ctx2d, t, ids, tw, w_,
+                                            axis=axes, block_m=8))(ts, wsh)
+    rows = np.stack([np.asarray(tokens)[i] @ np.asarray(w)[int(ids[i])]
+                     for i in range(Tk)]).reshape(T, topk, Nw)
+    golden = np.sum(rows * np.asarray(tw)[..., None], axis=1)
+    assert_allclose(np.asarray(y), golden, atol=1e-3, rtol=1e-3)

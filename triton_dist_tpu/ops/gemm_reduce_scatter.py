@@ -202,6 +202,11 @@ def _validate(ctx, a, b, axis, cfg):
     M, K = a.shape
     Kb, N = b.shape
     assert K == Kb, f"A/B inner dims {K} vs {Kb}"
+    if not default_interpret() and (K // n) % 128:
+        raise ValueError(
+            f"gemm_rs on compiled TPU needs a lane-multiple K shard: K={K} "
+            f"over {n} ranks gives K_local={K // n} (Mosaic tiles lanes by "
+            "128; the interpret-mode simulator does not enforce this)")
     assert M % n == 0, f"M={M} not divisible by ranks {n}"
     m_seg = M // n
     # clamp tiles to the segment, then require exact divisibility

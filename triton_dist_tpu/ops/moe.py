@@ -44,8 +44,10 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from triton_dist_tpu.ops.allgather import all_gather
-from triton_dist_tpu.ops.allgather_gemm import ag_overlap_protocol
-from triton_dist_tpu.ops.common import collective_id_for
+from triton_dist_tpu.ops.allgather_gemm import (ag_overlap_protocol,
+                                                ag_overlap_protocol_2d)
+from triton_dist_tpu.ops.common import collective_id_for, norm_axis
+from triton_dist_tpu.shmem import device as shd
 from triton_dist_tpu.ops.gemm_reduce_scatter import (emit_slot_reduction,
                                                      rs_overlap_protocol)
 from triton_dist_tpu.ops.group_gemm import (align_tokens_by_expert,
@@ -58,9 +60,10 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-def _gather_ids(ctx: ShmemContext, ids: jax.Array, axis: str, t_local: int):
+def _gather_ids(ctx: ShmemContext, ids: jax.Array, axis, t_local: int):
     """AllGather routing ids as a lane-aligned int32 wire block; returns the
-    [n, t_local] gathered id matrix (replicated)."""
+    [n, t_local] gathered id matrix (replicated). ``axis`` may be a tuple
+    (hierarchical push)."""
     n = ctx.axis_size(axis)
     pad = _round_up(t_local, 128) - t_local
 
@@ -69,7 +72,10 @@ def _gather_ids(ctx: ShmemContext, ids: jax.Array, axis: str, t_local: int):
         return w.reshape(-1, 128)
 
     ids_wire = ctx.shard_map(pack, in_specs=P(axis), out_specs=P(axis))(ids)
-    g = all_gather(ctx, ids_wire, axis=axis, method="push")
+    if isinstance(axis, tuple):
+        g = all_gather(ctx, ids_wire, axis=axis, method="push_2d")
+    else:
+        g = all_gather(ctx, ids_wire, axis=axis, method="push")
     return g.reshape(n, -1)[:, :t_local]
 
 
@@ -93,8 +99,12 @@ def _ag_moe_kernel(axis, mesh_axes, bm, bn, out_dtype, n_blocks,
         emit_grouped_gemm(src_ref, w_ref, out_ref.at[pl.ds(seg * P_s, P_s)],
                           be_ref, seg * n_blocks, bm, bn, out_dtype)
 
-    ag_overlap_protocol(axis, mesh_axes, x_ref, ws_ref, send_sems, recv_sems,
-                        emit)
+    if isinstance(axis, tuple) and len(axis) > 1:
+        ag_overlap_protocol_2d(axis, mesh_axes, x_ref, ws_ref,
+                               send_sems, recv_sems, emit)
+    else:
+        ag_overlap_protocol(axis, mesh_axes, x_ref, ws_ref,
+                            send_sems, recv_sems, emit)
 
 
 def ag_moe_group_gemm(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
@@ -106,8 +116,10 @@ def ag_moe_group_gemm(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
     weight shard: [T, N_local] per device → global [T, N] sharded
     P(None, axis). Golden: all_gather + dense per-expert matmul.
     Entry analog: ag_group_gemm_intra_node
-    (allgather_group_gemm.py:317-770)."""
-    axis = axis or ctx.axis_names[0]
+    (allgather_group_gemm.py:317-770). ``axis`` may be an (outer, inner…)
+    tuple — the hierarchical 2-tier AG feeds the grouped GEMM (inter-node
+    analog, allgather_group_gemm.py:171-228)."""
+    axis = norm_axis(ctx, axis)
     n = ctx.axis_size(axis)
     mesh_axes = ctx.axis_names
     T, H = tokens.shape
@@ -124,7 +136,7 @@ def ag_moe_group_gemm(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
     be_flat = be.reshape(-1)
 
     def f(tok_shard, gi_full, rv_full, be_full, w_shard):
-        me = lax.axis_index(axis)
+        me = shd.my_pe(axis)
         # sender-side alignment of MY segment's tokens
         gi_me = lax.dynamic_index_in_dim(gi_full, me, keepdims=False)
         rv_me = lax.dynamic_index_in_dim(rv_full, me, keepdims=False)
@@ -151,7 +163,7 @@ def ag_moe_group_gemm(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
             ],
             compiler_params=pltpu.CompilerParams(
                 has_side_effects=True,
-                collective_id=collective_id_for("ag_moe")),
+                collective_id=collective_id_for(f"ag_moe_{axis}")),
             cost_estimate=pl.CostEstimate(
                 flops=2 * n * P_s * H * n_local,
                 bytes_accessed=(n * P_s * (H + n_local) + E * H * n_local)
@@ -192,6 +204,29 @@ def _moe_rs_kernel(axis, mesh_axes, bm, bn, n_blocks,
     emit_slot_reduction(ws_ref, out_ref, bm, bn)
 
 
+def _moe_rs_2d_kernel(axes, mesh_axes, bm, bn, n_blocks, P_seg,
+                      x_ref, w_ref, be_ref, red_ref, ws_ref, stage_ref,
+                      send_sems, recv_sems):
+    """Fast-tier stage of the hierarchical GroupGEMM-RS: the inner-group RS
+    segments are the *strided* aligned chunks {(r, j) : r < no} in
+    outer-major block order (same layout trick as _gemm_rs_2d_stage_kernel),
+    ready for the outer ring without re-permute."""
+    outer, inner = axes[0], tuple(axes[1:])
+    no = shd.n_pes(outer)
+    ni = shd.n_pes(inner)
+
+    def emit(j, dst_ref):
+        for r in range(no):
+            seg = r * ni + j
+            emit_grouped_gemm(x_ref.at[pl.ds(seg * P_seg, P_seg)], w_ref,
+                              dst_ref.at[pl.ds(r * P_seg, P_seg)],
+                              be_ref, seg * n_blocks, bm, bn)
+
+    rs_overlap_protocol(inner, mesh_axes, ws_ref, stage_ref,
+                        send_sems, recv_sems, emit)
+    emit_slot_reduction(ws_ref, red_ref, bm, bn)
+
+
 def moe_reduce_rs(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
                   topk_weights: jax.Array, weights: jax.Array,
                   axis: str | None = None, block_m: int = 128) -> jax.Array:
@@ -203,11 +238,19 @@ def moe_reduce_rs(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
     segment, ring-scatters partials to their owners overlapped with compute,
     reduces, then folds topk rows into per-token rows → [T, N] sharded
     P(axis). Golden: dense compute + psum_scatter
-    (cf. moe_reduce_rs.py:889-1027)."""
-    axis = axis or ctx.axis_names[0]
+    (cf. moe_reduce_rs.py:889-1027). ``axis`` may be an (outer, inner…)
+    tuple — fused GroupGEMM + fast-tier RS, then a slow-tier ring (the
+    inter-node analog, moe_reduce_rs.py:590-670)."""
+    axis = norm_axis(ctx, axis)
     n = ctx.axis_size(axis)
     mesh_axes = ctx.axis_names
     Tk, K = tokens.shape
+    if not default_interpret() and (K // n) % 128:
+        raise ValueError(
+            f"moe_reduce_rs on compiled TPU needs a lane-multiple K shard: "
+            f"K={K} over {n} ranks gives K_local={K // n} (Mosaic tiles "
+            "lanes by 128; the interpret-mode simulator does not enforce "
+            "this)")
     T, topk = topk_weights.shape
     assert Tk == T * topk
     assert T % n == 0, f"T={T} not divisible by ranks {n}"
@@ -225,7 +268,7 @@ def moe_reduce_rs(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
     be_flat = be.reshape(-1)
 
     def f(tok_shard, gi_full, rv_full, be_full, tw_full, w_shard):
-        me = lax.axis_index(axis)
+        me = shd.my_pe(axis)
         # aligned rows for every segment, from my K-shard of the tokens
         base = (jnp.arange(n, dtype=jnp.int32) * seg_rows)[:, None]
         rows = jnp.clip(base + gi_full, 0, Tk - 1).reshape(-1)
@@ -233,14 +276,23 @@ def moe_reduce_rs(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
              * rv_full.reshape(-1)[:, None].astype(tok_shard.dtype))
 
         bn = min(128, N)
-        kernel = lambda *refs: _moe_rs_kernel(axis, mesh_axes, bm, bn,
-                                              n_blocks, *refs)
+        hier = isinstance(axis, tuple)
+        if hier:
+            ni = ctx.axis_size(tuple(axis[1:]))
+            no = ctx.axis_size(axis[0])
+            chunk = no * P_seg
+            kernel = lambda *refs: _moe_rs_2d_kernel(axis, mesh_axes, bm, bn,
+                                                     n_blocks, P_seg, *refs)
+        else:
+            ni, no, chunk = n, 1, P_seg
+            kernel = lambda *refs: _moe_rs_kernel(axis, mesh_axes, bm, bn,
+                                                  n_blocks, *refs)
         y, _ws, _stage = pl.pallas_call(
             kernel,
             out_shape=(
-                jax.ShapeDtypeStruct((P_seg, N), jnp.float32),
-                jax.ShapeDtypeStruct((n, P_seg, N), jnp.float32),  # symm
-                jax.ShapeDtypeStruct((2, P_seg, N), jnp.float32),  # stage
+                jax.ShapeDtypeStruct((chunk, N), jnp.float32),
+                jax.ShapeDtypeStruct((ni, chunk, N), jnp.float32),  # symm
+                jax.ShapeDtypeStruct((2, chunk, N), jnp.float32),   # stage
             ),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY),
                       pl.BlockSpec(memory_space=pl.ANY),
@@ -248,11 +300,11 @@ def moe_reduce_rs(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
             out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 3,
             scratch_shapes=[
                 pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((n,)),
+                pltpu.SemaphoreType.DMA((ni,)),
             ],
             compiler_params=pltpu.CompilerParams(
                 has_side_effects=True,
-                collective_id=collective_id_for("moe_rs")),
+                collective_id=collective_id_for(f"moe_rs_{axis}")),
             cost_estimate=pl.CostEstimate(
                 flops=2 * n * P_seg * tok_shard.shape[1] * N,
                 bytes_accessed=(n * P_seg * (tok_shard.shape[1] + N))
@@ -260,6 +312,9 @@ def moe_reduce_rs(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
                 transcendentals=0),
             interpret=default_interpret(),
         )(x, w_shard, be_full)
+        if hier:
+            from triton_dist_tpu.ops.reduce_scatter import _rs_call
+            y = _rs_call(axis[0], mesh_axes, no, y)   # [P_seg, N] f32
 
         # my segment's metadata: unscramble aligned rows → (token, k) rows
         gi_me = lax.dynamic_index_in_dim(gi_full, me, keepdims=False)

@@ -19,7 +19,7 @@ def _shapes(ctx, M=None):
     from jax.sharding import PartitionSpec as P
     n = ctx.num_ranks
     M = M or 64 * n
-    K, N = 64 * n, 128
+    K, N = 128 * n, 128
     a = jax.random.normal(jax.random.key(0), (M, K), jnp.float32)
     b = jax.random.normal(jax.random.key(1), (K, N), jnp.float32)
     return ctx.shard(a, P(None, "x")), ctx.shard(b, P("x", None))

@@ -46,7 +46,7 @@ def reduce_rs():
     from triton_dist_tpu.ops.moe import moe_reduce_rs
     ctx = world_context()
     n = ctx.num_ranks
-    E, K, N, T, topk = 4, n * 64, 128, n * 8, 2
+    E, K, N, T, topk = 4, n * 128, 128, n * 8, 2
     tokens = jax.random.normal(jax.random.key(0), (T * topk, K), jnp.float32)
     ids = jax.random.randint(jax.random.key(1), (T * topk,), 0, E)
     tw = jax.nn.softmax(jax.random.normal(jax.random.key(2), (T, topk)), -1)
