@@ -19,9 +19,12 @@ One kernel per device (same transport idiom as ``ops/reduce_scatter``):
    lives in HBM ping-pong buffers packed as [acc ‖ m ‖ l] lanes, updated
    by an ``emit_pipeline`` over (head, q-tile, kv-tile) blocks per step —
    the blockwise flash pattern, with the ring as the outermost loop.
-4. Causal masking by *global* positions (q offset ``me*S``, kv offset
-   ``src*S``); fully-masked steps (src > me) skip compute with a single
-   state-copy DMA instead of the pipeline.
+4. Causal masking by *global* positions, derived per tile from the
+   sequence layout (``_layout_offs``/``_tile_off``). Two layouts:
+   contiguous (rank r holds rows [r*S, (r+1)*S); fully-masked steps
+   src > me skip the whole pipeline with one state-copy DMA) and zigzag
+   (rank r holds chunks (r, 2n-1-r) — balanced causal work every step;
+   fully-masked TILES skip their MXU work via ``pl.when``).
 
 Returns (out, lse): lse = m + log(l) per q row, the residual the backward
 pass and the decode combine both need (cf. reference
@@ -48,17 +51,37 @@ from triton_dist_tpu.utils import default_interpret
 _NEG = -1e30
 
 
-def _attn_step_pipeline(step_init, causal, sm_scale, D, bq, bk,
-                        q_off, kv_off, BH, Hq, Hkv, S,
+def _layout_offs(zigzag, r, c, S, n):
+    """(lo, hi) global offsets of rank ``r``'s local block: contiguous —
+    one run at r*S; zigzag — chunk pair (r, 2n-1-r) of c rows each."""
+    return (r * c, (2 * n - 1 - r) * c) if zigzag else (r * S, 0)
+
+
+def _tile_off(zigzag, c, lo, hi, start):
+    """Global position of a tile starting at LOCAL row ``start``. Contiguous
+    layout: one offset. Zigzag layout: the local block is [chunk lo ‖ chunk
+    hi] of c rows each (tiles never straddle the seam — block sizes divide
+    c), so the offset depends on which half the tile sits in."""
+    if not zigzag:
+        return lo + start
+    return jnp.where(start < c, lo + start, hi + (start - c))
+
+
+def _attn_step_pipeline(step_init, causal, zigzag, sm_scale, D, bq, bk,
+                        offs, BH, Hq, Hkv, S,
                         q_ref, k_src, v_src, st_in, st_out):
     """One ring step's blockwise attention: grid (head, q-tile, kv-tile),
     kv innermost so the packed [acc ‖ m ‖ l] state block stays resident
     across the kv sweep. ``step_init`` (python-static) selects fresh-state
     initialization (s == 0, the carry-in input is omitted entirely — no
     wasted fetch of the uninitialized buffer) vs carry-in from the
-    previous step's buffer."""
+    previous step's buffer. Fully-masked causal tiles skip all compute
+    (``pl.when``) — with the zigzag layout this makes per-step causal work
+    identical on every rank."""
     g = Hq // Hkv
     W = D + 256  # acc lanes ‖ m lanes ‖ l lanes
+    q_lo, q_hi, kv_lo, kv_hi = offs
+    c = S // 2 if zigzag else S
 
     def kv_head(bh):
         return (bh // Hq) * Hkv + (bh % Hq) // g
@@ -82,37 +105,46 @@ def _attn_step_pipeline(step_init, causal, sm_scale, D, bq, bk,
             else:
                 out_blk[...] = in_blk[...]
 
-        qf = q_blk[0].astype(jnp.float32)
-        kf = k_blk[0].astype(jnp.float32)
-        s_ij = lax.dot_general(qf, kf, (((1,), (1,)), ((), ())),
-                               preferred_element_type=jnp.float32)
-        s_ij = s_ij * sm_scale
+        q_t = _tile_off(zigzag, c, q_lo, q_hi, qi * bq)
+        kv_t = _tile_off(zigzag, c, kv_lo, kv_hi, kvi * bk)
+
+        def compute():
+            qf = q_blk[0].astype(jnp.float32)
+            kf = k_blk[0].astype(jnp.float32)
+            s_ij = lax.dot_general(qf, kf, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+            s_ij = s_ij * sm_scale
+            if causal:
+                qpos = q_t + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+                kpos = kv_t + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+                keep = kpos <= qpos
+                s_ij = jnp.where(keep, s_ij, _NEG)
+
+            acc_p = out_blk[0, :, :D]
+            m_p = jnp.max(out_blk[0, :, D:D + 128], axis=-1, keepdims=True)
+            l_p = jnp.max(out_blk[0, :, D + 128:], axis=-1, keepdims=True)
+
+            m_c = jnp.maximum(jnp.max(s_ij, axis=-1, keepdims=True), m_p)
+            p = jnp.exp(s_ij - m_c)
+            if causal:
+                # exp(-1e30 - (-1e30)) == 1 on fully-masked rows; re-mask
+                p = jnp.where(keep, p, 0.0)
+            alpha = jnp.exp(m_p - m_c)
+            l_c = l_p * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_c = acc_p * alpha + lax.dot_general(
+                p, v_blk[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+            out_blk[0, :, :D] = acc_c
+            out_blk[0, :, D:D + 128] = jnp.broadcast_to(m_c, (bq, 128))
+            out_blk[0, :, D + 128:] = jnp.broadcast_to(l_c, (bq, 128))
+
         if causal:
-            qpos = q_off + qi * bq + lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0)
-            kpos = kv_off + kvi * bk + lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 1)
-            keep = kpos <= qpos
-            s_ij = jnp.where(keep, s_ij, _NEG)
-
-        acc_p = out_blk[0, :, :D]
-        m_p = jnp.max(out_blk[0, :, D:D + 128], axis=-1, keepdims=True)
-        l_p = jnp.max(out_blk[0, :, D + 128:], axis=-1, keepdims=True)
-
-        m_c = jnp.maximum(jnp.max(s_ij, axis=-1, keepdims=True), m_p)
-        p = jnp.exp(s_ij - m_c)
-        if causal:
-            # exp(-1e30 - (-1e30)) == 1 on fully-masked rows; re-mask
-            p = jnp.where(keep, p, 0.0)
-        alpha = jnp.exp(m_p - m_c)
-        l_c = l_p * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_c = acc_p * alpha + lax.dot_general(
-            p, v_blk[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-        out_blk[0, :, :D] = acc_c
-        out_blk[0, :, D:D + 128] = jnp.broadcast_to(m_c, (bq, 128))
-        out_blk[0, :, D + 128:] = jnp.broadcast_to(l_c, (bq, 128))
+            # a tile is fully masked iff its first kv position is beyond
+            # its last q position — skip its MXU work entirely
+            pl.when(kv_t <= q_t + (bq - 1))(compute)
+        else:
+            compute()
 
     in_specs = [
         pl.BlockSpec((1, bq, D), lambda bh, qi, kvi: (bh, qi, 0)),
@@ -135,8 +167,8 @@ def _attn_step_pipeline(step_init, causal, sm_scale, D, bq, bk,
     )(*args, st_out)
 
 
-def _ring_fwd_kernel(axis, mesh_axes, causal, sm_scale, cfg_bq, cfg_bk,
-                     Hq, Hkv,
+def _ring_fwd_kernel(axis, mesh_axes, causal, zigzag, sm_scale,
+                     cfg_bq, cfg_bk, Hq, Hkv,
                      q_ref, k_ref, v_ref, o_ref, lse_ref,
                      st0, st1, kv_slots,
                      send_sems, recv_sems, ack_sem):
@@ -146,7 +178,8 @@ def _ring_fwd_kernel(axis, mesh_axes, causal, sm_scale, cfg_bq, cfg_bk,
     bq, bk = cfg_bq, cfg_bk
     right = shd.pe_at(mesh_axes, axis, lax.rem(me + 1, n))
     left = shd.pe_at(mesh_axes, axis, lax.rem(me - 1 + n, n))
-    q_off = me * S
+    c = S // 2
+    q_offs = _layout_offs(zigzag, me, c, S, n)
 
     shd.barrier_all((axis,), mesh_axes=mesh_axes)
 
@@ -154,7 +187,7 @@ def _ring_fwd_kernel(axis, mesh_axes, causal, sm_scale, cfg_bq, cfg_bk,
     for s in range(n):
         slot = s % 2
         src = lax.rem(me - s + n, n)
-        kv_off = src * S
+        kv_offs = _layout_offs(zigzag, src, c, S, n)
 
         if s >= 1:
             shd.wait_recv(kv_slots.at[slot], recv_sems.at[slot])
@@ -185,12 +218,14 @@ def _ring_fwd_kernel(axis, mesh_axes, causal, sm_scale, cfg_bq, cfg_bk,
             v_src = kv_slots.at[slot, :, :, D:]
 
         pipeline = functools.partial(
-            _attn_step_pipeline, s == 0, causal, sm_scale, D, bq, bk,
-            q_off, kv_off, BH, Hq, Hkv, S,
+            _attn_step_pipeline, s == 0, causal, zigzag, sm_scale, D, bq,
+            bk, q_offs + kv_offs, BH, Hq, Hkv, S,
             q_ref, k_src, v_src, st_in, st_out)
-        if causal and s > 0:
-            # src > me ⇒ every kv position is beyond every q position:
-            # skip the whole pipeline, carry the state forward with one DMA
+        if causal and not zigzag and s > 0:
+            # contiguous layout: src > me ⇒ every kv position is beyond
+            # every q position — skip the whole pipeline, carry the state
+            # forward with one DMA. (Zigzag has work every step by design;
+            # its balance comes from per-tile skips inside the pipeline.)
             @pl.when(src > me)
             def _():
                 pltpu.sync_copy(st_in, st_out)
@@ -243,7 +278,8 @@ def ring_attention_fwd(ctx: ShmemContext, q: jax.Array, k: jax.Array,
                        causal: bool = True, sm_scale: float | None = None,
                        block_q: int = 512, block_k: int = 512,
                        batch_axis: str | None = None,
-                       head_axis: str | None = None):
+                       head_axis: str | None = None,
+                       layout: str = "contiguous"):
     """Forward ring attention. ``q`` [B, Hq, S, D], ``k``/``v``
     [B, Hkv, S, D], all sharded P(batch_axis, head_axis, axis, None) —
     sequence over the ring ``axis`` (global S = n * S local), optionally
@@ -252,11 +288,21 @@ def ring_attention_fwd(ctx: ShmemContext, q: jax.Array, k: jax.Array,
     [B, Hq, S] f32 sharded the same) — lse is the backward/composition
     residual.
 
+    ``layout``: "contiguous" — device r holds global rows [r*S_loc,
+    (r+1)*S_loc); causal steps from future ranks are skipped whole.
+    "zigzag" — device r holds chunks (r, 2n-1-r) of S_glob/(2n) rows each
+    (concatenated), the standard load-balanced causal CP layout: every
+    rank computes exactly two chunk-pairs per step (fully-masked tiles are
+    skipped dynamically), vs 0..n for contiguous. Inputs/outputs stay in
+    zigzag order — see ``zigzag_indices`` for the global permutation.
+
     Hq % Hkv == 0 per shard (GQA; a head_axis must divide both); S_local
     divisible by block_q and block_k; D a lane multiple (128).
     """
     axis = norm_axis(ctx, axis)
     assert isinstance(axis, str), "ring attention rings one axis"
+    assert layout in ("contiguous", "zigzag"), layout
+    zigzag = layout == "zigzag"
     n = ctx.axis_size(axis)
     mesh_axes = ctx.axis_names
     B, Hq, S, D = q.shape
@@ -271,15 +317,19 @@ def ring_attention_fwd(ctx: ShmemContext, q: jax.Array, k: jax.Array,
         Hkvl = k_s.shape[1]
         assert Hql % Hkvl == 0, (
             f"per-shard GQA needs Hq % Hkv == 0, got {Hql}/{Hkvl}")
-        bq = math.gcd(block_q, s_loc)
-        bk = math.gcd(block_k, s_loc)
+        half = s_loc // 2 if zigzag else s_loc
+        if zigzag:
+            assert s_loc % 2 == 0, "zigzag needs an even local row count"
+        bq = math.gcd(block_q, half)
+        bk = math.gcd(block_k, half)
         BH, BHkv = Bl * Hql, Bl * Hkvl
         q3 = q_s.reshape(BH, s_loc, D)
         k3 = k_s.reshape(BHkv, s_loc, D)
         v3 = v_s.reshape(BHkv, s_loc, D)
         W = D + 256
         kernel = lambda *refs: _ring_fwd_kernel(
-            axis, mesh_axes, causal, scale, bq, bk, Hql, Hkvl, *refs)
+            axis, mesh_axes, causal, zigzag, scale, bq, bk, Hql, Hkvl,
+            *refs)
         out, lse, *_ = pl.pallas_call(
             kernel,
             out_shape=(
@@ -316,13 +366,15 @@ def ring_attention_fwd(ctx: ShmemContext, q: jax.Array, k: jax.Array,
     return sm(q, k, v)
 
 
-def _bwd_dq_pipeline(step_init, causal, scale, D, bq, bk, q_off, kv_off,
+def _bwd_dq_pipeline(step_init, causal, zigzag, scale, D, bq, bk, offs,
                      BH, Hq, Hkv, S,
                      q_ref, do_ref, lse_ref, dl_ref, k_src, v_src,
                      dq_in, dq_out):
     """dq accumulation for one ring step: grid (head, q-tile, kv-tile), kv
     innermost so the dq block stays resident across the kv sweep."""
     g = Hq // Hkv
+    q_lo, q_hi, kv_lo, kv_hi = offs
+    c = S // 2 if zigzag else S
 
     def kv_head(bh):
         return (bh // Hq) * Hkv + (bh % Hq) // g
@@ -342,12 +394,21 @@ def _bwd_dq_pipeline(step_init, causal, scale, D, bq, bk, q_off, kv_off,
             else:
                 dq_o[...] = dq_i[...]
 
-        p, dS, keep = _recompute_p_ds(
-            causal, scale, bq, bk, q_off + qi * bq, kv_off + kvi * bk,
-            q_blk, do_blk, lse_blk, dl_blk, k_blk, v_blk)
-        dq_o[0] += lax.dot_general(
-            dS, k_blk[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+        q_t = _tile_off(zigzag, c, q_lo, q_hi, qi * bq)
+        kv_t = _tile_off(zigzag, c, kv_lo, kv_hi, kvi * bk)
+
+        def compute():
+            p, dS, keep = _recompute_p_ds(
+                causal, scale, bq, bk, q_t, kv_t,
+                q_blk, do_blk, lse_blk, dl_blk, k_blk, v_blk)
+            dq_o[0] += lax.dot_general(
+                dS, k_blk[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+
+        if causal:
+            pl.when(kv_t <= q_t + (bq - 1))(compute)
+        else:
+            compute()
 
     in_specs = [
         pl.BlockSpec((1, bq, D), lambda bh, qi, kvi: (bh, qi, 0)),
@@ -369,7 +430,7 @@ def _bwd_dq_pipeline(step_init, causal, scale, D, bq, bk, q_off, kv_off,
     )(*args, dq_out)
 
 
-def _bwd_dkv_pipeline(step_init, causal, scale, D, bq, bk, q_off, kv_off,
+def _bwd_dkv_pipeline(step_init, causal, zigzag, scale, D, bq, bk, offs,
                       BHkv, Hq, Hkv, S,
                       q_ref, do_ref, lse_ref, dl_ref, k_src, v_src,
                       g_in, g_out):
@@ -378,6 +439,8 @@ def _bwd_dkv_pipeline(step_init, causal, scale, D, bq, bk, q_off, kv_off,
     across the whole (group, q) sweep, initialized from the arriving
     partial (or zeros at s == 0) and shipped onward afterwards."""
     g = Hq // Hkv
+    q_lo, q_hi, kv_lo, kv_hi = offs
+    c = S // 2 if zigzag else S
 
     def q_head(bhkv, hg):
         return (bhkv // Hkv) * Hq + (bhkv % Hkv) * g + hg
@@ -398,15 +461,24 @@ def _bwd_dkv_pipeline(step_init, causal, scale, D, bq, bk, q_off, kv_off,
             else:
                 g_o[...] = g_i[...]
 
-        p, dS, keep = _recompute_p_ds(
-            causal, scale, bq, bk, q_off + qi * bq, kv_off + kvi * bk,
-            q_blk, do_blk, lse_blk, dl_blk, k_blk, v_blk)
-        g_o[0, :, :D] += lax.dot_general(
-            dS, q_blk[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        g_o[0, :, D:] += lax.dot_general(
-            p, do_blk[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        q_t = _tile_off(zigzag, c, q_lo, q_hi, qi * bq)
+        kv_t = _tile_off(zigzag, c, kv_lo, kv_hi, kvi * bk)
+
+        def compute():
+            p, dS, keep = _recompute_p_ds(
+                causal, scale, bq, bk, q_t, kv_t,
+                q_blk, do_blk, lse_blk, dl_blk, k_blk, v_blk)
+            g_o[0, :, :D] += lax.dot_general(
+                dS, q_blk[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            g_o[0, :, D:] += lax.dot_general(
+                p, do_blk[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        if causal:
+            pl.when(kv_t <= q_t + (bq - 1))(compute)
+        else:
+            compute()
 
     in_specs = [
         pl.BlockSpec((1, bq, D),
@@ -457,7 +529,8 @@ def _recompute_p_ds(causal, scale, bq, bk, q_pos0, kv_pos0,
     return p, dS, keep
 
 
-def _ring_bwd_kernel(axis, mesh_axes, causal, scale, bq, bk, Hq, Hkv,
+def _ring_bwd_kernel(axis, mesh_axes, causal, zigzag, scale, bq, bk,
+                     Hq, Hkv,
                      q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
                      dq_ref, dk_ref, dv_ref,
                      dl_ref, dst0, dst1, gacc, kv_slots, g_slots,
@@ -467,7 +540,8 @@ def _ring_bwd_kernel(axis, mesh_axes, causal, scale, bq, bk, Hq, Hkv,
     BH, S, D = q_ref.shape
     right = shd.pe_at(mesh_axes, axis, lax.rem(me + 1, n))
     left = shd.pe_at(mesh_axes, axis, lax.rem(me - 1 + n, n))
-    q_off = me * S
+    c = S // 2
+    q_offs = _layout_offs(zigzag, me, c, S, n)
 
     shd.barrier_all((axis,), mesh_axes=mesh_axes)
 
@@ -489,7 +563,7 @@ def _ring_bwd_kernel(axis, mesh_axes, causal, scale, bq, bk, Hq, Hkv,
         slot = s % 2
         nxt = (s + 1) % 2
         src = lax.rem(me - s + n, n)
-        kv_off = src * S
+        kv_offs = _layout_offs(zigzag, src, c, S, n)
 
         if s >= 1:
             shd.wait_recv(kv_slots.at[slot], kv_recv.at[slot])
@@ -520,15 +594,15 @@ def _ring_bwd_kernel(axis, mesh_axes, causal, scale, bq, bk, Hq, Hkv,
 
         dq_in, dq_out = dstates[slot], dstates[nxt]
         run_a = functools.partial(
-            _bwd_dq_pipeline, s == 0, causal, scale, D, bq, bk, q_off,
-            kv_off, BH, Hq, Hkv, S, q_ref, do_ref, lse_ref, dl_ref,
-            k_src, v_src, dq_in, dq_out)
+            _bwd_dq_pipeline, s == 0, causal, zigzag, scale, D, bq, bk,
+            q_offs + kv_offs, BH, Hq, Hkv, S, q_ref, do_ref, lse_ref,
+            dl_ref, k_src, v_src, dq_in, dq_out)
         run_b = functools.partial(
-            _bwd_dkv_pipeline, s == 0, causal, scale, D, bq, bk, q_off,
-            kv_off, kv_slots.shape[1], Hq, Hkv, S, q_ref, do_ref, lse_ref,
-            dl_ref, k_src, v_src, g_slots.at[slot], gacc)
+            _bwd_dkv_pipeline, s == 0, causal, zigzag, scale, D, bq, bk,
+            q_offs + kv_offs, kv_slots.shape[1], Hq, Hkv, S, q_ref, do_ref,
+            lse_ref, dl_ref, k_src, v_src, g_slots.at[slot], gacc)
 
-        if causal and s > 0:
+        if causal and not zigzag and s > 0:
             @pl.when(src > me)
             def _():
                 pltpu.sync_copy(dq_in, dq_out)
@@ -587,7 +661,8 @@ def ring_attention_bwd(ctx: ShmemContext, q, k, v, o, lse, do,
                        axis: str, causal: bool, sm_scale: float | None,
                        block_q: int, block_k: int,
                        batch_axis: str | None = None,
-                       head_axis: str | None = None):
+                       head_axis: str | None = None,
+                       layout: str = "contiguous"):
     """Backward ring attention: a second ring pass where each KV block
     travels with its partial (dk ‖ dv) accumulator and arrives home after a
     full circle, while dq accumulates locally — flash-attention backward
@@ -595,13 +670,18 @@ def ring_attention_bwd(ctx: ShmemContext, q, k, v, o, lse, do,
     mesh_axes = ctx.axis_names
     n = ctx.axis_size(axis)
     D = q.shape[-1]
+    assert layout in ("contiguous", "zigzag"), layout
+    zigzag = layout == "zigzag"
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
 
     def f(q_s, k_s, v_s, o_s, lse_s, do_s):
         Bl, Hql, s_loc, _ = q_s.shape
         Hkvl = k_s.shape[1]
-        bq = math.gcd(block_q, s_loc)
-        bk = math.gcd(block_k, s_loc)
+        if zigzag:
+            assert s_loc % 2 == 0, "zigzag needs an even local row count"
+        half = s_loc // 2 if zigzag else s_loc
+        bq = math.gcd(block_q, half)
+        bk = math.gcd(block_k, half)
         BH, BHkv = Bl * Hql, Bl * Hkvl
         q3 = q_s.reshape(BH, s_loc, D)
         k3 = k_s.reshape(BHkv, s_loc, D)
@@ -610,7 +690,8 @@ def ring_attention_bwd(ctx: ShmemContext, q, k, v, o, lse, do,
         lse3 = lse_s.reshape(BH, 1, s_loc)
         do3 = do_s.reshape(BH, s_loc, D)
         kernel = lambda *refs: _ring_bwd_kernel(
-            axis, mesh_axes, causal, scale, bq, bk, Hql, Hkvl, *refs)
+            axis, mesh_axes, causal, zigzag, scale, bq, bk, Hql, Hkvl,
+            *refs)
         dq, dk, dv, *_ = pl.pallas_call(
             kernel,
             out_shape=(
@@ -660,16 +741,18 @@ def ring_attention(ctx: ShmemContext, q: jax.Array, k: jax.Array,
                    causal: bool = True, sm_scale: float | None = None,
                    block_q: int = 512, block_k: int = 512,
                    batch_axis: str | None = None,
-                   head_axis: str | None = None) -> jax.Array:
+                   head_axis: str | None = None,
+                   layout: str = "contiguous") -> jax.Array:
     """Context-parallel blockwise attention over a ring (public,
     differentiable entry). Golden: dense softmax attention on the gathered
     sequence; gradient golden: jax.grad of the dense computation.
     ``batch_axis``/``head_axis`` compose with dp/tp meshes (independent
-    rings per (dp, tp) row)."""
+    rings per (dp, tp) row). ``layout="zigzag"`` is the load-balanced
+    causal layout (see ``ring_attention_fwd`` and ``zigzag_indices``)."""
     axis_n = norm_axis(ctx, axis)
     kw = dict(axis=axis_n, causal=causal, sm_scale=sm_scale,
               block_q=block_q, block_k=block_k, batch_axis=batch_axis,
-              head_axis=head_axis)
+              head_axis=head_axis, layout=layout)
 
     @jax.custom_vjp
     def attn(q, k, v):
@@ -688,4 +771,21 @@ def ring_attention(ctx: ShmemContext, q: jax.Array, k: jax.Array,
     return attn(q, k, v)
 
 
-__all__ = ["ring_attention", "ring_attention_fwd", "ring_attention_bwd"]
+def zigzag_indices(S: int, n: int):
+    """Global row permutation for the zigzag layout: device r holds global
+    chunks (r, 2n-1-r) of S/(2n) rows each, concatenated. Returns ``idx``
+    with ``x_zigzag = x[idx]`` (sharding the result P(axis) gives each
+    device its zigzag block) and ``inv`` with ``x = x_zigzag[inv]``."""
+    assert S % (2 * n) == 0, (S, n)
+    import numpy as np
+    c = S // (2 * n)
+    idx = np.concatenate([
+        np.concatenate([np.arange(r * c, (r + 1) * c),
+                        np.arange((2 * n - 1 - r) * c, (2 * n - r) * c)])
+        for r in range(n)])
+    inv = np.argsort(idx)
+    return idx, inv
+
+
+__all__ = ["ring_attention", "ring_attention_fwd", "ring_attention_bwd",
+           "zigzag_indices"]
