@@ -200,6 +200,34 @@ def bench_a2a(ctx, tokens_per_rank: int, hidden: int, topk: int,
     return dispatch_s, roundtrip_s
 
 
+def bench_attn(ctx, i1: int, i2: int, B: int = 1, Hq: int = 16,
+               Hkv: int = 4, D: int = 128, s_loc: int = 4096
+               ) -> dict[str, float]:
+    """Causal ring-attention forward TFLOP/s per chip (at n=1: the blockwise
+    flash kernel itself — MXU efficiency of the per-step inner loop)."""
+    from triton_dist_tpu.ops.ring_attention import ring_attention
+    axis = ctx.axis_names[0]
+    n = ctx.axis_size(axis)
+    S = n * s_loc
+    q = (jax.random.normal(jax.random.key(0), (B, Hq, S, D), jnp.float32)
+         * 0.5).astype(jnp.bfloat16)
+    k = (jax.random.normal(jax.random.key(1), (B, Hkv, S, D), jnp.float32)
+         * 0.5).astype(jnp.bfloat16)
+    v = (jax.random.normal(jax.random.key(2), (B, Hkv, S, D), jnp.float32)
+         * 0.5).astype(jnp.bfloat16)
+    spec = P(None, None, axis)
+    ks_, vs_ = ctx.shard(k, spec), ctx.shard(v, spec)
+
+    def step(qq, _):
+        o = ring_attention(ctx, qq, ks_, vs_, axis=axis, causal=True)
+        return qq + (o * jnp.asarray(1e-20, o.dtype))
+
+    s = _per_iter(make_chain_timer(step, ctx.shard(q, spec),
+                                   jnp.zeros((), jnp.bfloat16)), i1, i2)
+    flops = 2 * 2 * B * Hq * S * S * D / 2  # 2 matmuls; causal halves
+    return {"attn_tflops_per_chip": round(flops / s / max(n, 1) / 1e12, 2)}
+
+
 def bench_decode(ctx, i1: int, i2: int, B: int = 1, Hq: int = 32,
                  Hkv: int = 8, D: int = 128, s_local: int = 1024
                  ) -> dict[str, float]:
@@ -352,6 +380,11 @@ def main():
         extras.update(bench_decode(ctx, i1=di1, i2=di2, **dec_shape))
     except Exception as e:
         extras["decode_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        ash = dict(s_loc=256, Hq=4, Hkv=2) if on_cpu() else {}
+        extras.update(bench_attn(ctx, i1=i1, i2=i2, **ash))
+    except Exception as e:
+        extras["attn_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
         # fp8 wire + scale side-channel — the reference's showcase protocol.
         # At n=1 this measures pure quantize/dequant overhead (no wire to

@@ -276,7 +276,7 @@ def _ring_fwd_kernel(axis, mesh_axes, causal, zigzag, sm_scale,
 def ring_attention_fwd(ctx: ShmemContext, q: jax.Array, k: jax.Array,
                        v: jax.Array, axis: str | None = None,
                        causal: bool = True, sm_scale: float | None = None,
-                       block_q: int = 512, block_k: int = 512,
+                       block_q: int = 1024, block_k: int = 1024,
                        batch_axis: str | None = None,
                        head_axis: str | None = None,
                        layout: str = "contiguous"):
@@ -659,7 +659,7 @@ def _ring_bwd_kernel(axis, mesh_axes, causal, zigzag, scale, bq, bk,
 
 def ring_attention_bwd(ctx: ShmemContext, q, k, v, o, lse, do,
                        axis: str, causal: bool, sm_scale: float | None,
-                       block_q: int, block_k: int,
+                       block_q: int = 1024, block_k: int = 1024,
                        batch_axis: str | None = None,
                        head_axis: str | None = None,
                        layout: str = "contiguous"):
@@ -739,7 +739,7 @@ def ring_attention_bwd(ctx: ShmemContext, q, k, v, o, lse, do,
 def ring_attention(ctx: ShmemContext, q: jax.Array, k: jax.Array,
                    v: jax.Array, axis: str | None = None,
                    causal: bool = True, sm_scale: float | None = None,
-                   block_q: int = 512, block_k: int = 512,
+                   block_q: int = 1024, block_k: int = 1024,
                    batch_axis: str | None = None,
                    head_axis: str | None = None,
                    layout: str = "contiguous") -> jax.Array:
