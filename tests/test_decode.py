@@ -93,3 +93,43 @@ def test_generate_greedy_consistent():
     full = forward(params, prompt, cfg)
     np.testing.assert_array_equal(np.asarray(toks[:, 0]),
                                   np.asarray(jnp.argmax(full[:, -1], -1)))
+
+
+def test_sp_decode_step_matches_single():
+    """decode_step_sp over a 4-way KV-sharded cache == single-device
+    decode_step (the model-level SP serving loop; reference
+    sp_flash_decode_layer.py:78-184)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from conftest import TEST_WORLD
+    from triton_dist_tpu.models.llama import decode_step_sp
+    from triton_dist_tpu.shmem.context import initialize_distributed
+
+    ctx = initialize_distributed(axis_names=("x",), mesh_shape=(TEST_WORLD,))
+    cfg = LlamaConfig(vocab_size=256, d_model=256, n_layers=2, n_heads=2,
+                      n_kv_heads=2, d_ff=256, max_seq_len=4 * 32)
+    params = init_params(jax.random.key(0), cfg)
+    B, S = 4, cfg.max_seq_len  # B*Hq = 8 rows (sublane-safe merge buffer)
+    cache = init_kv_cache(cfg, B, S)
+    spec = P(None, None, None, "x", None)
+    cache = {k: jax.device_put(v, NamedSharding(ctx.mesh, spec))
+             for k, v in cache.items()}
+
+    token = jax.random.randint(jax.random.key(1), (B,), 0, cfg.vocab_size)
+    logits_ref = None
+    pos = 0
+    # a few steps so later steps read cache entries written by earlier ones
+    step_sp = jax.jit(lambda p, t, pos, c: decode_step_sp(
+        ctx, p, t, pos, cfg, c, axis="x"))
+    step_1d = jax.jit(lambda p, t, pos, c: decode_step(p, t, pos, cfg, c))
+    cache_1d = init_kv_cache(cfg, B, S)
+    for pos in range(3):
+        l_sp, cache = step_sp(params, token, pos, cache)
+        l_1d, cache_1d = step_1d(params, token, pos, cache_1d)
+        # bf16 activations + a different partial-merge order: ~5e-3 noise
+        np.testing.assert_allclose(np.asarray(l_sp), np.asarray(l_1d),
+                                   rtol=1e-2, atol=1e-2)
+        # host round-trip: a mesh-sharded token input would drag the SPMD
+        # partitioner into the single-device path's scanned interpret kernel
+        token = jnp.asarray(np.argmax(np.asarray(l_sp), axis=-1),
+                            jnp.int32)

@@ -357,6 +357,63 @@ def decode_step(params: dict, token: jax.Array, pos: jax.Array,
     return logits, {"k": ks, "v": vs}
 
 
+def decode_step_sp(ctx, params: dict, token: jax.Array, pos: jax.Array,
+                   cfg: LlamaConfig, cache: dict,
+                   axis: str | None = None,
+                   ag_method: str = "fused") -> tuple[jax.Array, dict]:
+    """Sequence-parallel one-token decode: the KV cache is sharded on its
+    sequence dim across ``axis`` and attention runs the distributed
+    flash-decode (local split-KV + fused partial-AG + lse-merge) — the
+    model-level serving loop over ``SpGQAFlashDecodeAttention`` (reference
+    sp_flash_decode_layer.py:78-184; its README decode-scaling workload).
+    The cache update for the new token's (k, v) is a global
+    dynamic_update_slice — GSPMD routes it to the owning shard. Weights
+    are replicated (compose TP separately).
+
+    ``cache`` as from ``init_kv_cache`` with k/v sharded
+    P(None, None, None, axis, None) ([layers, B, Hkv, S, D] on S).
+    """
+    from triton_dist_tpu.ops.flash_decode import sp_gqa_flash_decode
+
+    axis = axis or ctx.axis_names[0]
+    B = token.shape[0]
+    Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    x = params["embed"][token].astype(cfg.dtype)
+    positions = jnp.full((B, 1), pos, jnp.int32)
+
+    # python-unrolled layer loop (not lax.scan): the distributed decode
+    # kernel's shard_map does not compose with scan under the SPMD
+    # partitioner on every backend, and decode-step jaxprs are small
+    ks_out, vs_out = [], []
+    for i in range(cfg.n_layers):
+        p = jax.tree.map(lambda a, i=i: a[i], params["blocks"])
+        ck, cv = cache["k"][i], cache["v"][i]
+        h = rmsnorm(x, p["attn_norm"], cfg.norm_eps)
+        q = rope((h @ p["wq"]).reshape(B, 1, Hq, Dh), positions,
+                 cfg.rope_theta)[:, 0]
+        k = rope((h @ p["wk"]).reshape(B, 1, Hkv, Dh), positions,
+                 cfg.rope_theta)
+        v = (h @ p["wv"]).reshape(B, 1, Hkv, Dh)
+        ck = lax.dynamic_update_slice(ck, k.transpose(0, 2, 1, 3),
+                                      (0, 0, pos, 0))
+        cv = lax.dynamic_update_slice(cv, v.transpose(0, 2, 1, 3),
+                                      (0, 0, pos, 0))
+        kv_len = jnp.full((B,), pos + 1, jnp.int32)
+        attn = sp_gqa_flash_decode(ctx, q, ck, cv, kv_len, axis=axis,
+                                   ag_method=ag_method)
+        x = x + attn.reshape(B, Hq * Dh).astype(x.dtype) @ p["wo"]
+        h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps)
+        ff = jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)
+                         ).astype(h.dtype) * (h @ p["w_up"])
+        x = x + ff @ p["w_down"]
+        ks_out.append(ck)
+        vs_out.append(cv)
+
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": jnp.stack(ks_out), "v": jnp.stack(vs_out)}
+
+
 def generate(params: dict, prompt: jax.Array, cfg: LlamaConfig,
              max_new_tokens: int, max_seq: int | None = None) -> jax.Array:
     """Greedy generation: prefill + scanned decode loop (batch decode, the
