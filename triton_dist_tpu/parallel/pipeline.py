@@ -22,16 +22,20 @@ from jax import lax
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x_micro: jax.Array,
-                   axis: str = "pp"):
+                   axis: str = "pp", with_aux: bool = False):
     """Run inside ``shard_map`` (manual over ``axis``).
 
-    stage_fn(stage_params, h) -> h : this stage's chunk of the network.
+    stage_fn(stage_params, h) -> h : this stage's chunk of the network
+    (with ``with_aux``: ``-> (h, aux_scalar)`` — e.g. MoE balance loss).
     stage_params: params for the local layer chunk (leading layer dim already
     sliced by shard_map).
     x_micro: [n_micro, mb, ...] microbatched input (same on every stage;
     only stage 0 reads it).
     Returns [n_micro, mb, ...] outputs, valid on the LAST stage and zeros
-    elsewhere — callers ``psum`` over ``axis`` to broadcast.
+    elsewhere — callers ``psum`` over ``axis`` to broadcast. With
+    ``with_aux``: ``(outs, aux_total)`` where aux is summed over real work
+    steps only (pipeline bubbles run stage_fn on garbage activations; their
+    aux must not pollute the loss) and psum-reduced over stages.
     """
     n_stages = lax.axis_size(axis)
     stage = lax.axis_index(axis)
@@ -41,12 +45,19 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_micro: jax.Array,
     outs0 = jnp.zeros_like(x_micro)
 
     def step(carry, t):
-        state, outs = carry
+        state, outs, aux_acc = carry
         # stage 0 injects microbatch t; later stages consume last hop's recv
         inject = lax.dynamic_index_in_dim(
             x_micro, jnp.clip(t, 0, n_micro - 1), keepdims=False)
         h_in = jnp.where(stage == 0, inject, state)
-        h_out = stage_fn(stage_params, h_in)
+        if with_aux:
+            h_out, aux = stage_fn(stage_params, h_in)
+            # real work ⇔ this step's activation is microbatch (t - stage)
+            working = ((t - stage >= 0)
+                       & (t - stage < n_micro)).astype(jnp.float32)
+            aux_acc = aux_acc + aux.astype(jnp.float32) * working
+        else:
+            h_out = stage_fn(stage_params, h_in)
         # last stage stores microbatch (t - (P-1)) when it's valid
         out_idx = t - (n_stages - 1)
         valid = (stage == n_stages - 1) & (out_idx >= 0)
@@ -58,15 +69,19 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_micro: jax.Array,
         # overwrites with its injection)
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
         state = lax.ppermute(h_out, axis, perm)
-        return (state, outs), None
+        return (state, outs, aux_acc), None
 
-    (_, outs), _ = lax.scan(step, (state0, outs0),
-                            jnp.arange(steps, dtype=jnp.int32))
+    (_, outs, aux_acc), _ = lax.scan(
+        step, (state0, outs0, jnp.float32(0)),
+        jnp.arange(steps, dtype=jnp.int32))
     # broadcast the last stage's outputs to every stage (f32 psum: XLA CPU's
     # AllReducePromotion pass check-fails cloning a bf16 all-reduce here)
     is_last = (stage == n_stages - 1).astype(jnp.float32)
-    return lax.psum(outs.astype(jnp.float32) * is_last,
+    outs = lax.psum(outs.astype(jnp.float32) * is_last,
                     axis).astype(outs.dtype)
+    if with_aux:
+        return outs, lax.psum(aux_acc, axis) / n_micro
+    return outs
 
 
 __all__ = ["pipeline_apply"]

@@ -84,7 +84,6 @@ def make_train_step(cfg, mesh, plan: ParallelPlan | None = None,
         assert plan.pp is None and not is_moe, (
             "cp (ring attention) composes with dp/tp only for now")
     if is_moe:
-        assert plan.pp is None, "pp+MoE composition not wired yet"
         specs = moe_mod.moe_param_specs(cfg, tp=plan.tp, ep=plan.ep)
         init_raw = lambda key: moe_mod.init_moe_params(key, cfg)
     else:
@@ -102,7 +101,54 @@ def make_train_step(cfg, mesh, plan: ParallelPlan | None = None,
     act_spec = plan.act_spec()
 
     # ---- forward/loss ----------------------------------------------------
-    if is_moe:
+    if is_moe and plan.pp is not None:
+        pp, n_micro = plan.pp, plan.n_micro
+        n_stages = mesh.shape[pp]
+        b = cfg.base
+        assert b.n_layers % n_stages == 0
+
+        def stage_fn(blocks, h):
+            S = h.shape[1]
+            positions = jnp.arange(S)[None, :].repeat(h.shape[0], 0)
+
+            def body(carry, p):
+                x, aux = carry
+                x, a = moe_mod.moe_block_apply(cfg, x, p, positions,
+                                               act_spec)
+                return (x, aux + a), None
+
+            if plan.remat:
+                body = jax.checkpoint(body)
+            (h, aux), _ = lax.scan(body, (h, jnp.float32(0)), blocks)
+            return h, aux
+
+        block_pp_specs = jax.tree.map(lambda _: P(pp), specs["blocks"],
+                                      is_leaf=lambda x: isinstance(x, P))
+
+        def loss_fn(params, tokens):
+            B, S = tokens.shape
+            assert B % n_micro == 0, (B, n_micro)
+            mb = B // n_micro
+            x = params["embed"][tokens].astype(jnp.float32)
+            x_micro = x.reshape(n_micro, mb, S, b.d_model)
+
+            pipe = jax.shard_map(
+                lambda blocks, xm: (lambda o, a: (o.astype(jnp.float32), a))(
+                    *pipeline_apply(stage_fn, blocks,
+                                    xm.astype(b.dtype), axis=pp,
+                                    with_aux=True)),
+                mesh=mesh,
+                in_specs=(block_pp_specs, P()),
+                out_specs=(P(), P()),
+                axis_names={pp},
+                check_vma=False,
+            )
+            outs, aux = pipe(params["blocks"], x_micro)
+            x = outs.reshape(B, S, b.d_model)
+            x = llama_mod.rmsnorm(x, params["final_norm"], b.norm_eps)
+            logits = (x @ params["lm_head"]).astype(jnp.float32)
+            return _xent(logits, tokens) + aux
+    elif is_moe:
         def loss_fn(params, tokens):
             logits, aux = moe_mod.moe_forward(params, tokens, cfg,
                                               act_spec=act_spec,

@@ -61,9 +61,24 @@ def initialize_distributed(axis_names: Sequence[str] = ("x",),
     if n_mesh > devices.size:
         raise ValueError(f"mesh_shape {mesh_shape} needs {n_mesh} devices, "
                          f"only {devices.size} available")
-    # A prefix subset is allowed (e.g. a 4-device test mesh on an 8-device
-    # host, or one slice of a larger deployment).
-    mesh = Mesh(devices[:n_mesh].reshape(tuple(mesh_shape)), tuple(axis_names))
+    dev_grid = None
+    if n_mesh == devices.size and devices[0].platform == "tpu":
+        # Topology-aware device ordering: ring/relay neighbors along the
+        # innermost mesh axis should be physically adjacent on the ICI
+        # torus. This is the TPU analog of the reference's NVLink/NUMA
+        # topology detection feeding its AG method pick
+        # (utils.py:504-607, allgather.py:54-69) — here jax's device-coords
+        # mesh builder does the detection.
+        try:
+            from jax.experimental import mesh_utils
+            dev_grid = mesh_utils.create_device_mesh(tuple(mesh_shape))
+        except Exception:
+            dev_grid = None   # odd topologies/subsets: fall back to order
+    if dev_grid is None:
+        # Prefix subset (e.g. a 4-device test mesh on an 8-device host) or
+        # non-TPU backend: plain enumeration order.
+        dev_grid = devices[:n_mesh].reshape(tuple(mesh_shape))
+    mesh = Mesh(dev_grid, tuple(axis_names))
     ctx = ShmemContext(mesh=mesh)
     _DEFAULT_CONTEXT = ctx
     return ctx
