@@ -101,3 +101,50 @@ def test_sp_decode_fused_8way(ctx8):
         q, ctx8.shard(k, P(None, None, "x")),
         ctx8.shard(v, P(None, None, "x")), kv)
     assert_allclose(np.asarray(out), np.asarray(gold), atol=1e-4, rtol=1e-4)
+
+
+@pytest.fixture(scope="module")
+def ctx24():
+    """(2, 4) two-tier mesh over the driver's 8-way device count."""
+    return initialize_distributed(axis_names=("o", "i"), mesh_shape=(2, 4))
+
+
+def test_ag_gemm_2d_8way(ctx24):
+    from triton_dist_tpu.ops.allgather_gemm import GemmConfig, ag_gemm
+    n, axes = 8, ("o", "i")
+    M, K, N = n * 8, 128, n * 16
+    a = ctx24.shard(jax.random.normal(jax.random.key(0), (M, K)), P(axes))
+    b = ctx24.shard(jax.random.normal(jax.random.key(1), (K, N)),
+                    P(None, axes))
+    c = jax.jit(lambda a, b: ag_gemm(ctx24, a, b, axis=axes,
+                                     cfg=GemmConfig(8, 16),
+                                     out_dtype=jnp.float32))(a, b)
+
+    def g(a_s, b_s):
+        af = jax.lax.all_gather(a_s, axes, axis=0, tiled=True)
+        return jnp.dot(af, b_s, preferred_element_type=jnp.float32)
+    gold = jax.jit(ctx24.shard_map(g, in_specs=(P(axes), P(None, axes)),
+                                   out_specs=P(None, axes)))(a, b)
+    assert_allclose(np.asarray(c), np.asarray(gold), atol=1e-4, rtol=1e-4)
+
+
+def test_gemm_rs_2d_8way(ctx24):
+    from triton_dist_tpu.ops.gemm_reduce_scatter import GemmConfig, gemm_rs
+    n, axes = 8, ("o", "i")
+    M, K, N = n * 8, n * 16, 32
+    a = ctx24.shard(jax.random.normal(jax.random.key(0), (M, K)),
+                    P(None, axes))
+    b = ctx24.shard(jax.random.normal(jax.random.key(1), (K, N)),
+                    P(axes, None))
+    c = jax.jit(lambda a, b: gemm_rs(ctx24, a, b, axis=axes,
+                                     cfg=GemmConfig(8, 32),
+                                     out_dtype=jnp.float32))(a, b)
+
+    def g(a_s, b_s):
+        part = jnp.dot(a_s, b_s, preferred_element_type=jnp.float32)
+        return jax.lax.psum_scatter(part, axes, scatter_dimension=0,
+                                    tiled=True)
+    gold = jax.jit(ctx24.shard_map(g, in_specs=(P(None, axes),
+                                                P(axes, None)),
+                                   out_specs=P(axes)))(a, b)
+    assert_allclose(np.asarray(c), np.asarray(gold), atol=1e-4, rtol=1e-4)

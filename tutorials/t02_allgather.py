@@ -54,9 +54,29 @@ def correctness_2d():
     import jax.numpy as jnp
     x = jnp.arange(n_dev * 8 * 128, dtype=jnp.float32).reshape(n_dev * 8, 128)
     xs = ctx.shard(x, P(("a", "b")))
-    y = jax.jit(lambda v: all_gather(ctx, v, method="ring_2d"))(xs)
-    np.testing.assert_allclose(np.asarray(y), np.asarray(x))
-    print(f"hierarchical ring_2d over a (2, {n_dev // 2}) mesh == golden")
+    for method in ("ring_2d", "push_2d"):
+        y = jax.jit(lambda v, m=method: all_gather(ctx, v, method=m))(xs)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+        print(f"hierarchical {method} over a (2, {n_dev // 2}) mesh "
+              "== golden")
+
+
+@register_case("correctness_broadcast")
+def correctness_broadcast():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from triton_dist_tpu.ops import broadcast
+    ctx = world_context()
+    n = ctx.num_ranks
+    x = jnp.stack([jnp.full((16, 128), float(i)) for i in range(n)])
+    root = n - 1
+    y = jax.jit(lambda v: broadcast(ctx, v, axis="x", root=root))(
+        ctx.shard(x, P("x")))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x[root]))
+    print(f"broadcast(root={root}) over {n} PEs == golden")
 
 
 @register_case("perf")
