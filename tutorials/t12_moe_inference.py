@@ -1,0 +1,107 @@
+"""Tutorial 12 — end-to-end expert-parallel MoE inference block.
+
+Analog of reference test/nvidia/test_ep_moe_inference.py (the end-to-end
+EP block its README showcases): router → low-latency A2A dispatch →
+grouped expert FFN on each rank's local experts → A2A combine with top-k
+weights — `models.moe.moe_mlp_ep_overlap` over `EPAll2AllLayer`.
+
+Cases: bf16 wire and the fp8 quantized wire with the f32 scale
+side-channel (low_latency_all_to_all.py:60-88, README.md:55). The
+hierarchical 2-tier dispatch path is exercised at the layer level
+(tests/test_layers.py, tests/test_hierarchical.py).
+
+Run:  python -m tutorials.t12_moe_inference [--sim 4]
+      [--case correctness|correctness_fp8|perf]
+"""
+
+from tutorials.common import (perf_report, register_case, time_op,
+                              tutorial_main, world_context)
+
+
+def _weights(E, D, F):
+    import jax
+    import jax.numpy as jnp
+    router_w = jax.random.normal(jax.random.key(1), (D, E),
+                                 jnp.float32) * 0.3
+    mk = lambda k, s: (jax.random.normal(jax.random.key(k), s)
+                       * 0.1).astype(jnp.bfloat16)
+    return router_w, mk(2, (E, D, F)), mk(3, (E, D, F)), mk(4, (E, F, D))
+
+
+def _golden(x, router_w, wg, wu, wd, k):
+    import jax
+    import jax.numpy as jnp
+    x32, wg32, wu32, wd32 = (a.astype(jnp.float32) for a in (x, wg, wu, wd))
+    logits = x32 @ router_w
+    gv, gi = jax.lax.top_k(jax.nn.softmax(logits, -1), k)
+    gv = gv / jnp.sum(gv, -1, keepdims=True)
+    h = jax.nn.silu(jnp.einsum("td,edf->tef", x32, wg32)) \
+        * jnp.einsum("td,edf->tef", x32, wu32)
+    ye = jnp.einsum("tef,efd->ted",
+                    h.astype(jnp.bfloat16).astype(jnp.float32), wd32)
+    sel = jnp.take_along_axis(ye, gi[..., None], axis=1)
+    return jnp.sum(sel * gv[..., None], axis=1)
+
+
+def _run(ctx, axis, wire_dtype=None, T_local=16, D=256, F=256, k=2,
+         tol=8e-2):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from triton_dist_tpu.layers import EPAll2AllLayer
+    from triton_dist_tpu.models.moe import moe_mlp_ep_overlap
+    n = ctx.num_ranks
+    E = 2 * n
+    T = n * T_local
+    x = (jax.random.normal(jax.random.key(0), (T, D), jnp.float32)
+         * 0.3).astype(jnp.bfloat16)
+    router_w, wg, wu, wd = _weights(E, D, F)
+    layer = EPAll2AllLayer.create(ctx, max_tokens=T_local, hidden=D, topk=k,
+                                  num_experts=E, axis=axis,
+                                  wire_dtype=wire_dtype)
+    spec = P(axis) if isinstance(axis, str) or axis is None else P(axis)
+    xs = ctx.shard(x, spec)
+    got = jax.jit(lambda v: moe_mlp_ep_overlap(
+        ctx, layer, v, router_w, wg, wu, wd,
+        axis=axis if isinstance(axis, str) else None))(xs)
+    gold = _golden(x, router_w, wg, wu, wd, k)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(gold), atol=tol, rtol=tol)
+    return layer, xs, (router_w, wg, wu, wd)
+
+
+@register_case("correctness")
+def correctness():
+    ctx = world_context()
+    _run(ctx, "x")
+    print(f"EP MoE block over {ctx.num_ranks} PEs == dense golden")
+
+
+@register_case("correctness_fp8")
+def correctness_fp8():
+    import jax.numpy as jnp
+    ctx = world_context()
+    # fp8 wire: coarser tolerance (the e4m3 payload carries ~2 decimal
+    # digits; the f32 per-row scale restores magnitude)
+    _run(ctx, "x", wire_dtype=jnp.float8_e4m3fn, tol=2e-1)
+    print(f"EP MoE block (fp8 wire + scale channel) over "
+          f"{ctx.num_ranks} PEs == dense golden")
+
+
+@register_case("perf")
+def perf():
+    import jax
+
+    from triton_dist_tpu.models.moe import moe_mlp_ep_overlap
+    ctx = world_context()
+    layer, xs, (router_w, wg, wu, wd) = _run(ctx, "x", T_local=64)
+    f = jax.jit(lambda v: moe_mlp_ep_overlap(ctx, layer, v, router_w,
+                                             wg, wu, wd, axis="x"))
+    s = time_op(lambda: f(xs))
+    perf_report("moe_ep_block", s, f"({xs.shape[0]} tokens global)")
+
+
+if __name__ == "__main__":
+    tutorial_main(__doc__)
