@@ -305,3 +305,32 @@ def test_moe_reduce_rs_2d(ctx2d):
                      for i in range(Tk)]).reshape(T, topk, Nw)
     golden = np.sum(rows * np.asarray(tw)[..., None], axis=1)
     assert_allclose(np.asarray(y), golden, atol=1e-3, rtol=1e-3)
+
+
+def test_gemm_rs_2d_repeated_ws(ctx2d):
+    """Persistent fast-tier workspace threaded through repeated 2-tier
+    GEMM-RS calls (entry barrier protects reuse)."""
+    from triton_dist_tpu.ops.gemm_reduce_scatter import (
+        GemmConfig, create_gemm_rs_workspace, gemm_rs_ws)
+    n, axes = 6, ("a", "b")
+    M, K, N = n * 16, n * 16, 32
+    cfg = GemmConfig(block_m=16, block_n=32)
+    ws, stage = create_gemm_rs_workspace(ctx2d, M // n, N, jnp.float32,
+                                         axis=axes)
+    f = jax.jit(lambda a, b, w, s: gemm_rs_ws(ctx2d, a, b, w, s, axis=axes,
+                                              cfg=cfg))
+
+    def g(a_s, b_s):
+        part = jnp.dot(a_s, b_s, preferred_element_type=jnp.float32)
+        return jax.lax.psum_scatter(part, axes, scatter_dimension=0,
+                                    tiled=True)
+    gold = jax.jit(ctx2d.shard_map(g, in_specs=(P(None, axes), P(axes, None)),
+                                   out_specs=P(axes)))
+    for i in range(3):
+        a = ctx2d.shard(jax.random.normal(jax.random.key(i), (M, K),
+                                          jnp.float32), P(None, axes))
+        b = ctx2d.shard(jax.random.normal(jax.random.key(70 + i), (K, N),
+                                          jnp.float32), P(axes, None))
+        c, ws, stage = f(a, b, ws, stage)
+        assert_allclose(np.asarray(c), np.asarray(gold(a, b)),
+                        atol=1e-4, rtol=1e-4)

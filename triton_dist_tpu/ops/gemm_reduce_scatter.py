@@ -145,14 +145,15 @@ def _gemm_rs_2d_stage_kernel(axes, mesh_axes, cfg, acc_dtype,
     emit_slot_reduction(ws_ref, red_ref, cfg.block_m, cfg.block_n)
 
 
-def _gemm_rs_2d(ctx, a, b, axes, cfg, out_dtype):
+def _gemm_rs_2d(ctx, a, b, axes, cfg, out_dtype, ws=None, stage=None):
     """Hierarchical 2-tier GEMM-RS over ``axes = (outer, *inner)`` — the
     inter-node analog of ``gemm_rs`` (reference 2-D RS pipeline,
     reduce_scatter.py:430-785: intra-node scatter + per-node reduce +
     inter-node tier). Stage 1 fuses the producer GEMM into a fast-tier
     (inner-group) RS; stage 2 ring-reduces the surviving chunk along the
     slow outer axis — each row crosses the slow tier exactly once, already
-    reduced over the fast tier."""
+    reduced over the fast tier. With ``ws``/``stage`` the fast-tier
+    buffers are persistent aliased operands (returned for re-threading)."""
     from triton_dist_tpu.ops.reduce_scatter import _rs_call
 
     cfg = cfg or GemmConfig()
@@ -163,17 +164,19 @@ def _gemm_rs_2d(ctx, a, b, axes, cfg, out_dtype):
     no, ni = ctx.axis_size(outer), ctx.axis_size(inner)
     n, M, _K, N, m_seg, cfg = _validate(ctx, a, b, axes, cfg)
     chunk = no * m_seg
+    persistent = ws is not None
+    if persistent:
+        assert ws.shape == (n, ni, chunk, N) and ws.dtype == acc_dtype, (
+            f"ws {ws.shape}/{ws.dtype} != ({n}, {ni}, {chunk}, {N})/"
+            f"{acc_dtype} — create it with create_gemm_rs_workspace("
+            f"ctx, m_seg={m_seg}, n_cols={N}, axis={axes})")
+        assert stage.shape == (n, 2, chunk, N) and stage.dtype == acc_dtype
 
-    def f(a_shard, b_shard):
-        kernel = lambda a_r, b_r, red_r, ws_r, st_r, *sems: \
-            _gemm_rs_2d_stage_kernel(axes, mesh_axes, cfg, acc_dtype,
-                                     a_r, b_r, red_r, ws_r, st_r, *sems)
-        red, _ws, _st = pl.pallas_call(
-            kernel,
+    def f(a_shard, b_shard, *persist):
+        common = dict(
             out_shape=(jax.ShapeDtypeStruct((chunk, N), acc_dtype),
                        jax.ShapeDtypeStruct((ni, chunk, N), acc_dtype),
                        jax.ShapeDtypeStruct((2, chunk, N), acc_dtype)),
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
             out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 3,
             scratch_shapes=[pltpu.SemaphoreType.DMA((2,)),
                             pltpu.SemaphoreType.DMA((ni,))],
@@ -188,10 +191,40 @@ def _gemm_rs_2d(ctx, a, b, axes, cfg, out_dtype):
                 + (ni + 3) * chunk * N * jnp.dtype(acc_dtype).itemsize,
                 transcendentals=0),
             interpret=default_interpret(),
-        )(a_shard, b_shard)
-        out = _rs_call(outer, mesh_axes, no, red)
-        return out.astype(out_dtype)
+        )
+        if persistent:
+            kernel = lambda a_r, b_r, ws_in, st_in, red_r, ws_r, st_r, \
+                *sems: _gemm_rs_2d_stage_kernel(
+                    axes, mesh_axes, cfg, acc_dtype, a_r, b_r, red_r,
+                    ws_r, st_r, *sems)
+            ws_s = persist[0].reshape(ni, chunk, N)
+            st_s = persist[1].reshape(2, chunk, N)
+            red, ws_o, st_o = pl.pallas_call(
+                kernel,
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+                input_output_aliases={2: 1, 3: 2},
+                **common,
+            )(a_shard, b_shard, ws_s, st_s)
+        else:
+            kernel = lambda a_r, b_r, red_r, ws_r, st_r, *sems: \
+                _gemm_rs_2d_stage_kernel(axes, mesh_axes, cfg, acc_dtype,
+                                         a_r, b_r, red_r, ws_r, st_r, *sems)
+            red, ws_o, st_o = pl.pallas_call(
+                kernel,
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+                **common,
+            )(a_shard, b_shard)
+        out = _rs_call(outer, mesh_axes, no, red).astype(out_dtype)
+        if persistent:
+            return (out, ws_o.reshape(persist[0].shape),
+                    st_o.reshape(persist[1].shape))
+        return out
 
+    if persistent:
+        sm = ctx.shard_map(
+            f, in_specs=(P(None, axes), P(axes, None), P(axes), P(axes)),
+            out_specs=(P(axes), P(axes), P(axes)))
+        return sm(a, b, ws, stage)
     sm = ctx.shard_map(f, in_specs=(P(None, axes), P(axes, None)),
                        out_specs=P(axes))
     return sm(a, b)
@@ -316,12 +349,13 @@ def gemm_rs_ws(ctx: ShmemContext, a: jax.Array, b: jax.Array,
     """Workspace-threading GEMM-RS: symmetric slots + send stage are explicit
     aliased operands, returned for re-threading. Jit with ``donate_argnums``
     on both (or carry through ``lax.scan``) for zero per-call allocation.
-    Create them with ``create_gemm_rs_workspace``."""
+    Create them with ``create_gemm_rs_workspace``. ``axis`` may be a tuple
+    (hierarchical 2-tier path: the fast-tier chunk buffers persist; the
+    slow-tier ring uses VMEM relay slots, nothing to persist)."""
     axis = _norm_axis(ctx, axis)
-    assert isinstance(axis, str), (
-        "gemm_rs_ws supports single-axis meshes only; the hierarchical "
-        "2-tier path (axis tuple) allocates its stage chunks per tier — "
-        "use gemm_rs(axis=(outer, inner)) for it")
+    if isinstance(axis, tuple):
+        return _gemm_rs_2d(ctx, a, b, axis, cfg, out_dtype,
+                           ws=ws, stage=stage)
     cfg = cfg or GemmConfig()
     out_dtype = out_dtype or a.dtype
     acc_dtype = jnp.float32 if out_dtype == jnp.bfloat16 else out_dtype
@@ -348,14 +382,22 @@ def gemm_rs_ws(ctx: ShmemContext, a: jax.Array, b: jax.Array,
 
 
 def create_gemm_rs_workspace(ctx: ShmemContext, m_seg: int, n_cols: int,
-                             out_dtype=jnp.bfloat16,
-                             axis: str | None = None
+                             out_dtype=jnp.bfloat16, axis=None
                              ) -> tuple[jax.Array, jax.Array]:
     """(symm partial slots, send stage) for ``gemm_rs_ws``; dtypes follow the
-    accumulator rule (f32 for bf16 outputs)."""
-    axis = axis or ctx.axis_names[0]
-    n = ctx.axis_size(axis)
+    accumulator rule (f32 for bf16 outputs). With a tuple ``axis`` the
+    slots are the fast-tier chunk buffers ([ni, no*m_seg, n_cols])."""
+    axis = _norm_axis(ctx, axis)
     acc_dtype = jnp.float32 if out_dtype == jnp.bfloat16 else out_dtype
+    if isinstance(axis, tuple):
+        no, ni = ctx.axis_size(axis[0]), ctx.axis_size(tuple(axis[1:]))
+        chunk = no * m_seg
+        ws = ctx.create_symm_tensor((ni, chunk, n_cols), acc_dtype,
+                                    axis=axis)
+        stage = ctx.create_symm_tensor((2, chunk, n_cols), acc_dtype,
+                                       axis=axis)
+        return ws, stage
+    n = ctx.axis_size(axis)
     ws = ctx.create_symm_tensor((n, m_seg, n_cols), acc_dtype, axis=axis)
     stage = ctx.create_symm_tensor((2, m_seg, n_cols), acc_dtype, axis=axis)
     return ws, stage
