@@ -334,3 +334,39 @@ def test_gemm_rs_2d_repeated_ws(ctx2d):
         c, ws, stage = f(a, b, ws, stage)
         assert_allclose(np.asarray(c), np.asarray(gold(a, b)),
                         atol=1e-4, rtol=1e-4)
+
+
+def test_moe_ep_overlap_2tier(ctx2d):
+    """End-to-end MoE EP block over the hierarchical dispatch/combine
+    (router → 2-tier A2A → grouped FFN on local experts → combine)."""
+    from triton_dist_tpu.layers import EPAll2AllLayer
+    from triton_dist_tpu.models.moe import moe_mlp_ep_overlap
+    n, axes = 6, ("a", "b")
+    T_local, D, F, k = 8, 128, 128, 2
+    E = 2 * n
+    T = n * T_local
+    x = (jax.random.normal(jax.random.key(0), (T, D), jnp.float32)
+         * 0.3).astype(jnp.bfloat16)
+    router_w = jax.random.normal(jax.random.key(1), (D, E),
+                                 jnp.float32) * 0.3
+    mk = lambda key, s: (jax.random.normal(jax.random.key(key), s)
+                         * 0.1).astype(jnp.bfloat16)
+    wg, wu, wd = mk(2, (E, D, F)), mk(3, (E, D, F)), mk(4, (E, F, D))
+    layer = EPAll2AllLayer.create(ctx2d, max_tokens=T_local, hidden=D,
+                                  topk=k, num_experts=E, axis=axes)
+    xs = ctx2d.shard(x, P(axes))
+    got = jax.jit(lambda v: moe_mlp_ep_overlap(
+        ctx2d, layer, v, router_w, wg, wu, wd))(xs)
+
+    x32, wg32, wu32, wd32 = (a.astype(jnp.float32) for a in (x, wg, wu, wd))
+    logits = x32 @ router_w
+    gv, gi = jax.lax.top_k(jax.nn.softmax(logits, -1), k)
+    gv = gv / jnp.sum(gv, -1, keepdims=True)
+    h = jax.nn.silu(jnp.einsum("td,edf->tef", x32, wg32)) \
+        * jnp.einsum("td,edf->tef", x32, wu32)
+    ye = jnp.einsum("tef,efd->ted",
+                    h.astype(jnp.bfloat16).astype(jnp.float32), wd32)
+    sel = jnp.take_along_axis(ye, gi[..., None], axis=1)
+    golden = jnp.sum(sel * gv[..., None], axis=1)
+    assert_allclose(np.asarray(got, np.float32), np.asarray(golden),
+                    atol=8e-2, rtol=8e-2)

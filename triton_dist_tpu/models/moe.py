@@ -213,12 +213,22 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
     x2d [T, D] globally P(axis)-sharded token rows; router_w [D, E];
     we_* [E, D, F]/[E, F, D] — each rank uses its local expert slice
     we_*[me*Elocal:(me+1)*Elocal].
+
+    With a 2-tier layer (``EPAll2AllLayer.create(axis=(major, minor))``)
+    the dispatch/combine run the hierarchical path and ``axis`` is taken
+    from the layer; ``x2d`` is P((major, minor))-sharded.
     """
     from triton_dist_tpu.ops.group_gemm import apply_grouped, grouped_gemm
+    from triton_dist_tpu.shmem import device as shd
 
-    axis = axis or ctx.axis_names[0]
-    n = ctx.axis_size(axis)
     a2a = a2a_layer.a2a
+    is_2d = getattr(a2a_layer, "is_2d", False)
+    if is_2d:
+        group = a2a.axes
+        shard_spec = P(group)
+    else:
+        group = axis or a2a.axis or ctx.axis_names[0]
+        shard_spec = P(group)
     E, k = a2a.num_experts, a2a.topk
     e_local = a2a.experts_per_rank
 
@@ -228,11 +238,16 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
 
     recv_tok, recv_ids, layout = a2a_layer.dispatch(x2d, gate_ids)
 
+    n = ctx.axis_size(group)
+
     def expert_ffn(tok, ids, wg, wu, wd):
-        me = lax.axis_index(axis)
-        cap, H = tok.shape[-2], tok.shape[-1]
-        tflat = tok.reshape(n * cap, H)
-        iflat = ids.reshape(n * cap)
+        me = shd.my_pe(group)
+        H = tok.shape[-1]
+        rows = 1
+        for d in tok.shape[:-1]:
+            rows *= d
+        tflat = tok.reshape(rows, H)
+        iflat = ids.reshape(rows)
         wg_l = lax.dynamic_slice_in_dim(wg, me * e_local, e_local)
         wu_l = lax.dynamic_slice_in_dim(wu, me * e_local, e_local)
         wd_l = lax.dynamic_slice_in_dim(wd, me * e_local, e_local)
@@ -245,12 +260,15 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
             return grouped_gemm(hh, wd_l, be, block_m=128)
 
         out = apply_grouped(tflat, iflat, e_local, ffn, block_m=128)
-        return out.reshape(n, cap, -1)
+        if is_2d:
+            return out.reshape(tok.shape[:-1] + (-1,))
+        return out.reshape(n, tok.shape[-2], -1)
 
+    w_spec = P(None, None, None)
     sm = ctx.shard_map(expert_ffn,
-                       in_specs=(P(axis), P(axis), P(None, None, None),
-                                 P(None, None, None), P(None, None, None)),
-                       out_specs=P(axis))
+                       in_specs=(shard_spec, shard_spec, w_spec, w_spec,
+                                 w_spec),
+                       out_specs=shard_spec)
     processed = sm(recv_tok, recv_ids, we_gate, we_up, we_down)
     return a2a_layer.combine(processed, layout, gate_vals)
 
