@@ -185,3 +185,21 @@ def test_native_a2a_route_matches_jnp():
     counts = csrc.a2a_bincount(dest, n_dst)
     ref = np.bincount(dest[(dest >= 0) & (dest < n_dst)], minlength=n_dst)
     np.testing.assert_array_equal(counts, ref)
+
+
+def test_autotuned_ring_attention():
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from triton_dist_tpu.ops.autotuned import ring_attention_autotuned
+    from triton_dist_tpu.shmem.context import initialize_distributed
+    ctx = initialize_distributed(axis_names=("x",), mesh_shape=(2,))
+    B, Hq, Hkv, D, S = 1, 2, 2, 128, 2 * 128
+    qv = jax.random.normal(jax.random.key(0), (B, Hq, S, D), jnp.float32)
+    kv = jax.random.normal(jax.random.key(1), (B, Hkv, S, D), jnp.float32)
+    vv = jax.random.normal(jax.random.key(2), (B, Hkv, S, D), jnp.float32)
+    spec = P(None, None, "x")
+    out = ring_attention_autotuned(ctx, ctx.shard(qv, spec),
+                                   ctx.shard(kv, spec),
+                                   ctx.shard(vv, spec), axis="x")
+    assert out.shape == qv.shape

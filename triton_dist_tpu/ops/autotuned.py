@@ -122,5 +122,39 @@ def moe_reduce_rs_autotuned(ctx: ShmemContext, tokens, ids, topk_weights,
                        block_m=cfg)
 
 
+# ring attention: tune the (block_q, block_k) tile pair — measured range
+# on v5e at S=4096: 52.9 (512^2) -> 83.1 (1024^2) TFLOP/s; 2048-wide tiles
+# exceed the scoped-VMEM budget at D=128 (docs/benchmarks.md)
+_ATTN_CANDIDATES = [(512, 512), (512, 1024), (1024, 512), (1024, 1024),
+                    (256, 512), (256, 256)]
+
+
+def _prune_attn(bqbk, args, kw) -> bool:
+    q = args[1]
+    D = q.shape[-1]
+    bq, bk = bqbk
+    # score tile + q/k/v/state VMEM blocks, double-buffered f32
+    vmem = 4 * (bq * bk + (bq + 2 * bk) * D + bq * (D + 256)) * 2
+    return vmem <= 14 * 2**20
+
+
+from triton_dist_tpu.ops.ring_attention import ring_attention  # noqa: E402
+
+_attn_jit = jax.jit(
+    ring_attention, static_argnums=(0,),
+    static_argnames=("axis", "causal", "sm_scale", "block_q", "block_k",
+                     "batch_axis", "head_axis", "layout"))
+
+
+@contextual_autotune(configs=_ATTN_CANDIDATES, prune=_prune_attn)
+def ring_attention_autotuned(ctx: ShmemContext, q, k, v,
+                             axis: str | None = None, causal: bool = True,
+                             layout: str = "contiguous", cfg=None):
+    bq, bk = cfg if cfg is not None else (1024, 1024)
+    return _attn_jit(ctx, q, k, v, axis=axis, causal=causal,
+                     layout=layout, block_q=bq, block_k=bk)
+
+
 __all__ = ["ag_gemm_autotuned", "gemm_rs_autotuned",
-           "ag_moe_group_gemm_autotuned", "moe_reduce_rs_autotuned"]
+           "ag_moe_group_gemm_autotuned", "moe_reduce_rs_autotuned",
+           "ring_attention_autotuned"]
