@@ -370,3 +370,27 @@ def test_moe_ep_overlap_2tier(ctx2d):
     golden = jnp.sum(sel * gv[..., None], axis=1)
     assert_allclose(np.asarray(got, np.float32), np.asarray(golden),
                     atol=8e-2, rtol=8e-2)
+
+
+def test_dispatch_combine_2d_fp8_roundtrip(ctx2d):
+    """2-tier dispatch/combine on the quantized wire (int8 on the CPU sim;
+    same protocol as fp8): quantize once at the edge, scales ride both
+    tiers, dequant at the edges — the reference's inter-node fp8 showcase
+    configuration (README.md:55) on the hierarchical path."""
+    n, T, H, topk, E = 6, 8, 128, 2, 12
+    a2a = create_all_to_all_context_2d(ctx2d, max_tokens=T, hidden=H,
+                                       topk=topk, num_experts=E,
+                                       dtype=jnp.float32,
+                                       wire_dtype=jnp.int8)
+    tokens = jax.random.normal(jax.random.key(0), (n * T, H), jnp.float32)
+    ids = jax.random.randint(jax.random.key(1), (n * T, topk), 0, E)
+    w = jnp.full((n * T, topk), 1.0 / topk)
+    spec = P(("a", "b"))
+    ts, is_, ws = (ctx2d.shard(t, spec) for t in (tokens, ids, w))
+    recv_tok, recv_ids, layouts = dispatch_2d(a2a, ts, is_)
+    # identity experts: combine returns each token (mean of k copies),
+    # up to two int8 quantization round-trips
+    out = combine_2d(a2a, recv_tok, layouts, ws)
+    err = np.abs(np.asarray(out) - np.asarray(tokens))
+    scale = np.abs(np.asarray(tokens)).max(axis=-1, keepdims=True)
+    assert np.max(err / (scale + 1e-6)) < 0.03, np.max(err / (scale + 1e-6))

@@ -34,17 +34,16 @@ class EPAll2AllLayer:
         ``axis`` may be a 2-tuple ``(major, minor)`` — the layer then runs
         the hierarchical 2-tier dispatch/combine (slow-tier hop + fast-tier
         expert scatter; the reference layer's inter-node path,
-        ep_a2a_layer.py:187-240 over ep_a2a.py:35-147). The 2-tier kernels
-        use the native wire dtype (no fp8 side-channel)."""
+        ep_a2a_layer.py:187-240 over ep_a2a.py:35-147), including the
+        quantized wire: tokens are quantized once at the edge and the
+        scale side-channel rides both tiers."""
         if axis is not None and not isinstance(axis, str):
             axes = tuple(axis)
             assert len(axes) == 2, (
                 f"2-tier A2A takes exactly (major, minor) axes, got {axes}")
-            assert wire_dtype is None, (
-                "wire_dtype is not supported on the 2-tier path")
             return cls(a2a_ops.create_all_to_all_context_2d(
                 ctx, max_tokens, hidden, topk, num_experts, axes=axes,
-                cap1=capacity, dtype=dtype))
+                cap1=capacity, dtype=dtype, wire_dtype=wire_dtype))
         return cls(a2a_ops.create_all_to_all_context(
             ctx, max_tokens, hidden, topk, num_experts,
             capacity=capacity, axis=axis, dtype=dtype,

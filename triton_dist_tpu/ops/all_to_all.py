@@ -115,7 +115,11 @@ def all_to_all_push(ctx: ShmemContext, *arrays: jax.Array,
             ],
             compiler_params=pltpu.CompilerParams(
                 has_side_effects=True,
-                collective_id=collective_id_for("all_to_all")),
+                # keyed by axis: the 2-tier dispatch runs two of these
+                # back-to-back over different axes — sharing one physical
+                # barrier semaphore would let stage-2 signals satisfy
+                # devices still waiting in stage 1 (cf. allgather.py)
+                collective_id=collective_id_for(f"all_to_all_{axis}")),
             interpret=default_interpret(),
         )(*shards)
         return out if isinstance(out, tuple) else (out,)
@@ -415,6 +419,13 @@ class Ep2dAllToAllContext:
     cap1: int                  # tier-1 slots per (src, dst-major-row)
     cap2: int                  # tier-2 slots per (src, dst-minor) pair
     dtype: jnp.dtype = jnp.bfloat16
+    # quantized wire (fp8/int8 + f32 per-token scale side-channel): tokens
+    # are quantized ONCE at the source and the scales ride both tiers with
+    # the same slot maps; dequantization happens only at the edges (expert
+    # input, combine output) — no requantization at the intermediate hop.
+    # This is the reference's showcase configuration (inter-node fp8 A2A,
+    # README.md:55) on the hierarchical path.
+    wire_dtype: jnp.dtype | None = None
 
     @property
     def n_major(self) -> int:
@@ -438,12 +449,13 @@ def create_all_to_all_context_2d(ctx: ShmemContext, max_tokens: int,
                                  axes: tuple[str, str] | None = None,
                                  cap1: int | None = None,
                                  cap2: int | None = None,
-                                 dtype=jnp.bfloat16) -> Ep2dAllToAllContext:
+                                 dtype=jnp.bfloat16,
+                                 wire_dtype=None) -> Ep2dAllToAllContext:
     axes = axes or (ctx.axis_names[0], ctx.axis_names[1])
     n = ctx.axis_size(axes[0]) * ctx.axis_size(axes[1])
     assert num_experts % n == 0, (num_experts, n)
     assert hidden % 128 == 0, f"hidden={hidden} must be a lane multiple (128)"
-    itemsize = jnp.dtype(dtype).itemsize
+    itemsize = jnp.dtype(wire_dtype or dtype).itemsize
     if cap1 is None:
         cap1 = max_tokens * topk
     cap1 = _cap_round(cap1, itemsize)
@@ -453,7 +465,10 @@ def create_all_to_all_context_2d(ctx: ShmemContext, max_tokens: int,
     return Ep2dAllToAllContext(ctx=ctx, axes=tuple(axes),
                                max_tokens=max_tokens, hidden=hidden,
                                topk=topk, num_experts=num_experts,
-                               cap1=cap1, cap2=cap2, dtype=jnp.dtype(dtype))
+                               cap1=cap1, cap2=cap2, dtype=jnp.dtype(dtype),
+                               wire_dtype=(jnp.dtype(wire_dtype)
+                                           if wire_dtype is not None
+                                           else None))
 
 
 def dispatch_2d(a2a: Ep2dAllToAllContext, tokens: jax.Array,
@@ -478,6 +493,8 @@ def dispatch_2d(a2a: Ep2dAllToAllContext, tokens: jax.Array,
     c1_cols, c2_cols = _id_cols(cap1), _id_cols(cap2)
     both = P((major, minor))
 
+    wire = a2a.wire_dtype
+
     def build1(tok_shard, ids_shard):
         eid = ids_shard.reshape(-1)                          # [T*k] global
         rank = eid // epr
@@ -487,19 +504,31 @@ def dispatch_2d(a2a: Ep2dAllToAllContext, tokens: jax.Array,
         src = _slot_src_map(a_dst, s_drop,
                             jnp.arange(T * k, dtype=jnp.int32) // k,
                             nM, cap1, T)
-        send = _slot_gather(tok_shard, src, a2a.dtype)
         meta = jnp.full((nM, c1_cols), -1, jnp.int32).at[a_dst, s_drop].set(
             eid, mode="drop")
-        return (send, meta.reshape(nM, c1_cols // 128, 128),
-                a_dst, slot, ok)
+        outs = ()
+        if wire is not None:
+            # quantize ONCE at the source; the f32 scale side-channel rides
+            # the same slot maps through both tiers (no requantization)
+            q, sv = _quant(tok_shard, wire)
+            send = _slot_gather(q, src, wire)
+            sc = _slot_gather(sv[:, None], src, jnp.float32)[..., 0]
+            send_sc = jnp.ones((nM, c1_cols), jnp.float32).at[:, :cap1].set(
+                jnp.where(src < T, sc, 1.0))
+            outs = (send_sc.reshape(nM, -1, 128),)
+        else:
+            send = _slot_gather(tok_shard, src, a2a.dtype)
+        return (send, meta.reshape(nM, c1_cols // 128, 128)) + outs + (
+            a_dst, slot, ok)
 
+    nw = 3 if wire is not None else 2
     sm1 = ctx.shard_map(build1, in_specs=(both, both),
-                        out_specs=(both,) * 5)
-    send1, meta1w, a_dst, slot1, ok1 = sm1(tokens, topk_ids)
-    recv1, meta1r = all_to_all_push(ctx, send1, meta1w, axis=major,
-                                    spec=both)
+                        out_specs=(both,) * (nw + 3))
+    *wires1, a_dst, slot1, ok1 = sm1(tokens, topk_ids)
+    recv1, meta1r, *sc1r = all_to_all_push(ctx, *wires1, axis=major,
+                                           spec=both)
 
-    def build2(r1_shard, m1_shard):
+    def build2(r1_shard, m1_shard, *sc_shard):
         meta = m1_shard.reshape(nM, c1_cols)[:, :cap1].reshape(-1)
         valid = meta >= 0
         rank = jnp.where(valid, meta, 0) // epr
@@ -507,19 +536,35 @@ def dispatch_2d(a2a: Ep2dAllToAllContext, tokens: jax.Array,
         slot, ok = _slot_assign(b_dst, nm, cap2, valid)
         toks = r1_shard.reshape(nM * cap1, H)
         s_drop = jnp.where(ok, slot, cap2)
+        R = nM * cap1
         src = _slot_src_map(b_dst, s_drop,
-                            jnp.arange(nM * cap1, dtype=jnp.int32),
-                            nm, cap2, nM * cap1)
-        send = _slot_gather(toks, src, a2a.dtype)
+                            jnp.arange(R, dtype=jnp.int32),
+                            nm, cap2, R)
+        # pass-through re-slot: the payload stays in the wire dtype
+        send = _slot_gather(toks, src,
+                            wire if wire is not None else a2a.dtype)
         meta2 = jnp.full((nm, c2_cols), -1, jnp.int32).at[b_dst, s_drop].set(
             meta, mode="drop")
-        return (send, meta2.reshape(nm, c2_cols // 128, 128),
-                b_dst, slot, ok)
+        outs = ()
+        if wire is not None:
+            s1 = sc_shard[0].reshape(nM, c1_cols)[:, :cap1].reshape(-1)
+            sc2 = _slot_gather(s1[:, None], src, jnp.float32)[..., 0]
+            send_sc = jnp.ones((nm, c2_cols), jnp.float32).at[:, :cap2].set(
+                jnp.where(src < R, sc2, 1.0))
+            outs = (send_sc.reshape(nm, -1, 128),)
+        return (send, meta2.reshape(nm, c2_cols // 128, 128)) + outs + (
+            b_dst, slot, ok)
 
-    sm2 = ctx.shard_map(build2, in_specs=(both, both), out_specs=(both,) * 5)
-    send2, meta2w, b_dst, slot2, ok2 = sm2(recv1, meta1r)
-    recv2, meta2r = all_to_all_push(ctx, send2, meta2w, axis=minor,
-                                    spec=both)
+    sm2 = ctx.shard_map(build2, in_specs=(both,) * nw,
+                        out_specs=(both,) * (nw + 3))
+    *wires2, b_dst, slot2, ok2 = sm2(recv1, meta1r, *sc1r)
+    recv2, meta2r, *sc2r = all_to_all_push(ctx, *wires2, axis=minor,
+                                           spec=both)
+    if wire is not None:
+        recv2 = ctx.shard_map(
+            lambda q, sw: _dequant(
+                q, sw.reshape(nm, c2_cols)[:, :cap2], a2a.dtype),
+            in_specs=(both, both), out_specs=both)(recv2, sc2r[0])
 
     unpack = ctx.shard_map(
         lambda w: jnp.where(
@@ -540,30 +585,62 @@ def combine_2d(a2a: Ep2dAllToAllContext, processed: jax.Array, layouts,
     major, minor = a2a.axes
     nM, nm = a2a.n_major, a2a.n_minor
     T, H, k = a2a.max_tokens, a2a.hidden, a2a.topk
-    cap1 = a2a.cap1
+    cap1, cap2 = a2a.cap1, a2a.cap2
+    c1_cols, c2_cols = _id_cols(cap1), _id_cols(cap2)
     (a_dst, slot1, ok1), (b_dst, slot2, ok2) = layouts
     both = P((major, minor))
+    wire = a2a.wire_dtype
 
-    (back2,) = all_to_all_push(ctx, processed, axis=minor, spec=both)
+    if wire is not None:
+        # quantize the return trip once at the experts; scales ride both
+        # hops with the payload (reference sends fp8 both ways)
+        def qpack(p_shard):
+            q, sv = _quant(p_shard.reshape(nm * cap2, H), wire)
+            sc = jnp.ones((nm, c2_cols), jnp.float32).at[:, :cap2].set(
+                sv.reshape(nm, cap2))
+            return q.reshape(nm, cap2, H), sc.reshape(nm, -1, 128)
 
-    def regroup(b2_shard, bd, s2, ok):
-        tok = b2_shard[bd, jnp.where(ok, s2, 0)]
+        pq, psc = ctx.shard_map(qpack, in_specs=both,
+                                out_specs=(both, both))(processed)
+        back2, b2sc = all_to_all_push(ctx, pq, psc, axis=minor, spec=both)
+    else:
+        (back2,) = all_to_all_push(ctx, processed, axis=minor, spec=both)
+
+    def regroup(b2_shard, bd, s2, ok, *scs):
+        idx = jnp.where(ok, s2, 0)
+        tok = b2_shard[bd, idx]
+        if wire is not None:
+            tok = jnp.where(ok[:, None], tok, 0).astype(wire)
+            sv = scs[0].reshape(nm, c2_cols)[:, :cap2][bd, idx]
+            sc = jnp.ones((nM, c1_cols), jnp.float32).at[:, :cap1].set(
+                jnp.where(ok, sv, 1.0).reshape(nM, cap1))
+            return (tok.reshape(nM, cap1, H), sc.reshape(nM, -1, 128))
         tok = jnp.where(ok[:, None], tok, 0).astype(a2a.dtype)
-        return tok.reshape(nM, cap1, H)
+        return (tok.reshape(nM, cap1, H),)
 
-    mid = ctx.shard_map(regroup, in_specs=(both,) * 4, out_specs=both)(
-        back2, b_dst, slot2, ok2)
-    (back1,) = all_to_all_push(ctx, mid, axis=major, spec=both)
+    nmid = 2 if wire is not None else 1
+    mid = ctx.shard_map(
+        regroup, in_specs=(both,) * (4 + (1 if wire is not None else 0)),
+        out_specs=(both,) * nmid)(
+        back2, b_dst, slot2, ok2, *((b2sc,) if wire is not None else ()))
+    back1, *b1sc = all_to_all_push(ctx, *mid, axis=major, spec=both)
 
-    def gather(b1_shard, ad, s1, ok, w):
-        tok = b1_shard[ad, jnp.where(ok, s1, 0)]
-        tok = jnp.where(ok[:, None], tok, 0).reshape(T, k, H)
+    def gather(b1_shard, ad, s1, ok, w, *scs):
+        idx = jnp.where(ok, s1, 0)
+        tok = b1_shard[ad, idx]
+        tok = jnp.where(ok[:, None], tok, 0)
+        if wire is not None:
+            sv = scs[0].reshape(nM, c1_cols)[:, :cap1][ad, idx]
+            tok = tok.astype(jnp.float32) * jnp.where(ok, sv, 1.0)[:, None]
+        tok = tok.reshape(T, k, H)
         return jnp.sum(tok.astype(jnp.float32)
                        * w[..., None].astype(jnp.float32),
                        axis=1).astype(a2a.dtype)
 
-    return ctx.shard_map(gather, in_specs=(both,) * 5, out_specs=both)(
-        back1, a_dst, slot1, ok1, topk_weights)
+    return ctx.shard_map(
+        gather, in_specs=(both,) * (5 + (1 if wire is not None else 0)),
+        out_specs=both)(
+        back1, a_dst, slot1, ok1, topk_weights, *b1sc)
 
 
 __all__ = ["all_to_all_push", "EpAllToAllContext", "create_all_to_all_context",
