@@ -236,3 +236,44 @@ def test_a2a_roundtrip_correct_under_noise(ctx, monkeypatch):
                              ctx.shard(w, P("x")))
     assert_allclose(np.asarray(out, np.float32), np.asarray(t, np.float32),
                     rtol=3e-2, atol=3e-2)
+
+
+def test_hierarchical_race_free_under_detector(ctx2d, monkeypatch):
+    """Race-detector slice over the 2-tier protocols: relay AG-GEMM,
+    hierarchical push AG, 2-tier A2A on the quantized wire."""
+    from triton_dist_tpu.ops.all_to_all import (combine_2d,
+                                                create_all_to_all_context_2d,
+                                                dispatch_2d)
+    from triton_dist_tpu.ops.allgather_gemm import ag_gemm
+    monkeypatch.setenv("TDT_DETECT_RACES", "1")
+    n, axes = 6, ("a", "b")
+
+    M, K, N = n * 8, 128, n * 16
+    a = jax.random.normal(jax.random.key(0), (M, K), jnp.float32)
+    b = jax.random.normal(jax.random.key(1), (K, N), jnp.float32)
+    out = jax.jit(lambda u, v: ag_gemm(ctx2d, u, v, axis=axes,
+                                       cfg=GemmConfig(8, 16)))(
+        ctx2d.shard(a, P(axes)), ctx2d.shard(b, P(None, axes)))
+    assert_allclose(np.asarray(out, np.float32), np.asarray(a @ b),
+                    rtol=5e-2, atol=5e-1)
+    _assert_detector_ran_clean("ag_gemm 2-tier")
+
+    y = jax.jit(lambda v: all_gather(ctx2d, v, method="push_2d"))(
+        ctx2d.shard(a, P(axes)))
+    assert_allclose(np.asarray(y), np.asarray(a))
+    _assert_detector_ran_clean("push_2d all_gather")
+
+    T, H, topk, E = 8, 128, 2, 12
+    a2a = create_all_to_all_context_2d(ctx2d, max_tokens=T, hidden=H,
+                                       topk=topk, num_experts=E,
+                                       dtype=jnp.float32,
+                                       wire_dtype=jnp.int8)
+    tokens = jax.random.normal(jax.random.key(2), (n * T, H), jnp.float32)
+    ids = jax.random.randint(jax.random.key(3), (n * T, topk), 0, E)
+    w = jnp.full((n * T, topk), 1.0 / topk)
+    spec = P(axes)
+    rt, ri, lay = dispatch_2d(a2a, ctx2d.shard(tokens, spec),
+                              ctx2d.shard(ids, spec))
+    back = combine_2d(a2a, rt, lay, ctx2d.shard(w, spec))
+    jax.block_until_ready(back)
+    _assert_detector_ran_clean("2-tier quantized a2a")
