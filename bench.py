@@ -405,8 +405,34 @@ def main():
     }))
 
 
+def _device_reachable(timeout_s: int = 240) -> bool:
+    """Probe backend init in a subprocess: a wedged device tunnel hangs
+    ``jax.devices()`` forever (observed after a client was killed
+    mid-compile — see the verify skill notes), and an eternally-hanging
+    bench is worse than a recorded failure."""
+    import subprocess
+    import sys as _sys
+    try:
+        r = subprocess.run(
+            [_sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 if __name__ == "__main__":
     import sys
+    if not _device_reachable():
+        print(json.dumps({
+            "metric": "ag_gemm_tflops_per_chip", "value": 0.0,
+            "unit": "TFLOP/s", "vs_baseline": 0.0,
+            "extras": {"error": "device backend unreachable (tunnel/device "
+                                "wedged; jax.devices() hung >240s). Last "
+                                "healthy run: 177.96 TFLOP/s — see "
+                                "docs/benchmarks.md"},
+        }))
+        sys.exit(0)
     if "--sweep" in sys.argv:
         sweep()
     else:
