@@ -396,13 +396,37 @@ def main():
     except Exception as e:
         extras["a2a_fp8_error"] = f"{type(e).__name__}: {e}"[:200]
 
-    print(json.dumps({
+    result = {
         "metric": "ag_gemm_tflops_per_chip",
         "value": round(tflops, 2),
         "unit": "TFLOP/s",
         "vs_baseline": round(tflops / baseline, 3),
         "extras": extras,
-    }))
+    }
+    _record_healthy(result)
+    print(json.dumps(result))
+
+
+def _last_healthy_path():
+    import os.path
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".bench_last_healthy.json")
+
+
+def _record_healthy(result: dict) -> None:
+    """Persist the latest healthy result so an unreachable-device run can
+    report it from a recorded artifact rather than a hardcoded string.
+    Skipped when the run captured any sub-benchmark error (a partially
+    failed run must not become the 'healthy' reference); stamped so a
+    consumer can tell how stale the fallback is."""
+    import time
+    if any(k.endswith("error") for k in result.get("extras", {})):
+        return
+    try:
+        with open(_last_healthy_path(), "w") as f:
+            json.dump({**result, "recorded_unix_time": int(time.time())}, f)
+    except OSError:
+        pass
 
 
 def _device_reachable(timeout_s: int = 240) -> bool:
@@ -424,13 +448,19 @@ def _device_reachable(timeout_s: int = 240) -> bool:
 if __name__ == "__main__":
     import sys
     if not _device_reachable():
+        # Not a measurement: value stays null so a metrics consumer cannot
+        # ingest it as a real 0.0-TFLOP/s regression data point.
+        extras = {"status": "device_unreachable",
+                  "error": "device backend unreachable (tunnel/device "
+                           "wedged; jax.devices() hung >240s)"}
+        try:
+            with open(_last_healthy_path()) as f:
+                extras["last_healthy"] = json.load(f)
+        except (OSError, ValueError):
+            pass
         print(json.dumps({
-            "metric": "ag_gemm_tflops_per_chip", "value": 0.0,
-            "unit": "TFLOP/s", "vs_baseline": 0.0,
-            "extras": {"error": "device backend unreachable (tunnel/device "
-                                "wedged; jax.devices() hung >240s). Last "
-                                "healthy run: 177.96 TFLOP/s — see "
-                                "docs/benchmarks.md"},
+            "metric": "ag_gemm_tflops_per_chip", "value": None,
+            "unit": "TFLOP/s", "vs_baseline": None, "extras": extras,
         }))
         sys.exit(0)
     if "--sweep" in sys.argv:

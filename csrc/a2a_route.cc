@@ -7,10 +7,14 @@
 // cross-tested against the jnp implementation.
 //
 // Contract (identical to ops.all_to_all._slot_assign):
-//   slot[r] = number of earlier valid rows with the same destination
-//   ok[r]   = slot[r] < cap, and the row was valid (dest in range, valid[r])
-// Rows with out-of-range destinations keep slot of the clipped dest
-// (matching the jnp clip) but are only counted when in range and valid.
+//   dc[r]   = dest[r] clipped into [0, n_dst) — out-of-range destinations
+//             are NOT rejected; they route to the clipped edge rank
+//             (callers that want them dropped pass valid[r]=0)
+//   slot[r] = number of earlier valid rows with the same CLIPPED destination
+//   ok[r]   = valid[r] && slot[r] < cap  (capacity drop; independent of
+//             whether dest[r] was in range before clipping)
+// Valid rows always bump the clipped destination's counter, matching the
+// jnp one-hot-cumsum implementation exactly.
 
 #include <cstdint>
 #include <vector>

@@ -1,0 +1,249 @@
+"""n>1 Mosaic-lowering gate: AOT-compile every overlap kernel against an
+abstract 8-device v5e TPU topology — no silicon required.
+
+Interpret-mode tests (the rest of tests/) validate protocol semantics but
+not Mosaic lowering; the real chip here is a single device, so kernels can
+hit n>1-only lowering bugs that nothing catches before a pod run (the class
+``dispatch_2d`` was suspected of in round 2). jax's compile-only topology
+client (``jax.experimental.topologies`` over the local libtpu) closes the
+gap: ``jit(fn).lower(shaped_args).compile()`` runs the full XLA+Mosaic
+pipeline for a v5e-8 mesh and fails loudly on lowering bugs.
+
+Parity: the reference's AOT kernel list compile coverage
+(scripts/aot_kernels.txt via tools/compile_aot.py, SURVEY §5.9) — there the
+AOT build compiles every shipped kernel signature ahead of time; here the
+same sweep doubles as the multi-chip lowering gate.
+
+Bisection note (round 3): ``dispatch_2d``/``combine_2d``/fp8 compile clean
+here at (2,4) AND at a (1,1) mesh with the local libtpu — the round-2
+on-chip hang is therefore NOT a client-side Mosaic compile bug; suspicion
+moves to the remote-compile server path / execution (see verify skill notes).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from conftest import REPO_ROOT  # noqa: F401  (conftest forces the CPU mesh)
+from triton_dist_tpu.ops.gemm import GemmConfig
+from triton_dist_tpu.shmem.context import ShmemContext
+
+N8 = 8
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _force_compiled_env():
+    """Force the compiled Mosaic path (the ops would otherwise pick
+    interpret mode off the CPU default backend) and quiet libtpu's host
+    introspection; persistent compile cache amortizes reruns."""
+    saved = {k: os.environ.get(k) for k in
+             ("TDT_FORCE_COMPILED", "TPU_ACCELERATOR_TYPE",
+              "TPU_WORKER_HOSTNAMES")}
+    os.environ["TDT_FORCE_COMPILED"] = "1"
+    os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-8")
+    os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+    saved_cache_dir = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", "/tmp/tdt_topo_cache")
+    yield
+    jax.config.update("jax_compilation_cache_dir", saved_cache_dir)
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+@pytest.fixture(scope="module")
+def topo():
+    from jax.experimental import topologies
+    try:
+        return topologies.get_topology_desc("v5e:2x4", "tpu")
+    except Exception as e:  # pragma: no cover - env without libtpu
+        pytest.skip(f"local libtpu topology unavailable: {e}")
+
+
+@pytest.fixture(scope="module")
+def ctx1d(topo):
+    from jax.experimental import topologies
+    return ShmemContext(mesh=topologies.make_mesh(topo, (N8,), ("x",)))
+
+
+@pytest.fixture(scope="module")
+def ctx2d(topo):
+    from jax.experimental import topologies
+    return ShmemContext(mesh=topologies.make_mesh(topo, (2, 4), ("o", "i")))
+
+
+def sds(ctx, shape, spec, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype,
+                                sharding=NamedSharding(ctx.mesh, spec))
+
+
+def compile_ok(fn, *args):
+    exe = jax.jit(fn).lower(*args).compile()
+    assert exe is not None
+
+
+# -- collectives -------------------------------------------------------------
+
+@pytest.mark.parametrize("method", ["push", "ring"])
+def test_all_gather_lowers_8dev(ctx1d, method):
+    from triton_dist_tpu.ops import all_gather
+    x = sds(ctx1d, (N8 * 8, 128), P("x"))
+    compile_ok(lambda v: all_gather(ctx1d, v, axis="x", method=method), x)
+
+
+def test_push2d_all_gather_lowers_8dev(ctx2d):
+    from triton_dist_tpu.ops import all_gather
+    x = sds(ctx2d, (N8 * 8, 128), P(("o", "i")))
+    compile_ok(lambda v: all_gather(ctx2d, v, method="push_2d"), x)
+
+
+def test_reduce_scatter_lowers_8dev(ctx1d):
+    from triton_dist_tpu.ops import reduce_scatter
+    x = sds(ctx1d, (N8 * 8, 128), P("x"))
+    compile_ok(lambda v: reduce_scatter(ctx1d, v, axis="x"), x)
+
+
+# -- overlap ops -------------------------------------------------------------
+
+def test_ag_gemm_lowers_8dev(ctx1d):
+    from triton_dist_tpu.ops.allgather_gemm import ag_gemm
+    M = K = 512
+    N = 128 * N8
+    a = sds(ctx1d, (M, K), P("x"))
+    b = sds(ctx1d, (K, N), P(None, "x"))
+    compile_ok(lambda u, v: ag_gemm(ctx1d, u, v, axis="x",
+                                    cfg=GemmConfig(M // N8, 128)), a, b)
+
+
+def test_ag_gemm_2tier_lowers_8dev(ctx2d):
+    from triton_dist_tpu.ops.allgather_gemm import ag_gemm
+    axes = ("o", "i")
+    M, K, N = 512, 128, N8 * 128
+    a = sds(ctx2d, (M, K), P(axes))
+    b = sds(ctx2d, (K, N), P(None, axes))
+    compile_ok(lambda u, v: ag_gemm(ctx2d, u, v, axis=axes,
+                                    cfg=GemmConfig(M // N8, 128)), a, b)
+
+
+def test_gemm_rs_lowers_8dev(ctx1d):
+    from triton_dist_tpu.ops.gemm_reduce_scatter import gemm_rs
+    M, K, N = N8 * 32, N8 * 128, 128
+    a = sds(ctx1d, (M, K), P(None, "x"))
+    b = sds(ctx1d, (K, N), P("x", None))
+    compile_ok(lambda u, v: gemm_rs(ctx1d, u, v, axis="x",
+                                    cfg=GemmConfig(32, 128)), a, b)
+
+
+# -- EP all-to-all -----------------------------------------------------------
+
+def test_a2a_dispatch_combine_lowers_8dev(ctx1d):
+    from triton_dist_tpu.ops.all_to_all import (combine,
+                                                create_all_to_all_context,
+                                                dispatch)
+    T, H, topk = N8 * 4, 128, 2
+    a2a = create_all_to_all_context(ctx1d, max_tokens=T // N8, hidden=H,
+                                    topk=topk, num_experts=2 * N8, axis="x")
+    t = sds(ctx1d, (T, H), P("x"), jnp.bfloat16)
+    i = sds(ctx1d, (T, topk), P("x"), jnp.int32)
+    w = sds(ctx1d, (T, topk), P("x"))
+
+    def roundtrip(tt, ii, ww):
+        recv, _, layout = dispatch(a2a, tt, ii)
+        return combine(a2a, recv, layout, ww)
+
+    compile_ok(roundtrip, t, i, w)
+
+
+@pytest.mark.parametrize("wire", [None, jnp.float8_e4m3fn])
+def test_a2a_2tier_lowers_8dev(ctx2d, wire):
+    """The round-2 on-chip hang suspect: 2-tier dispatch+combine, bf16 and
+    quantized wire."""
+    from triton_dist_tpu.ops.all_to_all import (combine_2d,
+                                                create_all_to_all_context_2d,
+                                                dispatch_2d)
+    T, H, topk, E = 8, 128, 2, 16
+    a2a = create_all_to_all_context_2d(ctx2d, max_tokens=T, hidden=H,
+                                       topk=topk, num_experts=E,
+                                       dtype=jnp.bfloat16, wire_dtype=wire)
+    spec = P(("o", "i"))
+    t = sds(ctx2d, (N8 * T, H), spec, jnp.bfloat16)
+    i = sds(ctx2d, (N8 * T, topk), spec, jnp.int32)
+    w = sds(ctx2d, (N8 * T, topk), spec)
+
+    def roundtrip(tt, ii, ww):
+        recv, _, layouts = dispatch_2d(a2a, tt, ii)
+        return combine_2d(a2a, recv, layouts, ww)
+
+    compile_ok(roundtrip, t, i, w)
+
+
+# -- MoE overlap -------------------------------------------------------------
+
+def test_ag_moe_group_gemm_lowers_8dev(ctx1d):
+    from triton_dist_tpu.ops.moe import ag_moe_group_gemm
+    E, H, N, T = 4, 128, N8 * 128, N8 * 32
+    t = sds(ctx1d, (T, H), P("x"))
+    i = sds(ctx1d, (T,), P("x"), jnp.int32)
+    w = sds(ctx1d, (E, H, N), P(None, None, "x"))
+    compile_ok(lambda tt, ii, ww: ag_moe_group_gemm(ctx1d, tt, ii, ww,
+                                                    block_m=32), t, i, w)
+
+
+def test_moe_reduce_rs_lowers_8dev(ctx1d):
+    from triton_dist_tpu.ops.moe import moe_reduce_rs
+    E, K, N, T, topk = 4, N8 * 128, 128, N8 * 8, 2
+    t = sds(ctx1d, (T * topk, K), P(None, "x"))
+    i = sds(ctx1d, (T * topk,), P(), jnp.int32)
+    tw = sds(ctx1d, (T, topk), P())
+    w = sds(ctx1d, (E, K, N), P(None, "x", None))
+    compile_ok(lambda tt, ii, tww, ww: moe_reduce_rs(ctx1d, tt, ii, tww, ww,
+                                                     block_m=16),
+               t, i, tw, w)
+
+
+# -- ring attention (training CP) --------------------------------------------
+
+def _qkv_sds(ctx, n, B=1, Hq=2, Hkv=2, s_loc=128, D=128):
+    spec = P(None, None, "x")
+    S = n * s_loc
+    return (sds(ctx, (B, Hq, S, D), spec), sds(ctx, (B, Hkv, S, D), spec),
+            sds(ctx, (B, Hkv, S, D), spec))
+
+
+def test_ring_attention_fwd_lowers_8dev(ctx1d):
+    from triton_dist_tpu.ops.ring_attention import ring_attention
+    q, k, v = _qkv_sds(ctx1d, N8)
+    compile_ok(lambda a, b, c: ring_attention(ctx1d, a, b, c, axis="x",
+                                              causal=True, block_q=128,
+                                              block_k=128), q, k, v)
+
+
+def test_ring_attention_bwd_lowers_8dev(ctx1d):
+    from triton_dist_tpu.ops.ring_attention import ring_attention
+    q, k, v = _qkv_sds(ctx1d, N8)
+
+    def loss(a, b, c):
+        return ring_attention(ctx1d, a, b, c, axis="x", causal=True,
+                              block_q=128, block_k=128).astype(
+            jnp.float32).sum()
+
+    compile_ok(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
+
+# -- distributed decode ------------------------------------------------------
+
+def test_fused_sp_decode_lowers_8dev(ctx1d):
+    from triton_dist_tpu.ops.flash_decode import sp_gqa_flash_decode
+    B, Hq, Hkv, D, s_local = 1, 4, 2, 128, 128
+    S = N8 * s_local
+    q = sds(ctx1d, (B, Hq, D), P())
+    k = sds(ctx1d, (B, Hkv, S, D), P(None, None, "x"))
+    v = sds(ctx1d, (B, Hkv, S, D), P(None, None, "x"))
+    kv = sds(ctx1d, (B,), P(), jnp.int32)
+    compile_ok(lambda *a: sp_gqa_flash_decode(ctx1d, *a, ag_method="fused"),
+               q, k, v, kv)

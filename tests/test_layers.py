@@ -105,5 +105,10 @@ def test_ep_layer_2d_roundtrip():
     out = layer.combine(recv_tok, layouts, ws)  # identity experts
     assert_allclose(np.asarray(out), np.asarray(tokens), atol=1e-4,
                     rtol=1e-4)
-    with pytest.raises(NotImplementedError):
-        layer.preprocess(is_)
+    # preprocess exposes the tier-1 (major-hop) plan — it must agree with
+    # what dispatch_2d actually used (layouts[0], flat [T*k] per shard)
+    a_dst, slot1, ok1 = (np.asarray(v) for v in layer.preprocess(is_))
+    la, ls, lo = (np.asarray(v) for v in layouts[0])
+    np.testing.assert_array_equal(a_dst.reshape(la.shape), la)
+    np.testing.assert_array_equal(slot1.reshape(ls.shape), ls)
+    np.testing.assert_array_equal(ok1.reshape(lo.shape), lo)

@@ -238,6 +238,65 @@ def test_a2a_roundtrip_correct_under_noise(ctx, monkeypatch):
                     rtol=3e-2, atol=3e-2)
 
 
+# -- serialized-execution bisection mode (TDT_SERIAL) -----------------------
+# (reference parity: serial=True on its overlap ops, allgather_gemm.py:482-485
+#  — forces puts synchronous so overlap collapses to lock-step; results must
+#  be bit-identical to the pipelined schedule.)
+
+def test_collectives_correct_under_serial(ctx, monkeypatch):
+    n = ctx.num_ranks
+    x = jax.random.normal(jax.random.key(21), (n * 8, 128), jnp.float32)
+    xs = ctx.shard(x, P("x"))
+    pipelined = {m: np.asarray(jax.jit(
+        lambda v, m=m: all_gather(ctx, v, axis="x", method=m))(xs))
+        for m in ("push", "ring")}
+    monkeypatch.setenv("TDT_SERIAL", "1")
+    from triton_dist_tpu.shmem import device as shd
+    assert shd._serial()
+    for m in ("push", "ring"):
+        y = jax.jit(lambda v, m=m: all_gather(ctx, v, axis="x", method=m))(xs)
+        np.testing.assert_array_equal(np.asarray(y), pipelined[m])
+
+    r = jax.jit(lambda v: reduce_scatter(ctx, v, axis="x"))(xs)
+    gold = jax.jit(ctx.shard_map(
+        lambda s: jax.lax.psum_scatter(s, "x", scatter_dimension=0,
+                                       tiled=True),
+        in_specs=P("x"), out_specs=P("x")))(xs)
+    assert_allclose(np.asarray(r), np.asarray(gold))
+
+
+def test_overlap_ops_correct_under_serial(ctx, monkeypatch):
+    from triton_dist_tpu.ops.allgather_gemm import ag_gemm
+    monkeypatch.setenv("TDT_SERIAL", "1")
+    n = ctx.num_ranks
+    M = K = 64
+    N = 128 * n
+    a = jax.random.normal(jax.random.key(22), (M, K), jnp.float32)
+    b = jax.random.normal(jax.random.key(23), (K, N), jnp.float32)
+    out = jax.jit(lambda u, v: ag_gemm(ctx, u, v, axis="x",
+                                       cfg=GemmConfig(M // n, 128)))(
+        ctx.shard(a, P("x")), ctx.shard(b, P(None, "x")))
+    assert_allclose(np.asarray(out, np.float32), np.asarray(a @ b),
+                    rtol=5e-2, atol=5e-1)
+
+    T, H, topk = n * 8, 128, 2
+    a2a = create_all_to_all_context(ctx, max_tokens=T // n, hidden=H,
+                                    topk=topk, num_experts=2 * n, axis="x")
+
+    def roundtrip(t, i, w):
+        recv, _, layout = dispatch(a2a, t, i)
+        return combine(a2a, recv, layout, w)
+
+    t = jax.random.normal(jax.random.key(24), (T, H), jnp.float32
+                          ).astype(jnp.bfloat16)
+    ids = jax.random.randint(jax.random.key(25), (T, topk), 0, 2 * n)
+    w = jnp.ones((T, topk), jnp.float32) / topk
+    out = jax.jit(roundtrip)(ctx.shard(t, P("x")), ctx.shard(ids, P("x")),
+                             ctx.shard(w, P("x")))
+    assert_allclose(np.asarray(out, np.float32), np.asarray(t, np.float32),
+                    rtol=3e-2, atol=3e-2)
+
+
 def test_hierarchical_race_free_under_detector(ctx2d, monkeypatch):
     """Race-detector slice over the 2-tier protocols: relay AG-GEMM,
     hierarchical push AG, 2-tier A2A on the quantized wire."""

@@ -203,3 +203,30 @@ def test_autotuned_ring_attention():
                                    ctx.shard(kv, spec),
                                    ctx.shard(vv, spec), axis="x")
     assert out.shape == qv.shape
+
+
+def test_collective_ids_order_independent():
+    """Two fresh processes must assign identical collective ids no matter
+    what order families are first used in — order-derived ids would alias
+    barriers across hosts that trace ops in different orders (reference
+    analog: fixed per-kernel signal-buffer layouts in its ctx dataclasses)."""
+    import subprocess
+    import sys
+
+    names = ["ag_gemm_x", "rs_ring_y", "barrier_all", "all_to_all_tp",
+             "ring_attn_sp", "gemm_rs_('x', 'y')", "ll_ag_merge_x"]
+    prog = (
+        "import sys\n"
+        "from triton_dist_tpu.ops.common import collective_id_for\n"
+        "names = sys.argv[1:]\n"
+        "print({n: collective_id_for(n) for n in names})\n")
+    outs = []
+    for order in (names, list(reversed(names)), names[3:] + names[:3]):
+        r = subprocess.run([sys.executable, "-c", prog, *order],
+                           capture_output=True, text=True,
+                           env={**__import__('os').environ,
+                                "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stderr
+        outs.append(eval(r.stdout.strip()))
+    assert outs[0] == outs[1] == outs[2]
+    assert len(set(outs[0].values())) == len(names)  # all distinct

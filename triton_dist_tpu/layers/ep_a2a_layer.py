@@ -54,19 +54,26 @@ class EPAll2AllLayer:
         return isinstance(self.a2a, a2a_ops.Ep2dAllToAllContext)
 
     def preprocess(self, topk_ids: jax.Array):
-        """Routing plan for globally P(axis)-sharded ``topk_ids`` — the same
-        plan ``dispatch`` computes internally (≈ layer.preprocess token sort,
+        """Routing plan for globally sharded ``topk_ids`` — the same plan
+        ``dispatch`` computes internally (≈ layer.preprocess token sort,
         ep_a2a_layer.py:110-130). Slot allocation is per source shard, so
         this must run under shard_map — calling ``route_tokens`` on the
         global array would count slots across ranks jointly and disagree
-        with dispatch's capacity-drop decisions."""
-        if self.is_2d:
-            raise NotImplementedError(
-                "preprocess() exposes the 1-tier routing plan; the 2-tier "
-                "path computes per-tier plans inside dispatch_2d (they are "
-                "returned as `layouts`)")
-        ctx, axis = self.a2a.ctx, self.a2a.axis
+        with dispatch's capacity-drop decisions.
+
+        On the 2-tier path this is the tier-1 (major-hop) plan; the tier-2
+        plan re-slots actual arrivals on the intermediate device, so it is
+        inherently dispatch-time data — ``dispatch`` returns it as
+        ``layouts[1]``."""
         from jax.sharding import PartitionSpec as P
+        ctx = self.a2a.ctx
+        if self.is_2d:
+            spec = P(self.a2a.axes)
+            sm = ctx.shard_map(
+                lambda ids: a2a_ops.route_tokens_2d(self.a2a, ids),
+                in_specs=spec, out_specs=(spec,) * 3)
+            return sm(topk_ids)
+        axis = self.a2a.axis
         sm = ctx.shard_map(lambda ids: a2a_ops.route_tokens(self.a2a, ids),
                            in_specs=P(axis),
                            out_specs=(P(axis), P(axis), P(axis)))

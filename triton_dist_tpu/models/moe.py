@@ -253,11 +253,11 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
         wd_l = lax.dynamic_slice_in_dim(wd, me * e_local, e_local)
 
         # gated FFN: silu(x@wg) * (x@wu) @ wd over local experts
-        def ffn(xs, be):
-            g = grouped_gemm(xs, wg_l, be, block_m=128)
-            u = grouped_gemm(xs, wu_l, be, block_m=128)
+        def ffn(xs, be, nb):
+            g = grouped_gemm(xs, wg_l, be, block_m=128, n_blocks_used=nb)
+            u = grouped_gemm(xs, wu_l, be, block_m=128, n_blocks_used=nb)
             hh = jax.nn.silu(g.astype(jnp.float32)).astype(u.dtype) * u
-            return grouped_gemm(hh, wd_l, be, block_m=128)
+            return grouped_gemm(hh, wd_l, be, block_m=128, n_blocks_used=nb)
 
         out = apply_grouped(tflat, iflat, e_local, ffn, block_m=128)
         if is_2d:

@@ -471,6 +471,22 @@ def create_all_to_all_context_2d(ctx: ShmemContext, max_tokens: int,
                                            else None))
 
 
+def route_tokens_2d(a2a: Ep2dAllToAllContext, topk_ids: jax.Array):
+    """Tier-1 (major-hop) routing plan — the same ``a_dst``/``slot``/``ok``
+    that ``dispatch_2d``'s first stage computes (build1), reshaped to the
+    ``route_tokens`` [T, topk] convention. The tier-2 plan is
+    arrival-dependent (it re-slots whatever tokens land on the intermediate
+    device), so it can only be produced by ``dispatch_2d`` itself — it is
+    returned there as ``layouts[1]``. Pure jnp; runs under jit/shard_map per
+    source shard."""
+    T, k = topk_ids.shape
+    eid = topk_ids.reshape(-1)
+    rank = eid // a2a.experts_per_rank
+    a_dst = rank // a2a.n_minor
+    slot, ok = _slot_assign(a_dst, a2a.n_major, a2a.cap1)
+    return (a_dst.reshape(T, k), slot.reshape(T, k), ok.reshape(T, k))
+
+
 def dispatch_2d(a2a: Ep2dAllToAllContext, tokens: jax.Array,
                 topk_ids: jax.Array):
     """2-tier EP dispatch. Global inputs sharded P((major, minor)):
@@ -645,4 +661,5 @@ def combine_2d(a2a: Ep2dAllToAllContext, processed: jax.Array, layouts,
 
 __all__ = ["all_to_all_push", "EpAllToAllContext", "create_all_to_all_context",
            "route_tokens", "dispatch", "combine", "Ep2dAllToAllContext",
-           "create_all_to_all_context_2d", "dispatch_2d", "combine_2d"]
+           "create_all_to_all_context_2d", "route_tokens_2d", "dispatch_2d",
+           "combine_2d"]

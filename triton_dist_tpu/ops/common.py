@@ -10,7 +10,7 @@ semaphore of each remote copy is the signal.
 
 from __future__ import annotations
 
-import itertools
+import hashlib
 
 import jax
 from jax.experimental import pallas as pl
@@ -25,14 +25,33 @@ from triton_dist_tpu.utils import default_interpret
 # distinct collective id (matching across devices running the same kernel).
 # Ids must ONLY be passed for kernels that actually use barrier semaphores —
 # compiled TPU rejects them otherwise.
-_COLLECTIVE_IDS = {}
-_counter = itertools.count(0)
+#
+# Ids are a STABLE function of the family name, not a first-use counter: in a
+# multi-host job, two processes can trace ops in different orders (divergent
+# autotuner pruning, conditional model paths), and order-derived ids would
+# silently alias different kernel families onto the same barrier across hosts
+# (the reference avoids this with fixed per-kernel signal-buffer layouts in
+# its ctx dataclasses). Interpret mode narrows ids to int16, so we hash into
+# [0, 2**15); a (deterministic, therefore immediately-reproducible) collision
+# between two family names raises loudly and can be resolved by pinning.
+_COLLECTIVE_ID_PINS: dict[str, int] = {}
+_ASSIGNED: dict[int, str] = {}
 
 
 def collective_id_for(name: str) -> int:
-    if name not in _COLLECTIVE_IDS:
-        _COLLECTIVE_IDS[name] = next(_counter)
-    return _COLLECTIVE_IDS[name]
+    if name in _COLLECTIVE_ID_PINS:
+        cid = _COLLECTIVE_ID_PINS[name]
+    else:
+        digest = hashlib.blake2b(name.encode(), digest_size=8).digest()
+        cid = int.from_bytes(digest, "little") % (1 << 15)
+    holder = _ASSIGNED.setdefault(cid, name)
+    if holder != name:
+        raise ValueError(
+            f"collective id collision: {name!r} and {holder!r} both hash to "
+            f"{cid}. Pin one explicitly via "
+            f"triton_dist_tpu.ops.common._COLLECTIVE_ID_PINS[{name!r}] = <id> "
+            f"before first use (any unused id in [0, 32768)).")
+    return cid
 
 
 def norm_axis(ctx: ShmemContext, axis):

@@ -23,7 +23,8 @@ from jax.experimental.pallas import tpu as pltpu
 from triton_dist_tpu.utils import default_interpret
 
 
-def align_tokens_by_expert(ids: jax.Array, num_experts: int, block_m: int):
+def align_tokens_by_expert(ids: jax.Array, num_experts: int, block_m: int,
+                           with_used_count: bool = False):
     """Sort token indices by expert and pad each expert's run to a multiple
     of ``block_m`` (analog of sort_topk_ids_align_block_size,
     allgather_group_gemm.py:54-139 — there a CPU/CUDA helper, here jnp).
@@ -36,6 +37,11 @@ def align_tokens_by_expert(ids: jax.Array, num_experts: int, block_m: int):
     free). Gathered row j participates in expert ``block_expert[j//bm]``'s
     GEMM iff ``row_valid[j]``; blocks past the used range carry no valid
     rows.
+
+    ``with_used_count=True`` appends the runtime used-block bound (see
+    ``used_block_count``) as a 4th element, computed from the counts this
+    layout already materializes — callers that need both avoid a second
+    one-hot pass over ``ids``.
     """
     T = ids.shape[0]
     E = num_experts
@@ -63,11 +69,34 @@ def align_tokens_by_expert(ids: jax.Array, num_experts: int, block_m: int):
         (block_start + blocks_e)[None, :] <= blk[:, None], axis=1
     ).astype(jnp.int32)
     block_expert = jnp.clip(block_expert, 0, E - 1)
+    if with_used_count:
+        n_used = jnp.maximum(1, jnp.sum(blocks_e)).astype(jnp.int32)
+        return gather_idx, row_valid, block_expert, n_used
     return gather_idx, row_valid, block_expert
 
 
+def used_block_count(ids: jax.Array, num_experts: int, block_m: int):
+    """Runtime number of ``block_m`` row-blocks that carry any valid rows
+    under ``align_tokens_by_expert``'s layout: ``sum_e ceil(count_e / bm)``,
+    clamped to ≥1 so downstream dynamic grids are never empty. All blocks at
+    or past this index hold only invalid rows — a grouped GEMM bounded by
+    it skips up to ``E`` blocks of pure padding (the analog of the
+    reference's ``num_tokens_post_padded`` early-exit,
+    allgather_group_gemm.py:278-285).
+
+    Standalone form for callers that have no use for the alignment arrays;
+    when you need both, pass ``with_used_count=True`` to
+    ``align_tokens_by_expert`` instead of paying this one-hot pass twice."""
+    E, bm = num_experts, block_m
+    ids_safe = jnp.where(ids >= 0, ids, E)
+    oh = jax.nn.one_hot(ids_safe, E + 1, dtype=jnp.int32)
+    counts = jnp.sum(oh[:, :E], axis=0)
+    return jnp.maximum(1, jnp.sum((counts + bm - 1) // bm)).astype(jnp.int32)
+
+
 def emit_grouped_gemm(t_ref, w_ref, o_ref, be_ref, base_blk,
-                      block_m: int, block_n: int, out_dtype=None):
+                      block_m: int, block_n: int, out_dtype=None,
+                      n_blocks_used=None):
     """In-kernel pipelined grouped GEMM over HBM refs:
     ``o[i*bm:(i+1)*bm] = t[i*bm:(i+1)*bm] @ w[be_ref[base_blk + i]]``.
 
@@ -78,7 +107,15 @@ def emit_grouped_gemm(t_ref, w_ref, o_ref, be_ref, base_blk,
     MoE overlap kernels call per *arrived segment*, the TPU analog of the
     reference's per-token-block ``dl.wait`` + grouped ``tl.dot``
     (kernel_consumer_m_parallel_scatter_group_gemm,
-    allgather_group_gemm.py:229-316)."""
+    allgather_group_gemm.py:229-316).
+
+    ``n_blocks_used`` (traced scalar, e.g. ``used_block_count``'s result read
+    from SMEM) truncates the row-block grid at runtime: padding blocks past
+    it are neither DMA'd nor computed (reference parity:
+    ``num_tokens_post_padded`` early-exit, allgather_group_gemm.py:278-285).
+    Output rows past ``n_blocks_used * block_m`` are left UNWRITTEN — the
+    caller must mask by row validity (``apply_grouped`` and the fused MoE
+    unscrambles already do)."""
     import math
 
     P, H = t_ref.shape
@@ -87,6 +124,8 @@ def emit_grouped_gemm(t_ref, w_ref, o_ref, be_ref, base_blk,
     block_n = math.gcd(min(block_n, N), N)
     assert P % block_m == 0, (P, block_m)
     out_dtype = out_dtype or o_ref.dtype
+    m_steps = (P // block_m if n_blocks_used is None
+               else jnp.minimum(n_blocks_used, P // block_m))
 
     def body(t_blk, w_blk, o_blk):
         o_blk[...] = jnp.dot(t_blk[...], w_blk[0],
@@ -95,7 +134,7 @@ def emit_grouped_gemm(t_ref, w_ref, o_ref, be_ref, base_blk,
 
     pltpu.emit_pipeline(
         body,
-        grid=(P // block_m, N // block_n),
+        grid=(m_steps, N // block_n),
         in_specs=[
             pl.BlockSpec((block_m, H), lambda i, j: (i, 0)),
             pl.BlockSpec((1, H, block_n),
@@ -107,7 +146,8 @@ def emit_grouped_gemm(t_ref, w_ref, o_ref, be_ref, base_blk,
 
 def grouped_gemm(tokens: jax.Array, weights: jax.Array,
                  block_expert: jax.Array, block_m: int = 128,
-                 block_n: int = 128, out_dtype=None) -> jax.Array:
+                 block_n: int = 128, out_dtype=None,
+                 n_blocks_used: jax.Array | None = None) -> jax.Array:
     """``out[i*bm:(i+1)*bm] = tokens[i*bm:(i+1)*bm] @ weights[block_expert[i]]``.
 
     tokens: [P, H] (expert-aligned rows), weights: [E, H, N],
@@ -115,7 +155,12 @@ def grouped_gemm(tokens: jax.Array, weights: jax.Array,
     each block's expert weight tile HBM→VMEM double-buffered (grid analog of
     the reference's ``kernel_consumer_m_parallel_scatter_group_gemm``,
     allgather_group_gemm.py:229-316).
-    """
+
+    ``n_blocks_used`` (traced int32 scalar from ``used_block_count``)
+    truncates the row-block walk at runtime, skipping the up-to-``E`` blocks
+    of pure per-expert padding in the aligned layout — rows past the bound
+    are returned ZEROED (callers mask by row validity anyway; zero keeps the
+    op total-function for reuse in autodiff contexts)."""
     import math
 
     P, H = tokens.shape
@@ -127,23 +172,50 @@ def grouped_gemm(tokens: jax.Array, weights: jax.Array,
     assert P % block_m == 0, (P, block_m)
     out_dtype = out_dtype or tokens.dtype
 
-    def kernel(be_ref, t_ref, w_ref, o_ref):
-        o_ref[...] = jnp.dot(t_ref[...], w_ref[0],
-                             preferred_element_type=jnp.float32
-                             ).astype(out_dtype)
+    if n_blocks_used is None:
+        def kernel(be_ref, t_ref, w_ref, o_ref):
+            o_ref[...] = jnp.dot(t_ref[...], w_ref[0],
+                                 preferred_element_type=jnp.float32
+                                 ).astype(out_dtype)
 
-    grid = (P // block_m, N // block_n)
-    return pl.pallas_call(
+        grid = (P // block_m, N // block_n)
+        return pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=grid,
+                in_specs=[
+                    pl.BlockSpec((block_m, H), lambda i, j, be: (i, 0)),
+                    pl.BlockSpec((1, H, block_n),
+                                 lambda i, j, be: (be[i], 0, j)),
+                ],
+                out_specs=pl.BlockSpec((block_m, block_n),
+                                       lambda i, j, be: (i, j)),
+            ),
+            out_shape=jax.ShapeDtypeStruct((P, N), out_dtype),
+            cost_estimate=pl.CostEstimate(
+                flops=2 * P * H * N,
+                bytes_accessed=(P * H + E * H * N + P * N)
+                * jnp.dtype(tokens.dtype).itemsize,
+                transcendentals=0),
+            interpret=default_interpret(),
+        )(block_expert, tokens, weights)
+
+    # runtime-bounded path: zero-init the output, then emit_pipeline over a
+    # dynamic grid — padding blocks cost neither DMA nor MXU work
+    nb = jnp.asarray(n_blocks_used, jnp.int32).reshape(1)
+
+    def kernel(be_ref, nb_ref, t_ref, w_ref, o_ref):
+        emit_grouped_gemm(t_ref, w_ref, o_ref, be_ref, 0, block_m, block_n,
+                          out_dtype, n_blocks_used=nb_ref[0])
+
+    out = pl.pallas_call(
         kernel,
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((block_m, H), lambda i, j, be: (i, 0)),
-                pl.BlockSpec((1, H, block_n), lambda i, j, be: (be[i], 0, j)),
-            ],
-            out_specs=pl.BlockSpec((block_m, block_n), lambda i, j, be: (i, j)),
-        ),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
         out_shape=jax.ShapeDtypeStruct((P, N), out_dtype),
         cost_estimate=pl.CostEstimate(
             flops=2 * P * H * N,
@@ -151,21 +223,27 @@ def grouped_gemm(tokens: jax.Array, weights: jax.Array,
             * jnp.dtype(tokens.dtype).itemsize,
             transcendentals=0),
         interpret=default_interpret(),
-    )(block_expert, tokens, weights)
+    )(block_expert, nb, tokens, weights)
+    # rows past the bound were never written; zero them so the result is a
+    # total function of the inputs
+    row_blk = jnp.arange(P, dtype=jnp.int32) // block_m
+    return jnp.where((row_blk < nb[0])[:, None], out,
+                     jnp.zeros((), out_dtype))
 
 
 def apply_grouped(tokens: jax.Array, ids: jax.Array, num_experts: int, fn,
                   block_m: int = 128) -> jax.Array:
     """The shared align→gather→mask→compute→scatter-back sequence every MoE
-    op needs: align rows by expert, call ``fn(x_aligned, block_expert) ->
-    y_aligned`` (one or more grouped GEMMs sharing the alignment), and
-    scatter results back to the original row order (invalid ids → zero
-    rows). Returns [T, N]."""
+    op needs: align rows by expert, call ``fn(x_aligned, block_expert,
+    n_blocks_used) -> y_aligned`` (one or more grouped GEMMs sharing the
+    alignment, runtime-bounded by the used-block count), and scatter results
+    back to the original row order (invalid ids → zero rows). Returns
+    [T, N]."""
     T = tokens.shape[0]
-    gather_idx, row_valid, block_expert = align_tokens_by_expert(
-        ids, num_experts, block_m)
+    gather_idx, row_valid, block_expert, nb = align_tokens_by_expert(
+        ids, num_experts, block_m, with_used_count=True)
     x = tokens[gather_idx] * row_valid[:, None].astype(tokens.dtype)
-    y = fn(x, block_expert)
+    y = fn(x, block_expert, nb)
     out = jnp.zeros((T, y.shape[-1]), y.dtype)
     src = jnp.where(row_valid, gather_idx, T)
     return out.at[src].add(y * row_valid[:, None].astype(y.dtype),
@@ -181,13 +259,15 @@ def moe_ffn_local(tokens: jax.Array, ids: jax.Array, w_up: jax.Array,
     Building block for the EP layer and the MoE overlap ops."""
     E = w_up.shape[0]
 
-    def ffn(x, block_expert):
-        h = grouped_gemm(x, w_up, block_expert, block_m=block_m)
+    def ffn(x, block_expert, nb):
+        h = grouped_gemm(x, w_up, block_expert, block_m=block_m,
+                         n_blocks_used=nb)
         h = activation(h.astype(jnp.float32)).astype(tokens.dtype)
-        return grouped_gemm(h, w_down, block_expert, block_m=block_m)
+        return grouped_gemm(h, w_down, block_expert, block_m=block_m,
+                            n_blocks_used=nb)
 
     return apply_grouped(tokens, ids, E, ffn, block_m=block_m)
 
 
-__all__ = ["align_tokens_by_expert", "emit_grouped_gemm", "grouped_gemm",
-           "apply_grouped", "moe_ffn_local"]
+__all__ = ["align_tokens_by_expert", "used_block_count", "emit_grouped_gemm",
+           "grouped_gemm", "apply_grouped", "moe_ffn_local"]

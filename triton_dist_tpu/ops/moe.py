@@ -81,9 +81,13 @@ def _gather_ids(ctx: ShmemContext, ids: jax.Array, axis, t_local: int):
 
 def _segment_alignment(gids: jax.Array, num_experts: int, block_m: int):
     """Per-segment sender-side alignment metadata from the gathered ids
-    [n, t_seg_rows] — identical on every rank by construction."""
+    [n, t_seg_rows] — identical on every rank by construction. Returns
+    (gather_idx, row_valid, block_expert, n_blocks_used[n]) — the last is
+    the per-segment runtime block bound the fused kernels use to skip
+    padding blocks (reference ``num_tokens_post_padded`` parity)."""
     return jax.vmap(
-        lambda i: align_tokens_by_expert(i, num_experts, block_m))(gids)
+        lambda i: align_tokens_by_expert(i, num_experts, block_m,
+                                         with_used_count=True))(gids)
 
 
 # ---------------------------------------------------------------------------
@@ -91,13 +95,14 @@ def _segment_alignment(gids: jax.Array, num_experts: int, block_m: int):
 # ---------------------------------------------------------------------------
 
 def _ag_moe_kernel(axis, mesh_axes, bm, bn, out_dtype, n_blocks,
-                   x_ref, w_ref, be_ref, out_ref, ws_ref,
+                   x_ref, w_ref, be_ref, nb_ref, out_ref, ws_ref,
                    send_sems, recv_sems):
     P_s = x_ref.shape[0]
 
     def emit(src_ref, seg):
         emit_grouped_gemm(src_ref, w_ref, out_ref.at[pl.ds(seg * P_s, P_s)],
-                          be_ref, seg * n_blocks, bm, bn, out_dtype)
+                          be_ref, seg * n_blocks, bm, bn, out_dtype,
+                          n_blocks_used=nb_ref[seg])
 
     if isinstance(axis, tuple) and len(axis) > 1:
         ag_overlap_protocol_2d(axis, mesh_axes, x_ref, ws_ref,
@@ -132,10 +137,10 @@ def ag_moe_group_gemm(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
     out_dtype = tokens.dtype
 
     gids = _gather_ids(ctx, ids, axis, t_local)               # [n, t_local]
-    gi, rv, be = _segment_alignment(gids, E, bm)              # [n, P_s] ×2, [n, n_blocks]
+    gi, rv, be, nb = _segment_alignment(gids, E, bm)          # [n, P_s] ×2, [n, n_blocks], [n]
     be_flat = be.reshape(-1)
 
-    def f(tok_shard, gi_full, rv_full, be_full, w_shard):
+    def f(tok_shard, gi_full, rv_full, be_full, nb_full, w_shard):
         me = shd.my_pe(axis)
         # sender-side alignment of MY segment's tokens
         gi_me = lax.dynamic_index_in_dim(gi_full, me, keepdims=False)
@@ -154,6 +159,7 @@ def ag_moe_group_gemm(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
             ),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY),
                       pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pltpu.SMEM),
                       pl.BlockSpec(memory_space=pltpu.SMEM)],
             out_specs=(pl.BlockSpec(memory_space=pl.ANY),
                        pl.BlockSpec(memory_space=pl.ANY)),
@@ -170,9 +176,10 @@ def ag_moe_group_gemm(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
                 * jnp.dtype(tok_shard.dtype).itemsize,
                 transcendentals=0),
             interpret=default_interpret(),
-        )(x, w_shard, be_full)
+        )(x, w_shard, be_full, nb_full)
 
-        # unscramble: aligned rows → original token order (invalid → drop)
+        # unscramble: aligned rows → original token order (invalid → drop;
+        # this also drops the garbage rows past each segment's block bound)
         dest = jnp.arange(n, dtype=jnp.int32)[:, None] * t_local + gi_full
         dest = jnp.where(rv_full, dest, T).reshape(-1)
         valid = rv_full.reshape(-1)[:, None].astype(y.dtype)
@@ -180,10 +187,10 @@ def ag_moe_group_gemm(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
             y * valid, mode="drop")
 
     sm = ctx.shard_map(
-        f, in_specs=(P(axis), P(None, None), P(None, None), P(None),
+        f, in_specs=(P(axis), P(None, None), P(None, None), P(None), P(None),
                      P(None, None, axis)),
         out_specs=P(None, axis))
-    return sm(tokens, gi, rv, be_flat, weights)
+    return sm(tokens, gi, rv, be_flat, nb, weights)
 
 
 # ---------------------------------------------------------------------------
@@ -191,13 +198,14 @@ def ag_moe_group_gemm(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
 # ---------------------------------------------------------------------------
 
 def _moe_rs_kernel(axis, mesh_axes, bm, bn, n_blocks,
-                   x_ref, w_ref, be_ref, out_ref, ws_ref, stage_ref,
+                   x_ref, w_ref, be_ref, nb_ref, out_ref, ws_ref, stage_ref,
                    send_sems, recv_sems):
     P_seg = out_ref.shape[0]
 
     def emit(seg, dst_ref):
         emit_grouped_gemm(x_ref.at[pl.ds(seg * P_seg, P_seg)], w_ref,
-                          dst_ref, be_ref, seg * n_blocks, bm, bn)
+                          dst_ref, be_ref, seg * n_blocks, bm, bn,
+                          n_blocks_used=nb_ref[seg])
 
     rs_overlap_protocol(axis, mesh_axes, ws_ref, stage_ref,
                         send_sems, recv_sems, emit)
@@ -205,8 +213,8 @@ def _moe_rs_kernel(axis, mesh_axes, bm, bn, n_blocks,
 
 
 def _moe_rs_2d_kernel(axes, mesh_axes, bm, bn, n_blocks, P_seg,
-                      x_ref, w_ref, be_ref, red_ref, ws_ref, stage_ref,
-                      send_sems, recv_sems):
+                      x_ref, w_ref, be_ref, nb_ref, red_ref, ws_ref,
+                      stage_ref, send_sems, recv_sems):
     """Fast-tier stage of the hierarchical GroupGEMM-RS: the inner-group RS
     segments are the *strided* aligned chunks {(r, j) : r < no} in
     outer-major block order (same layout trick as _gemm_rs_2d_stage_kernel),
@@ -220,7 +228,8 @@ def _moe_rs_2d_kernel(axes, mesh_axes, bm, bn, n_blocks, P_seg,
             seg = r * ni + j
             emit_grouped_gemm(x_ref.at[pl.ds(seg * P_seg, P_seg)], w_ref,
                               dst_ref.at[pl.ds(r * P_seg, P_seg)],
-                              be_ref, seg * n_blocks, bm, bn)
+                              be_ref, seg * n_blocks, bm, bn,
+                              n_blocks_used=nb_ref[seg])
 
     rs_overlap_protocol(inner, mesh_axes, ws_ref, stage_ref,
                         send_sems, recv_sems, emit)
@@ -264,10 +273,10 @@ def moe_reduce_rs(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
     # ids are replicated → every rank computes identical per-segment
     # alignment; the ring reduces ALIGNED rows (topk fold commutes with the
     # cross-rank sum and runs once at the end)
-    gi, rv, be = _segment_alignment(ids.reshape(n, seg_rows), E, bm)
+    gi, rv, be, nb = _segment_alignment(ids.reshape(n, seg_rows), E, bm)
     be_flat = be.reshape(-1)
 
-    def f(tok_shard, gi_full, rv_full, be_full, tw_full, w_shard):
+    def f(tok_shard, gi_full, rv_full, be_full, nb_full, tw_full, w_shard):
         me = shd.my_pe(axis)
         # aligned rows for every segment, from my K-shard of the tokens
         base = (jnp.arange(n, dtype=jnp.int32) * seg_rows)[:, None]
@@ -296,6 +305,7 @@ def moe_reduce_rs(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
             ),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY),
                       pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pltpu.SMEM),
                       pl.BlockSpec(memory_space=pltpu.SMEM)],
             out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 3,
             scratch_shapes=[
@@ -311,7 +321,7 @@ def moe_reduce_rs(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
                 * jnp.dtype(tok_shard.dtype).itemsize,
                 transcendentals=0),
             interpret=default_interpret(),
-        )(x, w_shard, be_full)
+        )(x, w_shard, be_full, nb_full)
         if hier:
             from triton_dist_tpu.ops.reduce_scatter import _rs_call
             y = _rs_call(axis[0], mesh_axes, no, y)   # [P_seg, N] f32
@@ -330,9 +340,9 @@ def moe_reduce_rs(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
 
     sm = ctx.shard_map(
         f, in_specs=(P(None, axis), P(None, None), P(None, None), P(None),
-                     P(None, None), P(None, axis, None)),
+                     P(None), P(None, None), P(None, axis, None)),
         out_specs=P(axis))
-    return sm(tokens, gi, rv, be_flat, topk_weights, weights)
+    return sm(tokens, gi, rv, be_flat, nb, topk_weights, weights)
 
 
 __all__ = ["ag_moe_group_gemm", "moe_reduce_rs"]

@@ -75,6 +75,44 @@ def producer_noise(src_ref) -> None:
         pltpu.sync_copy(src_ref, src_ref)
 
 
+# -- serialized-execution bisection mode ------------------------------------
+
+def _serial() -> bool:
+    """``TDT_SERIAL=1`` (read at trace time) forces every put to complete
+    synchronously at the source before the kernel proceeds — the analog of
+    the reference's ``serial=True`` debug switch on its overlap ops
+    (allgather_gemm.py:428,482-485), which serializes the copy/compute
+    overlap to bisect hangs and races. With it set, all cross-device
+    pipelining collapses to a lock-step schedule; correctness must be
+    unchanged, only slower — any behavioral difference is a sync bug."""
+    return os.environ.get("TDT_SERIAL") == "1"
+
+
+class _CompletedDMA:
+    """Stand-in descriptor returned by ``putmem_nbi`` in TDT_SERIAL mode:
+    the put already completed at source, so ``quiet``/``wait_send`` become
+    no-ops (a second wait on the consumed send semaphore would hang).
+
+    ``wait()`` intentionally RAISES: on a real remote-copy descriptor it
+    also waits the *receive* semaphore, which serial mode cannot have
+    satisfied (delivery is signaled on the peer, not here) — silently
+    no-opping would turn the bisection mode itself into a race. Kernels
+    awaiting their own incoming delivery must use ``wait_recv``."""
+
+    def wait_send(self):
+        return None
+
+    def wait(self):
+        raise RuntimeError(
+            "TDT_SERIAL: .wait() on a serialized put is ambiguous (the real "
+            "descriptor would also wait the recv semaphore). Use wait_recv("
+            "dst_ref, recv_sem) for deliveries; send completion already "
+            "happened.")
+
+
+_COMPLETED_DMA = _CompletedDMA()
+
+
 # -- PE identity ------------------------------------------------------------
 
 def my_pe(axis: str | Sequence[str]):
@@ -157,6 +195,9 @@ def putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, pe,):
         device_id_type=pltpu.DeviceIdType.LOGICAL,
     )
     rdma.start()
+    if _serial():
+        rdma.wait_send()
+        return _COMPLETED_DMA
     return rdma
 
 

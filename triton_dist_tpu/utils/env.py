@@ -16,6 +16,26 @@ import jax
 from jax.experimental.pallas import tpu as pltpu
 
 
+def _probe_default_backend(timeout_s: float = 45.0) -> int | None:
+    """Device count of the DEFAULT backend, probed in a subprocess with a
+    timeout. Never call ``jax.devices()`` in-process to *discover* a backend:
+    a wedged device tunnel blocks it forever (observed >2.5 h after a client
+    died mid-compile — verify skill notes), which is how round 2's multichip
+    dryrun timed out on plumbing while the code under test was green.
+    Returns None when the backend is unreachable within ``timeout_s``."""
+    import subprocess
+    import sys
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            timeout=timeout_s, capture_output=True, text=True)
+        if r.returncode == 0:
+            return int(r.stdout.strip().splitlines()[-1])
+    except Exception:
+        pass
+    return None
+
+
 def force_virtual_cpu_devices(n: int, skip_if_satisfied: bool = True) -> None:
     """Re-point jax at an ``n``-device virtual CPU platform, clearing any
     live backend (the container's sitecustomize eagerly initializes a TPU
@@ -32,11 +52,26 @@ def force_virtual_cpu_devices(n: int, skip_if_satisfied: bool = True) -> None:
     ``n`` devices (any platform — used by dryruns that accept real chips);
     pass False to force the CPU simulator unconditionally."""
     if skip_if_satisfied:
-        try:
-            if len(jax.devices()) >= n:
-                return
-        except Exception:
-            pass
+        import jax._src.xla_bridge as xb
+        if getattr(xb, "_backends", None):
+            # A backend is already live in-process: enumeration completed
+            # once, so devices() is a cached call that cannot hang.
+            try:
+                if len(jax.devices()) >= n:
+                    return
+            except Exception:
+                pass
+        else:
+            # No live backend yet — probing the default one in-process can
+            # hang forever on a wedged tunnel. Probe via subprocess+timeout
+            # and fall through to the forced CPU mesh on timeout/shortfall.
+            cnt = _probe_default_backend()
+            if cnt is not None and cnt >= n:
+                try:
+                    if len(jax.devices()) >= n:
+                        return
+                except Exception:
+                    pass  # backend vanished since the probe: fall through
     import jax._src.xla_bridge as xb
     try:
         xb._clear_backends()
@@ -123,7 +158,14 @@ def _register_cpu_tpu_info():
 
 
 def default_interpret():
-    """What to pass as ``pallas_call(interpret=...)`` on this backend."""
+    """What to pass as ``pallas_call(interpret=...)`` on this backend.
+
+    ``TDT_FORCE_COMPILED=1`` (read at trace time) forces the compiled Mosaic
+    path regardless of the live backend — used when lowering against an
+    *abstract TPU topology* (AOT deployment, the CI topology-compile gate in
+    tests/test_aot_topology.py) from a process whose default backend is CPU."""
+    if os.environ.get("TDT_FORCE_COMPILED") == "1":
+        return False
     if on_cpu():
         _register_cpu_tpu_info()
         return interpret_params()
