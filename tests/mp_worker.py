@@ -1,0 +1,68 @@
+"""Multi-process worker driven by tests/test_multiprocess.py (and runnable
+by hand: see __main__). One python process per "host", CPU backend with 2
+local virtual devices each — the single-controller-per-process model a real
+TPU pod uses, minus the chips (reference analog: one torchrun rank per GPU,
+launch.sh:33-44 + utils.py:91-111 bootstrap).
+
+Covers the three multi-host paths nothing else tests with
+``process_count() > 1``:
+- ``initialize_distributed``'s env-gated ``jax.distributed.initialize``
+  (shmem/context.py) incl. the JAX_NUM_PROCESSES/JAX_PROCESS_ID forwarding,
+- a pure-XLA collective over a mesh spanning both processes,
+- the autotuner's cross-process MAX consensus
+  (``_consensus_times`` → ``multihost_utils.process_allgather``).
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    # env must be pinned BEFORE jax import: 2 local CPU devices per process
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from triton_dist_tpu.shmem.context import initialize_distributed
+    from triton_dist_tpu.tools import contextual_autotune
+
+    ctx = initialize_distributed(axis_names=("x",), mesh_shape=(4,))
+    assert jax.process_count() == 2, jax.process_count()
+    me = jax.process_index()
+
+    # pure-XLA collective across both processes' devices
+    sharding = NamedSharding(ctx.mesh, P("x"))
+    ones = jax.jit(lambda: jnp.ones((8, 128), jnp.float32),
+                   out_shardings=sharding)()
+    total = jax.jit(
+        ctx.shard_map(lambda s: jax.lax.psum(jnp.sum(s), "x"),
+                      in_specs=P("x"), out_specs=P()))(ones)
+    np.testing.assert_allclose(np.asarray(total), 8 * 128)
+
+    # autotuned op: both configs timed on every process, consensus = MAX
+    calls = []
+
+    @contextual_autotune(configs=[2, 3], iters=1, warmup=0)
+    def op(x, cfg=None):
+        calls.append(cfg)
+        return x * cfg
+
+    y = op(jnp.ones((4,), jnp.float32))
+    assert sorted(set(calls)) == [2, 3], calls
+    picked = float(np.asarray(y)[0])
+    print(f"MP_OK process={me}/{jax.process_count()} picked={picked}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    # standalone: python tests/mp_worker.py <process_id> <num_processes> <addr>
+    if len(sys.argv) == 4:
+        os.environ["JAX_PROCESS_ID"] = sys.argv[1]
+        os.environ["JAX_NUM_PROCESSES"] = sys.argv[2]
+        os.environ["JAX_COORDINATOR_ADDRESS"] = sys.argv[3]
+    main()

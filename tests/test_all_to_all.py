@@ -149,3 +149,30 @@ def test_quantized_wire_preserves_ids(ctx):
     # round T/n*topk=8 up to their tile)
     q, b = np.asarray(ids_q), np.asarray(ids_b)
     assert sorted(q[q >= 0].tolist()) == sorted(b[b >= 0].tolist())
+
+
+def test_quantized_wire_fused_dequant_aligned_cap(ctx):
+    """capacity=128 hits the IN-KERNEL per-arrival dequant path (sub-128
+    caps take the post-kernel fallback — both must agree with the bf16
+    roundtrip within quantization error)."""
+    n = ctx.num_ranks
+    T, H, topk = n * 8, 256, 2
+    a2a = create_all_to_all_context(ctx, max_tokens=T // n, hidden=H,
+                                    topk=topk, num_experts=2 * n, axis="x",
+                                    capacity=128, dtype=jnp.bfloat16,
+                                    wire_dtype=jnp.float8_e4m3fn)
+    assert a2a.capacity == 128
+
+    tokens = jax.random.normal(jax.random.key(5), (T, H), jnp.float32
+                               ).astype(jnp.bfloat16)
+    ids = jax.random.randint(jax.random.key(6), (T, topk), 0, 2 * n)
+    w = jnp.ones((T, topk), jnp.float32) / topk
+
+    def roundtrip(t, i, ww):
+        recv, _, layout = dispatch(a2a, t, i)
+        return combine(a2a, recv, layout, ww)
+
+    out = jax.jit(roundtrip)(ctx.shard(tokens, P("x")),
+                             ctx.shard(ids, P("x")), ctx.shard(w, P("x")))
+    assert_allclose(np.asarray(out, np.float32),
+                    np.asarray(tokens, np.float32), rtol=0.15, atol=0.15)

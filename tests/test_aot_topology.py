@@ -159,6 +159,29 @@ def test_a2a_dispatch_combine_lowers_8dev(ctx1d):
     compile_ok(roundtrip, t, i, w)
 
 
+def test_a2a_fused_dequant_lowers_8dev(ctx1d):
+    """capacity=128 → the IN-KERNEL per-arrival dequant (emit_pipeline with
+    the lane→sublane scale broadcast) must lower at n=8."""
+    from triton_dist_tpu.ops.all_to_all import (combine,
+                                                create_all_to_all_context,
+                                                dispatch)
+    T, H, topk = N8 * 4, 128, 2
+    a2a = create_all_to_all_context(ctx1d, max_tokens=T // N8, hidden=H,
+                                    topk=topk, num_experts=2 * N8, axis="x",
+                                    capacity=128,
+                                    wire_dtype=jnp.float8_e4m3fn)
+    assert a2a.capacity == 128
+    t = sds(ctx1d, (T, H), P("x"), jnp.bfloat16)
+    i = sds(ctx1d, (T, topk), P("x"), jnp.int32)
+    w = sds(ctx1d, (T, topk), P("x"))
+
+    def roundtrip(tt, ii, ww):
+        recv, _, layout = dispatch(a2a, tt, ii)
+        return combine(a2a, recv, layout, ww)
+
+    compile_ok(roundtrip, t, i, w)
+
+
 @pytest.mark.parametrize("wire", [None, jnp.float8_e4m3fn])
 def test_a2a_2tier_lowers_8dev(ctx2d, wire):
     """The round-2 on-chip hang suspect: 2-tier dispatch+combine, bf16 and

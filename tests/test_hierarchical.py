@@ -394,3 +394,25 @@ def test_dispatch_combine_2d_fp8_roundtrip(ctx2d):
     err = np.abs(np.asarray(out) - np.asarray(tokens))
     scale = np.abs(np.asarray(tokens)).max(axis=-1, keepdims=True)
     assert np.max(err / (scale + 1e-6)) < 0.03, np.max(err / (scale + 1e-6))
+
+
+def test_dispatch_combine_2d_fp8_aligned_cap(ctx2d):
+    """cap1=128 (⇒ cap2=256, both 128-aligned): tier 2 takes the IN-KERNEL
+    per-arrival dequant, not the post-kernel fallback — the fused path must
+    be numerically indistinguishable from it."""
+    n, T, H, topk, E = 6, 8, 128, 2, 12
+    a2a = create_all_to_all_context_2d(ctx2d, max_tokens=T, hidden=H,
+                                       topk=topk, num_experts=E,
+                                       cap1=128, dtype=jnp.float32,
+                                       wire_dtype=jnp.int8)
+    assert a2a.cap1 == 128 and a2a.cap2 % 128 == 0, (a2a.cap1, a2a.cap2)
+    tokens = jax.random.normal(jax.random.key(4), (n * T, H), jnp.float32)
+    ids = jax.random.randint(jax.random.key(5), (n * T, topk), 0, E)
+    w = jnp.full((n * T, topk), 1.0 / topk)
+    spec = P(("a", "b"))
+    ts, is_, ws = (ctx2d.shard(t, spec) for t in (tokens, ids, w))
+    recv_tok, recv_ids, layouts = dispatch_2d(a2a, ts, is_)
+    out = combine_2d(a2a, recv_tok, layouts, ws)
+    err = np.abs(np.asarray(out) - np.asarray(tokens))
+    scale = np.abs(np.asarray(tokens)).max(axis=-1, keepdims=True)
+    assert np.max(err / (scale + 1e-6)) < 0.03, np.max(err / (scale + 1e-6))

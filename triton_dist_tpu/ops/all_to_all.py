@@ -45,13 +45,30 @@ from triton_dist_tpu.utils import default_interpret
 # wire collective
 # ---------------------------------------------------------------------------
 
-def _a2a_kernel(axis, mesh_axes, n_arrays, refs):
-    """refs = [in_0..in_{A-1}, out_0..out_{A-1}, send_sems, recv_sems].
-    Each array is [n, ...]: in slot p is the payload for peer p; out slot p
-    is the payload received from peer p."""
+def _a2a_kernel(axis, mesh_axes, n_arrays, dequant, refs):
+    """refs = [in_0..in_{A-1}, (deq_out,)? out_0..out_{A-1}, send_sems,
+    recv_sems]. Each array is [n, ...]: in slot p is the payload for peer p;
+    out slot p is the payload received from peer p.
+
+    ``dequant`` (None or ``(out_dtype, cap, H, bn)``; cap % 128 == 0) fuses
+    the
+    receive-edge dequantization INTO the collective: array 0 is then the
+    quantized [n, cap, H] payload, the LAST array its f32 scale wire
+    [n, cap_cols//128, 128], and each peer's slot is dequantized into
+    ``deq_out`` as soon as it arrives — early arrivals' dequant overlaps the
+    wait for later peers, so only the LAST slot's dequant rides the critical
+    path (vs a full extra pass after the kernel). The reference's fp8 wire
+    does the same: scales ride the kernel and apply in place
+    (low_latency_all_to_all.py:60-88)."""
     ins = refs[:n_arrays]
-    outs = refs[n_arrays:2 * n_arrays]
-    send_sems, recv_sems = refs[2 * n_arrays:]
+    if dequant is None:
+        deq = None
+        outs = refs[n_arrays:2 * n_arrays]
+        send_sems, recv_sems = refs[2 * n_arrays:]
+    else:
+        deq = refs[n_arrays]
+        outs = refs[n_arrays + 1:2 * n_arrays + 1]
+        send_sems, recv_sems = refs[2 * n_arrays + 1:]
     me = shd.my_pe(axis)
     n = shd.n_pes(axis)
 
@@ -71,18 +88,46 @@ def _a2a_kernel(axis, mesh_axes, n_arrays, refs):
             rdmas.append(shd.putmem_nbi(outs[a].at[me], ins[a].at[dst],
                                         send_sems.at[a, dst],
                                         recv_sems.at[a, me], pid))
+
+    def dequant_slot(p):
+        out_dtype, cap, H, bn = dequant
+
+        def body(q_blk, sc_blk, o_blk):
+            sc = sc_blk[0]                                    # [128] lanes
+            o_blk[...] = (q_blk[...].astype(jnp.float32)
+                          * sc[:, None]).astype(out_dtype)
+
+        pltpu.emit_pipeline(
+            body,
+            grid=(cap // 128, H // bn),
+            in_specs=[
+                pl.BlockSpec((128, bn), lambda i, j: (i, j)),
+                # scale run [i*128, (i+1)*128) of the flattened wire is
+                # exactly row i of the [rows, 128] side-channel (the fused
+                # path requires cap % 128 == 0 — Mosaic rejects sub-128
+                # lane slices)
+                pl.BlockSpec((1, 128), lambda i, j: (i, 0)),
+            ],
+            out_specs=[pl.BlockSpec((128, bn), lambda i, j: (i, j))],
+        )(outs[0].at[p], outs[-1].at[p], deq.at[p])
+
     for c in local_copies:
         c.wait()
+    if dequant is not None:
+        dequant_slot(me)
     for p in range(1, n):
         src = lax.rem(me + p, n)
         for a in range(n_arrays):
             shd.wait_recv(outs[a].at[src], recv_sems.at[a, src])
+        if dequant is not None:
+            dequant_slot(src)
     shd.quiet(*rdmas)
 
 
 def all_to_all_push(ctx: ShmemContext, *arrays: jax.Array,
                     axis: str | None = None,
-                    spec: P | None = None) -> tuple[jax.Array, ...]:
+                    spec: P | None = None,
+                    dequant_to=None) -> tuple[jax.Array, ...]:
     """Generic low-latency All-to-All: each input is locally ``[n, ...]``
     where slot p is the payload destined for peer p along ``axis``. Returns
     same-shaped arrays where local slot p holds the payload *received from*
@@ -93,22 +138,43 @@ def all_to_all_push(ctx: ShmemContext, *arrays: jax.Array,
     other mesh axes holding replicas (data-parallel semantics). Pass
     ``P(mesh_axes)`` (flat, globally ``[n_devices*n, ...]``) when every
     device holds distinct payloads — e.g. one tier of the hierarchical
-    dispatch."""
+    dispatch.
+
+    ``dequant_to=<dtype>`` fuses the receive-edge dequantization into the
+    kernel (quantized-wire convention: ``arrays[0]`` is the [n, cap, H]
+    payload, ``arrays[-1]`` its per-slot f32 scale wire). The first returned
+    array is then [n, cap, H] in ``<dtype>`` — each peer's slot dequantized
+    as it arrived, overlapping the waits for later peers."""
     axis = axis or ctx.axis_names[0]
     n = ctx.axis_size(axis)
     mesh_axes = ctx.axis_names
     spec = spec if spec is not None else P(axis)
     n_arrays = len(arrays)
+    dequant = None
+    cap = None
+    if dequant_to is not None:
+        import math
+        assert n_arrays >= 2, "quantized wire needs payload + scale arrays"
+        _, cap, H = arrays[0].shape[-3:]
+        if cap % 128 == 0:
+            # in-kernel per-arrival dequant (sub-128 caps would need
+            # unaligned lane slices of the scale wire, which Mosaic
+            # rejects — those fall back to the post-kernel pass below)
+            dequant = (jnp.dtype(dequant_to), cap, H, math.gcd(512, H))
 
     def f(*shards):
-        kernel = lambda *refs: _a2a_kernel(axis, mesh_axes, n_arrays, refs)
+        kernel = lambda *refs: _a2a_kernel(axis, mesh_axes, n_arrays,
+                                           dequant, refs)
+        deq_shape = ()
+        if dequant is not None:
+            deq_shape = (jax.ShapeDtypeStruct(shards[0].shape, dequant[0]),)
         out = pl.pallas_call(
             kernel,
-            out_shape=tuple(jax.ShapeDtypeStruct(s.shape, s.dtype)
-                            for s in shards),
+            out_shape=deq_shape + tuple(
+                jax.ShapeDtypeStruct(s.shape, s.dtype) for s in shards),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * n_arrays,
-            out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY)
-                            for _ in shards),
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * (
+                n_arrays + len(deq_shape)),
             scratch_shapes=[
                 pltpu.SemaphoreType.DMA((n_arrays, n)),
                 pltpu.SemaphoreType.DMA((n_arrays, n)),
@@ -122,6 +188,15 @@ def all_to_all_push(ctx: ShmemContext, *arrays: jax.Array,
                 collective_id=collective_id_for(f"all_to_all_{axis}")),
             interpret=default_interpret(),
         )(*shards)
+        if dequant is not None:
+            # visible outs = (dequantized, raw wire ws, rest...): swap the
+            # raw payload ws for the dequantized buffer, keep the rest
+            return (out[0],) + out[2:]
+        if dequant_to is not None:
+            # unfused fallback (cap not 128-aligned): one XLA pass after
+            # the kernel
+            scale = out[-1].reshape(out[-1].shape[0], -1)[:, :cap]
+            return (_dequant(out[0], scale, dequant_to),) + out[1:]
         return out if isinstance(out, tuple) else (out,)
 
     sm = ctx.shard_map(f, in_specs=tuple(spec for _ in arrays),
@@ -201,13 +276,6 @@ def route_tokens(a2a: EpAllToAllContext, topk_ids: jax.Array):
     return dest, slot.reshape(T, k), valid.reshape(T, k)
 
 
-def _dequant_wire(ctx, axis, n, id_cols, cap, out_dtype):
-    """shard_map'd receive-edge dequant: (q wire, scale wire) → tokens."""
-    return ctx.shard_map(
-        lambda q, s: _dequant(q, s.reshape(n, id_cols)[:, :cap], out_dtype),
-        in_specs=(P(axis), P(axis)), out_specs=P(axis))
-
-
 def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
     """EP dispatch (analog of ``fast_all_to_all``,
     low_latency_all_to_all.py:189-248). Global inputs sharded P(axis):
@@ -240,12 +308,9 @@ def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
                             jnp.arange(T * k, dtype=jnp.int32) // k,
                             n, cap, T)
         if wire is not None:
-            # quantize the T unique tokens once; scales ride the same map
-            q, s = _quant(tok_shard, wire)
-            send_buf = _slot_gather(q, src, wire)
-            sc = _slot_gather(s[:, None], src, jnp.float32)[..., 0]
-            send_sc = jnp.ones((n, id_cols), jnp.float32).at[:, :cap].set(
-                jnp.where(src < T, sc, 1.0))
+            # fused gather+quant: one pass builds the wire buffer + scales
+            send_buf, sc = _slot_gather_quant(tok_shard, src, wire)
+            send_sc = jnp.ones((n, id_cols), jnp.float32).at[:, :cap].set(sc)
         else:
             send_buf = _slot_gather(tok_shard, src, a2a.dtype)
         send_ids = jnp.full((n, id_cols), -1, jnp.int32).at[
@@ -265,10 +330,11 @@ def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
     else:
         send_buf, send_ids, dest, slot, valid = sm(tokens, topk_ids)
     if wire is not None:
-        recv_q, recv_ids_wire, recv_sc = all_to_all_push(
-            ctx, send_buf, send_ids, send_sc, axis=axis)
-        recv_tokens = _dequant_wire(ctx, axis, n, id_cols, cap,
-                                    a2a.dtype)(recv_q, recv_sc)
+        # dequant fused into the collective: each peer's slot converts on
+        # arrival, overlapping later peers' waits
+        recv_tokens, recv_ids_wire, _ = all_to_all_push(
+            ctx, send_buf, send_ids, send_sc, axis=axis,
+            dequant_to=a2a.dtype)
     else:
         recv_tokens, recv_ids_wire = all_to_all_push(ctx, send_buf, send_ids,
                                                      axis=axis)
@@ -302,9 +368,8 @@ def combine(a2a: EpAllToAllContext, processed: jax.Array, layout,
 
         pq, psc = ctx.shard_map(qpack, in_specs=P(axis),
                                 out_specs=(P(axis), P(axis)))(processed)
-        back_q, back_sc = all_to_all_push(ctx, pq, psc, axis=axis)
-        back = _dequant_wire(ctx, axis, n, id_cols, cap,
-                             a2a.dtype)(back_q, back_sc)
+        back, _ = all_to_all_push(ctx, pq, psc, axis=axis,
+                                  dequant_to=a2a.dtype)
     else:
         (back,) = all_to_all_push(ctx, processed, axis=axis)
 
@@ -358,13 +423,16 @@ def _slot_gather(rows, src, out_dtype):
                      0).astype(out_dtype)
 
 
+def _qmax(wire_dtype) -> float:
+    if jnp.issubdtype(wire_dtype, jnp.floating):
+        return float(jnp.finfo(wire_dtype).max)
+    return float(jnp.iinfo(wire_dtype).max)
+
+
 def _quant(x: jax.Array, wire_dtype) -> tuple[jax.Array, jax.Array]:
     """Per-row symmetric quantization: (q rows in ``wire_dtype``,
     f32 scale per row). Zero rows get scale 1 (quantize to zeros)."""
-    if jnp.issubdtype(wire_dtype, jnp.floating):
-        qmax = float(jnp.finfo(wire_dtype).max)
-    else:
-        qmax = float(jnp.iinfo(wire_dtype).max)
+    qmax = _qmax(wire_dtype)
     xf = x.astype(jnp.float32)
     amax = jnp.max(jnp.abs(xf), axis=-1)
     scale = jnp.where(amax > 0, amax / qmax, 1.0)
@@ -372,6 +440,30 @@ def _quant(x: jax.Array, wire_dtype) -> tuple[jax.Array, jax.Array]:
     if not jnp.issubdtype(wire_dtype, jnp.floating):
         q = jnp.round(q)
     return q.astype(wire_dtype), scale
+
+
+def _slot_gather_quant(rows, src, wire_dtype):
+    """Fused ``_slot_gather`` + ``_quant``: build the [n_dst, cap, H]
+    quantized send buffer AND its per-slot f32 scales in ONE pass over the
+    gathered rows. The unfused form (quantize [T, H], gather q, gather
+    scales) costs two extra full memory passes that measured ~2× the bf16
+    dispatch at n=1 — pure edge overhead that would ride the multi-chip
+    critical path too. Reference parity: scales ride the same kernel as the
+    payload, no extra passes (low_latency_all_to_all.py:60-88).
+
+    A token routed to k slots has its amax recomputed per slot — identical
+    scale each time (bit-for-bit: same reduction over the same row), trading
+    a little VPU redundancy for whole HBM passes. Unfilled slots quantize to
+    zeros with scale 1 (``_quant``'s zero-row rule)."""
+    R = rows.shape[0]
+    H = rows.shape[-1]
+    filled = src < R
+    take = jnp.take(rows, jnp.minimum(src, R - 1).reshape(-1), axis=0)
+    take = take.reshape(src.shape + (H,)).astype(jnp.float32)
+    take = jnp.where(filled[..., None], take, 0.0)
+    q, scale = _quant(take.reshape(-1, H), wire_dtype)
+    return (q.reshape(take.shape).astype(wire_dtype),
+            scale.reshape(src.shape))
 
 
 def _dequant(q: jax.Array, scale: jax.Array, out_dtype) -> jax.Array:
@@ -524,13 +616,12 @@ def dispatch_2d(a2a: Ep2dAllToAllContext, tokens: jax.Array,
             eid, mode="drop")
         outs = ()
         if wire is not None:
-            # quantize ONCE at the source; the f32 scale side-channel rides
-            # the same slot maps through both tiers (no requantization)
-            q, sv = _quant(tok_shard, wire)
-            send = _slot_gather(q, src, wire)
-            sc = _slot_gather(sv[:, None], src, jnp.float32)[..., 0]
+            # fused gather+quant ONCE at the source; the f32 scale
+            # side-channel rides the same slot maps through both tiers
+            # (no requantization)
+            send, sc = _slot_gather_quant(tok_shard, src, wire)
             send_sc = jnp.ones((nM, c1_cols), jnp.float32).at[:, :cap1].set(
-                jnp.where(src < T, sc, 1.0))
+                sc)
             outs = (send_sc.reshape(nM, -1, 128),)
         else:
             send = _slot_gather(tok_shard, src, a2a.dtype)
@@ -574,13 +665,9 @@ def dispatch_2d(a2a: Ep2dAllToAllContext, tokens: jax.Array,
     sm2 = ctx.shard_map(build2, in_specs=(both,) * nw,
                         out_specs=(both,) * (nw + 3))
     *wires2, b_dst, slot2, ok2 = sm2(recv1, meta1r, *sc1r)
-    recv2, meta2r, *sc2r = all_to_all_push(ctx, *wires2, axis=minor,
-                                           spec=both)
-    if wire is not None:
-        recv2 = ctx.shard_map(
-            lambda q, sw: _dequant(
-                q, sw.reshape(nm, c2_cols)[:, :cap2], a2a.dtype),
-            in_specs=(both, both), out_specs=both)(recv2, sc2r[0])
+    recv2, meta2r, *sc2r = all_to_all_push(
+        ctx, *wires2, axis=minor, spec=both,
+        dequant_to=a2a.dtype if wire is not None else None)
 
     unpack = ctx.shard_map(
         lambda w: jnp.where(

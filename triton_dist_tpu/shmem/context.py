@@ -53,7 +53,15 @@ def initialize_distributed(axis_names: Sequence[str] = ("x",),
         "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_ID",
     ))
     if multihost_env and not jax.distributed.is_initialized():
-        jax.distributed.initialize()
+        # jax auto-detects only managed clusters (Slurm/MPI/GKE-TPU);
+        # the explicit JAX_NUM_PROCESSES/JAX_PROCESS_ID spelling that
+        # scripts/launch.sh documents for ad-hoc pods must be forwarded by
+        # hand (coordinator address jax reads itself).
+        nproc = os.environ.get("JAX_NUM_PROCESSES")
+        pid = os.environ.get("JAX_PROCESS_ID")
+        jax.distributed.initialize(
+            num_processes=int(nproc) if nproc else None,
+            process_id=int(pid) if pid else None)
     devices = np.array(jax.devices())
     if mesh_shape is None:
         mesh_shape = (devices.size,) + (1,) * (len(axis_names) - 1)
