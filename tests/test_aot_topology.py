@@ -205,6 +205,30 @@ def test_a2a_2tier_lowers_8dev(ctx2d, wire):
     compile_ok(roundtrip, t, i, w)
 
 
+# -- three-tier hierarchy ----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ctx3d(topo):
+    from jax.experimental import topologies
+    return ShmemContext(mesh=topologies.make_mesh(topo, (2, 2, 2),
+                                                  ("a", "b", "c")))
+
+
+def test_three_tier_lowers_8dev(ctx3d):
+    """3-axis hierarchical AG + AG-GEMM (reference push_3d family parity,
+    low_latency_allgather.py:345-530) must lower at (2,2,2)."""
+    from triton_dist_tpu.ops import all_gather
+    from triton_dist_tpu.ops.allgather_gemm import ag_gemm
+    axes = ("a", "b", "c")
+    x = sds(ctx3d, (N8 * 8, 128), P(axes))
+    compile_ok(lambda v: all_gather(ctx3d, v, method="push_2d"), x)
+    M, K, N = 512, 128, N8 * 128
+    a = sds(ctx3d, (M, K), P(axes))
+    b = sds(ctx3d, (K, N), P(None, axes))
+    compile_ok(lambda u, v: ag_gemm(ctx3d, u, v, axis=axes,
+                                    cfg=GemmConfig(M // N8, 128)), a, b)
+
+
 # -- MoE overlap -------------------------------------------------------------
 
 def test_ag_moe_group_gemm_lowers_8dev(ctx1d):

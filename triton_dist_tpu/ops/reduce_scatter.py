@@ -116,6 +116,14 @@ def reduce_scatter(ctx: ShmemContext, x: jax.Array, axis: str | None = None,
     innermost (fastest tier, ICI) first — the multi-tier analog of the
     reference's 2-D RS (reduce_scatter.py:430-785: intra-node scatter +
     per-node reduce + inter-node tier), generalized to any axis count."""
+    if axis is not None and not isinstance(axis, str):
+        # tuple spelling, consistent with ag_gemm/gemm_rs/all_gather: a
+        # tuple of ALL mesh axes selects the hierarchical path
+        if tuple(axis) != tuple(ctx.axis_names):
+            raise ValueError(
+                f"multi-axis reduce_scatter spans ALL mesh axes "
+                f"{ctx.axis_names}; got subset/reorder {tuple(axis)!r}")
+        axis = None
     if method == "auto":
         method = "ring_2d" if (axis is None and len(ctx.axis_names) > 1) \
             else "ring"
