@@ -176,3 +176,45 @@ def test_quantized_wire_fused_dequant_aligned_cap(ctx):
                              ctx.shard(ids, P("x")), ctx.shard(w, P("x")))
     assert_allclose(np.asarray(out, np.float32),
                     np.asarray(tokens, np.float32), rtol=0.15, atol=0.15)
+
+
+def test_dispatch_combine_capacity_drop_semantics(ctx):
+    """Over-capacity routing must DROP the excess (token, k) pairs, not
+    corrupt surviving slots: with every token targeting rank 0 and
+    capacity < T, combine returns w_sum_of_survivors * token for survivors
+    and exactly zero for fully-dropped tokens (standard expert-capacity
+    semantics; the reference instead sizes for worst case — capacity =
+    max_tokens * topk — which create_all_to_all_context defaults to)."""
+    n = ctx.num_ranks
+    # slots are per (src, dst) pair: with every (token, k) pair of a source
+    # targeting rank 0, source-local demand is (T/n)*topk = 16 pairs into
+    # cap=8 slots — a genuine 2x overflow (8 is the f32 sublane-tile floor,
+    # so _cap_round keeps it)
+    T, H, topk, cap = n * 8, 128, 2, 8
+    a2a = create_all_to_all_context(ctx, max_tokens=T // n, hidden=H,
+                                    topk=topk, num_experts=n,
+                                    capacity=cap, axis="x",
+                                    dtype=jnp.float32)
+    cap = a2a.capacity  # post-rounding
+    tokens = jax.random.normal(jax.random.key(0), (T, H), jnp.float32)
+    # every (token, k) pair -> expert 0 (rank 0): source-local demand is
+    # 2 * 8 = 16 pairs into `cap` slots
+    ids = jnp.zeros((T, topk), jnp.int32)
+    w = jnp.full((T, topk), 0.5)
+
+    def roundtrip(t, i, ww):
+        recv, _, layout = dispatch(a2a, t, i)
+        return combine(a2a, recv, layout, ww), layout[2]
+
+    out, valid = jax.jit(roundtrip)(ctx.shard(tokens, P("x")),
+                                    ctx.shard(ids, P("x")),
+                                    ctx.shard(w, P("x")))
+    out, valid = np.asarray(out), np.asarray(valid)
+    demand = (T // n) * topk
+    assert demand > cap, (demand, cap)  # the test must actually overflow
+    # per source shard: exactly cap pairs survive, in slot-assign order
+    assert valid.reshape(n, -1).sum(axis=1).tolist() == [cap] * n
+    toks = np.asarray(tokens)
+    surv_w = valid.reshape(T, topk).sum(axis=1) * 0.5
+    np.testing.assert_allclose(out, toks * surv_w[:, None], rtol=1e-5,
+                               atol=1e-5)
