@@ -14,15 +14,19 @@ device) down with it:
     python scripts/bisect_a2a_onchip.py            # all stages
     python scripts/bisect_a2a_onchip.py put serial_push   # specific ones
 
-Stages (each also run with TDT_SERIAL=1 first — serial-passes/pipelined-
-hangs ⇒ protocol sync bug; both hang ⇒ lowering/runtime):
-    put          known-good single-chip ring put (sanity: chip healthy)
-    serial_push  bare all_to_all_push, 2-axis (1,1) mesh, serialized puts
-    push         same, pipelined
-    serial_d2d   dispatch_2d, (1,1), serialized
-    d2d          dispatch_2d, (1,1), pipelined
-    roundtrip    dispatch_2d + combine_2d, (1,1)
-    d2d_fp8      quantized wire variant
+A pre-flight probe (subprocess jax.devices(), short timeout) runs first:
+on a wedged tunnel EVERY stage would otherwise hang in backend discovery
+before reaching any kernel, and a backend-init hang must not be
+misattributed to the kernel under test.
+
+Each kernel stage has a TDT_SERIAL=1 twin that runs first —
+serial-passes/pipelined-hangs ⇒ protocol sync bug; both hang ⇒
+lowering/runtime:
+    put                known-good single-chip ring put (chip sanity)
+    serial_push/push   bare all_to_all_push, 2-axis (1,1) mesh
+    serial_d2d/d2d     dispatch_2d, (1,1)
+    serial_roundtrip/roundtrip   dispatch_2d + combine_2d
+    serial_d2d_fp8/d2d_fp8       quantized wire variant
 """
 
 from __future__ import annotations
@@ -109,21 +113,37 @@ np.testing.assert_allclose(np.asarray(back, np.float32),
 """,
 }
 
+# (name, body_key, env overrides, wire-dtype code suffix)
+FP8 = ", wire_dtype=jnp.float8_e4m3fn"
 STAGES = [
-    ("put", "put", {}),
-    ("serial_push", "push", {"TDT_SERIAL": "1"}),
-    ("push", "push", {}),
-    ("serial_d2d", "d2d", {"TDT_SERIAL": "1"}),
-    ("d2d", "d2d", {}),
-    ("roundtrip", "roundtrip", {}),
-    ("d2d_fp8", "d2d", {"_wire": ", wire_dtype=jnp.float8_e4m3fn"}),
+    ("put", "put", {}, ""),
+    ("serial_push", "push", {"TDT_SERIAL": "1"}, ""),
+    ("push", "push", {}, ""),
+    ("serial_d2d", "d2d", {"TDT_SERIAL": "1"}, ""),
+    ("d2d", "d2d", {}, ""),
+    ("serial_roundtrip", "roundtrip", {"TDT_SERIAL": "1"}, ""),
+    ("roundtrip", "roundtrip", {}, ""),
+    ("serial_d2d_fp8", "d2d", {"TDT_SERIAL": "1"}, FP8),
+    ("d2d_fp8", "d2d", {}, FP8),
 ]
 
 
-def run_stage(name: str, body_key: str, env_extra: dict,
+def preflight(timeout_s: int = 180) -> bool:
+    """Backend reachability, probed in a subprocess: a wedged tunnel hangs
+    jax.devices() in ANY process with the device plugin registered, and
+    that hang must not be misread as a kernel-stage failure."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            timeout=timeout_s, capture_output=True, text=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_stage(name: str, body_key: str, env_extra: dict, wire: str,
               timeout_s: int = 1200) -> str:
-    body = STAGE_BODIES[body_key].replace(
-        "{wire}", env_extra.pop("_wire", ""))
+    body = STAGE_BODIES[body_key].replace("{wire}", wire)
     env = dict(os.environ)
     # client-side compile: a hung compile stays local and killable; never
     # let the remote terminal own the compile of a suspect graph
@@ -146,18 +166,26 @@ def run_stage(name: str, body_key: str, env_extra: dict,
 
 def main() -> int:
     want = set(sys.argv[1:])
-    known = {name for name, _, _ in STAGES}
+    known = {name for name, _, _, _ in STAGES}
     unknown = want - known
     if unknown:
         print(f"unknown stage(s) {sorted(unknown)}; "
               f"choose from {sorted(known)}", file=sys.stderr)
         return 2
+    print("[bisect] preflight: backend reachability ...", flush=True)
+    if not preflight():
+        print("[bisect] BACKEND UNREACHABLE (jax.devices() hung/failed in "
+              "a subprocess) — the tunnel is wedged; no kernel stage was "
+              "reached. Nothing below would measure the kernels.",
+              flush=True)
+        return 3
+    print("[bisect] preflight OK", flush=True)
     results = {}
-    for name, body_key, env_extra in STAGES:
+    for name, body_key, env_extra, wire in STAGES:
         if want and name not in want:
             continue
         print(f"[bisect] {name} ...", flush=True)
-        results[name] = run_stage(name, body_key, dict(env_extra))
+        results[name] = run_stage(name, body_key, dict(env_extra), wire)
         print(f"[bisect] {name}: {results[name]}", flush=True)
         if not results[name].startswith("OK"):
             print("[bisect] stopping at first failure (run remaining "

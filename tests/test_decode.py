@@ -133,3 +133,91 @@ def test_sp_decode_step_matches_single():
         # partitioner into the single-device path's scanned interpret kernel
         token = jnp.asarray(np.argmax(np.asarray(l_sp), axis=-1),
                             jnp.int32)
+
+
+def test_moe_sp_decode_step_matches_dense():
+    """moe_decode_step_sp (SP flash-decode attention + EP A2A MoE FFN in
+    one jitted step — the DeepSeek-style serving composition) == a
+    single-device dense reference step, over several steps so the cache
+    round-trips."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from conftest import TEST_WORLD
+    from triton_dist_tpu.layers.ep_a2a_layer import EPAll2AllLayer
+    from triton_dist_tpu.models.llama import rmsnorm, rope
+    from triton_dist_tpu.models.moe import (MoEConfig, init_moe_params,
+                                            moe_decode_step_sp)
+    from triton_dist_tpu.ops.flash_decode import gqa_decode_partial
+    from triton_dist_tpu.shmem.context import initialize_distributed
+
+    ctx = initialize_distributed(axis_names=("x",), mesh_shape=(TEST_WORLD,))
+    n = ctx.num_ranks
+    base = LlamaConfig(vocab_size=256, d_model=128, n_layers=2, n_heads=2,
+                       n_kv_heads=2, d_ff=128, max_seq_len=4 * 32)
+    cfg = MoEConfig(base=base, num_experts=2 * n, topk=2, moe_d_ff=128)
+    params = init_moe_params(jax.random.key(0), cfg)
+    B, S = 4, base.max_seq_len
+    layer = EPAll2AllLayer.create(ctx, max_tokens=B // n, hidden=base.d_model,
+                                  topk=cfg.topk, num_experts=cfg.num_experts,
+                                  axis="x", dtype=base.dtype)
+
+    cache = init_kv_cache(base, B, S)
+    spec = P(None, None, None, "x", None)
+    cache = {k: jax.device_put(v, NamedSharding(ctx.mesh, spec))
+             for k, v in cache.items()}
+    cache_1d = init_kv_cache(base, B, S)
+
+    def dense_step(params, token, pos, cache):
+        """Single-device reference: dense attention halves + dense MoE."""
+        b = cfg.base
+        Hq, Hkv, Dh = b.n_heads, b.n_kv_heads, b.head_dim
+        x = params["embed"][token].astype(b.dtype)
+        positions = jnp.full((B, 1), pos, jnp.int32)
+        ks, vs = [], []
+        for i in range(b.n_layers):
+            p = jax.tree.map(lambda a, i=i: a[i], params["blocks"])
+            ck, cv = cache["k"][i], cache["v"][i]
+            h = rmsnorm(x, p["attn_norm"], b.norm_eps)
+            q = rope((h @ p["wq"]).reshape(B, 1, Hq, Dh), positions,
+                     b.rope_theta)[:, 0]
+            k = rope((h @ p["wk"]).reshape(B, 1, Hkv, Dh), positions,
+                     b.rope_theta)
+            v = (h @ p["wv"]).reshape(B, 1, Hkv, Dh)
+            ck = jax.lax.dynamic_update_slice(ck, k.transpose(0, 2, 1, 3),
+                                              (0, 0, pos, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v.transpose(0, 2, 1, 3),
+                                              (0, 0, pos, 0))
+            kv_len = jnp.full((B,), pos + 1, jnp.int32)
+            attn, _ = gqa_decode_partial(q, ck, cv, kv_len)
+            x = x + attn.reshape(B, Hq * Dh).astype(x.dtype) @ p["wo"]
+            h = rmsnorm(x, p["mlp_norm"], b.norm_eps)
+            h32 = h.astype(jnp.float32)
+            gv, gi = jax.lax.top_k(
+                jax.nn.softmax(h32 @ p["w_router"], -1), cfg.topk)
+            gv = gv / jnp.sum(gv, -1, keepdims=True)
+            act = jax.nn.silu(jnp.einsum("td,edf->tef", h32,
+                                         p["we_gate"].astype(jnp.float32))) \
+                * jnp.einsum("td,edf->tef", h32,
+                             p["we_up"].astype(jnp.float32))
+            ye = jnp.einsum("tef,efd->ted",
+                            act.astype(b.dtype).astype(jnp.float32),
+                            p["we_down"].astype(jnp.float32))
+            sel = jnp.take_along_axis(ye, gi[..., None], axis=1)
+            x = x + jnp.sum(sel * gv[..., None], axis=1).astype(x.dtype)
+            ks.append(ck)
+            vs.append(cv)
+        x = rmsnorm(x, params["final_norm"], b.norm_eps)
+        return ((x @ params["lm_head"]).astype(jnp.float32),
+                {"k": jnp.stack(ks), "v": jnp.stack(vs)})
+
+    step_sp = jax.jit(lambda p, t, pos, c: moe_decode_step_sp(
+        ctx, layer, p, t, pos, cfg, c, sp_axis="x"))
+    step_1d = jax.jit(dense_step)
+
+    token = jax.random.randint(jax.random.key(1), (B,), 0, base.vocab_size)
+    for pos in range(3):
+        l_sp, cache = step_sp(params, token, pos, cache)
+        l_1d, cache_1d = step_1d(params, token, pos, cache_1d)
+        np.testing.assert_allclose(np.asarray(l_sp), np.asarray(l_1d),
+                                   rtol=3e-2, atol=3e-2)
+        token = jnp.asarray(np.argmax(np.asarray(l_sp), axis=-1), jnp.int32)

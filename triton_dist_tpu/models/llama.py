@@ -360,7 +360,8 @@ def decode_step(params: dict, token: jax.Array, pos: jax.Array,
 def decode_step_sp(ctx, params: dict, token: jax.Array, pos: jax.Array,
                    cfg: LlamaConfig, cache: dict,
                    axis: str | None = None,
-                   ag_method: str = "fused") -> tuple[jax.Array, dict]:
+                   ag_method: str = "fused",
+                   ffn=None) -> tuple[jax.Array, dict]:
     """Sequence-parallel one-token decode: the KV cache is sharded on its
     sequence dim across ``axis`` and attention runs the distributed
     flash-decode (local split-KV + fused partial-AG + lse-merge) — the
@@ -372,6 +373,11 @@ def decode_step_sp(ctx, params: dict, token: jax.Array, pos: jax.Array,
 
     ``cache`` as from ``init_kv_cache`` with k/v sharded
     P(None, None, None, axis, None) ([layers, B, Hkv, S, D] on S).
+
+    ``ffn(h, p) -> [B, D]`` overrides the per-layer FFN block (``h`` is the
+    post-mlp_norm hidden, ``p`` the layer's params) — how
+    ``models.moe.moe_decode_step_sp`` swaps in the expert-parallel MoE FFN
+    without duplicating the attention/cache plumbing.
     """
     from triton_dist_tpu.ops.flash_decode import sp_gqa_flash_decode
 
@@ -403,9 +409,13 @@ def decode_step_sp(ctx, params: dict, token: jax.Array, pos: jax.Array,
                                    ag_method=ag_method)
         x = x + attn.reshape(B, Hq * Dh).astype(x.dtype) @ p["wo"]
         h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps)
-        ff = jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)
-                         ).astype(h.dtype) * (h @ p["w_up"])
-        x = x + ff @ p["w_down"]
+        if ffn is None:
+            ff = (jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)
+                              ).astype(h.dtype) * (h @ p["w_up"])
+                  ) @ p["w_down"]
+        else:
+            ff = ffn(h, p)
+        x = x + ff.astype(x.dtype)
         ks_out.append(ck)
         vs_out.append(cv)
 

@@ -331,6 +331,37 @@ def moe_mlp_tp_overlap(ctx: ShmemContext, x2d: jax.Array,
                          block_m=block_m)
 
 
+def moe_decode_step_sp(ctx: ShmemContext, a2a_layer, params: dict,
+                       token: jax.Array, pos: jax.Array, cfg: MoEConfig,
+                       cache: dict, sp_axis: str | None = None,
+                       ag_method: str = "fused"
+                       ) -> tuple[jax.Array, dict]:
+    """DeepSeek-style serving decode step — BOTH showcase paths in one
+    jitted step: sequence-parallel distributed flash-decode attention over
+    the KV cache sharded on ``sp_axis`` (reference
+    sp_flash_decode_layer.py:78-184) and the expert-parallel MoE FFN
+    through the low-latency A2A dispatch/combine (test_ep_moe_inference.py
+    composition). The single-axis deployment uses ONE axis for both: KV
+    sequence shards and expert shards live on the same devices, which is
+    the reference's serving topology (SP decode ranks == EP ranks).
+
+    ``token`` [B] int32 with B = n_ranks * a2a.max_tokens;
+    ``pos`` scalar int32; ``cache`` as ``init_kv_cache(cfg.base, ...)``
+    stacked per layer, k/v sharded P(None, None, None, sp_axis, None).
+    Returns (logits [B, V] f32, updated cache).
+
+    Thin composition over ``llama.decode_step_sp``'s ``ffn`` hook — the
+    attention/cache plumbing lives in exactly one place."""
+    from triton_dist_tpu.models.llama import decode_step_sp
+
+    def moe_ffn(h, p):
+        return moe_mlp_ep_overlap(ctx, a2a_layer, h, p["w_router"],
+                                  p["we_gate"], p["we_up"], p["we_down"])
+
+    return decode_step_sp(ctx, params, token, pos, cfg.base, cache,
+                          axis=sp_axis, ag_method=ag_method, ffn=moe_ffn)
+
+
 __all__ = ["MoEConfig", "init_moe_params", "moe_param_specs",
            "moe_mlp_gshard", "moe_block_apply", "moe_forward",
-           "moe_mlp_ep_overlap", "moe_mlp_tp_overlap"]
+           "moe_mlp_ep_overlap", "moe_mlp_tp_overlap", "moe_decode_step_sp"]
