@@ -282,6 +282,40 @@ def test_ring_attention_bwd_lowers_8dev(ctx1d):
     compile_ok(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
 
 
+# -- full serving composition ------------------------------------------------
+
+def test_moe_decode_step_lowers_8dev(ctx1d):
+    """The DeepSeek-style serving step (SP flash-decode attention + EP A2A
+    MoE FFN, models.moe.moe_decode_step_sp) — the widest single graph in
+    the framework — must lower at n=8 in one piece."""
+    from triton_dist_tpu.layers.ep_a2a_layer import EPAll2AllLayer
+    from triton_dist_tpu.models.llama import LlamaConfig
+    from triton_dist_tpu.models.moe import (MoEConfig, init_moe_params,
+                                            moe_decode_step_sp)
+    base = LlamaConfig(vocab_size=256, d_model=1024, n_layers=2, n_heads=8,
+                       n_kv_heads=2, d_ff=256, max_seq_len=N8 * 128)
+    cfg = MoEConfig(base=base, num_experts=2 * N8, topk=2, moe_d_ff=128)
+    B, S, L = N8, base.max_seq_len, base.n_layers
+    layer = EPAll2AllLayer.create(ctx1d, max_tokens=B // N8,
+                                  hidden=base.d_model, topk=cfg.topk,
+                                  num_experts=cfg.num_experts, axis="x",
+                                  dtype=base.dtype)
+    params = jax.eval_shape(lambda k: init_moe_params(k, cfg),
+                            jax.random.key(0))  # shapes only, no init work
+    params = jax.tree.map(
+        lambda s: sds(ctx1d, s.shape, P(), s.dtype), params)
+    Hkv, D = base.n_kv_heads, base.head_dim
+    kv = sds(ctx1d, (L, B, Hkv, S, D), P(None, None, None, "x", None),
+             base.dtype)
+    cache = {"k": kv, "v": kv}
+    token = sds(ctx1d, (B,), P(), jnp.int32)
+    pos = sds(ctx1d, (), P(), jnp.int32)
+
+    compile_ok(lambda p, t, po, c: moe_decode_step_sp(
+        ctx1d, layer, p, t, po, cfg, c, sp_axis="x"), params, token, pos,
+        cache)
+
+
 # -- distributed decode ------------------------------------------------------
 
 def test_fused_sp_decode_lowers_8dev(ctx1d):

@@ -144,10 +144,8 @@ def test_moe_sp_decode_step_matches_dense():
 
     from conftest import TEST_WORLD
     from triton_dist_tpu.layers.ep_a2a_layer import EPAll2AllLayer
-    from triton_dist_tpu.models.llama import rmsnorm, rope
     from triton_dist_tpu.models.moe import (MoEConfig, init_moe_params,
                                             moe_decode_step_sp)
-    from triton_dist_tpu.ops.flash_decode import gqa_decode_partial
     from triton_dist_tpu.shmem.context import initialize_distributed
 
     ctx = initialize_distributed(axis_names=("x",), mesh_shape=(TEST_WORLD,))
@@ -167,48 +165,26 @@ def test_moe_sp_decode_step_matches_dense():
              for k, v in cache.items()}
     cache_1d = init_kv_cache(base, B, S)
 
+    def dense_moe_ffn(h, p):
+        """Dense per-expert golden FFN — plugged into decode_step's ffn
+        hook so the attention/cache plumbing is the shared one."""
+        h32 = h.astype(jnp.float32)
+        gv, gi = jax.lax.top_k(
+            jax.nn.softmax(h32 @ p["w_router"], -1), cfg.topk)
+        gv = gv / jnp.sum(gv, -1, keepdims=True)
+        act = jax.nn.silu(jnp.einsum("td,edf->tef", h32,
+                                     p["we_gate"].astype(jnp.float32))) \
+            * jnp.einsum("td,edf->tef", h32,
+                         p["we_up"].astype(jnp.float32))
+        ye = jnp.einsum("tef,efd->ted",
+                        act.astype(cfg.base.dtype).astype(jnp.float32),
+                        p["we_down"].astype(jnp.float32))
+        sel = jnp.take_along_axis(ye, gi[..., None], axis=1)
+        return jnp.sum(sel * gv[..., None], axis=1)
+
     def dense_step(params, token, pos, cache):
-        """Single-device reference: dense attention halves + dense MoE."""
-        b = cfg.base
-        Hq, Hkv, Dh = b.n_heads, b.n_kv_heads, b.head_dim
-        x = params["embed"][token].astype(b.dtype)
-        positions = jnp.full((B, 1), pos, jnp.int32)
-        ks, vs = [], []
-        for i in range(b.n_layers):
-            p = jax.tree.map(lambda a, i=i: a[i], params["blocks"])
-            ck, cv = cache["k"][i], cache["v"][i]
-            h = rmsnorm(x, p["attn_norm"], b.norm_eps)
-            q = rope((h @ p["wq"]).reshape(B, 1, Hq, Dh), positions,
-                     b.rope_theta)[:, 0]
-            k = rope((h @ p["wk"]).reshape(B, 1, Hkv, Dh), positions,
-                     b.rope_theta)
-            v = (h @ p["wv"]).reshape(B, 1, Hkv, Dh)
-            ck = jax.lax.dynamic_update_slice(ck, k.transpose(0, 2, 1, 3),
-                                              (0, 0, pos, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v.transpose(0, 2, 1, 3),
-                                              (0, 0, pos, 0))
-            kv_len = jnp.full((B,), pos + 1, jnp.int32)
-            attn, _ = gqa_decode_partial(q, ck, cv, kv_len)
-            x = x + attn.reshape(B, Hq * Dh).astype(x.dtype) @ p["wo"]
-            h = rmsnorm(x, p["mlp_norm"], b.norm_eps)
-            h32 = h.astype(jnp.float32)
-            gv, gi = jax.lax.top_k(
-                jax.nn.softmax(h32 @ p["w_router"], -1), cfg.topk)
-            gv = gv / jnp.sum(gv, -1, keepdims=True)
-            act = jax.nn.silu(jnp.einsum("td,edf->tef", h32,
-                                         p["we_gate"].astype(jnp.float32))) \
-                * jnp.einsum("td,edf->tef", h32,
-                             p["we_up"].astype(jnp.float32))
-            ye = jnp.einsum("tef,efd->ted",
-                            act.astype(b.dtype).astype(jnp.float32),
-                            p["we_down"].astype(jnp.float32))
-            sel = jnp.take_along_axis(ye, gi[..., None], axis=1)
-            x = x + jnp.sum(sel * gv[..., None], axis=1).astype(x.dtype)
-            ks.append(ck)
-            vs.append(cv)
-        x = rmsnorm(x, params["final_norm"], b.norm_eps)
-        return ((x @ params["lm_head"]).astype(jnp.float32),
-                {"k": jnp.stack(ks), "v": jnp.stack(vs)})
+        return decode_step(params, token, pos, cfg.base, cache,
+                           ffn=dense_moe_ffn)
 
     step_sp = jax.jit(lambda p, t, pos, c: moe_decode_step_sp(
         ctx, layer, p, t, pos, cfg, c, sp_axis="x"))

@@ -317,11 +317,18 @@ def prefill(params: dict, tokens: jax.Array, cfg: LlamaConfig,
 
 
 def decode_step(params: dict, token: jax.Array, pos: jax.Array,
-                cfg: LlamaConfig, cache: dict) -> tuple[jax.Array, dict]:
+                cfg: LlamaConfig, cache: dict,
+                ffn=None) -> tuple[jax.Array, dict]:
     """One-token decode via the flash-decode kernel. ``token`` [B] int32,
     ``pos`` scalar int32 (cache slots filled so far). Returns
     (logits [B, V], cache). Attention = ops.flash_decode.gqa_decode_partial
-    over the cache (the single-rank half of SpGQAFlashDecodeAttention)."""
+    over the cache (the single-rank half of SpGQAFlashDecodeAttention).
+
+    ``ffn(h, p) -> [B, D]`` overrides the per-layer FFN block (same hook as
+    ``decode_step_sp`` — lets single-device references for MoE variants
+    reuse this plumbing). With a custom ``ffn`` the layer loop unrolls in
+    Python instead of ``lax.scan`` (the callback may close over shard_map'd
+    kernels that don't compose with scan on every backend)."""
     from triton_dist_tpu.ops.flash_decode import gqa_decode_partial
 
     B = token.shape[0]
@@ -345,13 +352,26 @@ def decode_step(params: dict, token: jax.Array, pos: jax.Array,
         attn, _lse = gqa_decode_partial(q, ck, cv, kv_len)
         x = x + attn.reshape(B, Hq * Dh) @ p["wo"]
         h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps)
-        ff = jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)
-                         ).astype(h.dtype) * (h @ p["w_up"])
-        x = x + ff @ p["w_down"]
+        if ffn is None:
+            ff = (jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)
+                              ).astype(h.dtype) * (h @ p["w_up"])
+                  ) @ p["w_down"]
+        else:
+            ff = ffn(h, p)
+        x = x + ff.astype(x.dtype)
         return x, (ck, cv)
 
-    x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"],
-                                     cache["v"]))
+    if ffn is None:
+        x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"],
+                                         cache["v"]))
+    else:
+        ks_l, vs_l = [], []
+        for i in range(cfg.n_layers):
+            p = jax.tree.map(lambda a, i=i: a[i], params["blocks"])
+            x, (ck, cv) = body(x, (p, cache["k"][i], cache["v"][i]))
+            ks_l.append(ck)
+            vs_l.append(cv)
+        ks, vs = jnp.stack(ks_l), jnp.stack(vs_l)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, {"k": ks, "v": vs}

@@ -354,6 +354,13 @@ def moe_decode_step_sp(ctx: ShmemContext, a2a_layer, params: dict,
     attention/cache plumbing lives in exactly one place."""
     from triton_dist_tpu.models.llama import decode_step_sp
 
+    a2a = a2a_layer.a2a
+    assert a2a.num_experts == cfg.num_experts, (
+        f"a2a layer built for {a2a.num_experts} experts but cfg routes "
+        f"over {cfg.num_experts} — gate ids would address nonexistent "
+        "ranks/slots")
+    assert a2a.topk == cfg.topk, (a2a.topk, cfg.topk)
+
     def moe_ffn(h, p):
         return moe_mlp_ep_overlap(ctx, a2a_layer, h, p["w_router"],
                                   p["we_gate"], p["we_up"], p["we_down"])

@@ -348,6 +348,12 @@ def ll_ag_merge(ctx: ShmemContext, packed: jax.Array, D: int,
     """Host wrapper for the fused partial-AG + merge. ``packed`` is
     [n, B, Hq, D+128] f32 sharded P(axis) (rank dim leading); returns
     merged [B, Hq, D] replicated."""
+    if not default_interpret() and D % 128:
+        raise ValueError(
+            f"fused SP decode on compiled TPU needs a lane-multiple head "
+            f"dim: head_dim={D} (Mosaic tiles lanes by 128 — the packed "
+            "(out ‖ lse) wire slices would be unaligned; the interpret-"
+            "mode simulator does not enforce this)")
     n = ctx.axis_size(axis)
     mesh_axes = ctx.axis_names
 
