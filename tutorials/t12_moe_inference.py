@@ -11,7 +11,7 @@ hierarchical 2-tier dispatch path is exercised at the layer level
 (tests/test_layers.py, tests/test_hierarchical.py).
 
 Run:  python -m tutorials.t12_moe_inference [--sim 4]
-      [--case correctness|correctness_fp8|perf]
+      [--case correctness|correctness_fp8|decode|perf]
 """
 
 from tutorials.common import (perf_report, register_case, time_op,
@@ -88,6 +88,46 @@ def correctness_fp8():
     _run(ctx, "x", wire_dtype=jnp.float8_e4m3fn, tol=2e-1)
     print(f"EP MoE block (fp8 wire + scale channel) over "
           f"{ctx.num_ranks} PEs == dense golden")
+
+
+@register_case("decode")
+def decode():
+    """Full serving decode step: SP flash-decode attention over the
+    sequence-sharded KV cache + the EP MoE FFN through the A2A — three
+    greedy steps with the cache round-tripping
+    (``models.moe.moe_decode_step_sp``)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from triton_dist_tpu.layers import EPAll2AllLayer
+    from triton_dist_tpu.models.llama import LlamaConfig, init_kv_cache
+    from triton_dist_tpu.models.moe import (MoEConfig, init_moe_params,
+                                            moe_decode_step_sp)
+    ctx = world_context()
+    n = ctx.num_ranks
+    base = LlamaConfig(vocab_size=256, d_model=256, n_layers=2, n_heads=2,
+                       n_kv_heads=2, d_ff=256, max_seq_len=n * 32)
+    cfg = MoEConfig(base=base, num_experts=2 * n, topk=2, moe_d_ff=128)
+    params = init_moe_params(jax.random.key(0), cfg)
+    B = n * max(1, 4 // n)   # B = n_ranks * max_tokens at any world size
+    layer = EPAll2AllLayer.create(ctx, max_tokens=B // n,
+                                  hidden=base.d_model, topk=cfg.topk,
+                                  num_experts=cfg.num_experts, axis="x",
+                                  dtype=base.dtype)
+    cache = init_kv_cache(base, B, base.max_seq_len)
+    spec = P(None, None, None, "x", None)
+    cache = {k: ctx.shard(v, spec) for k, v in cache.items()}
+    step = jax.jit(lambda p, t, pos, c: moe_decode_step_sp(
+        ctx, layer, p, t, pos, cfg, c))
+    tok = jnp.arange(B, dtype=jnp.int32)
+    for pos in range(3):
+        logits, cache = step(params, tok, pos, cache)
+        assert bool(jnp.all(jnp.isfinite(logits)))
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+    print(f"SP+EP serving decode step over {n} PEs: 3 greedy steps, "
+          f"tokens {np.asarray(tok).tolist()}")
 
 
 @register_case("perf")
