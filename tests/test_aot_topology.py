@@ -282,6 +282,22 @@ def test_ring_attention_bwd_lowers_8dev(ctx1d):
     compile_ok(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
 
 
+def test_ring_attention_zigzag_bwd_lowers_8dev(ctx1d):
+    """The load-balanced causal layout (fwd+bwd) — its two-chunk tile
+    offsets exercise different slicing than contiguous. s_loc=256 so each
+    zigzag chunk is 128 rows (the compiled-backend floor the op enforces;
+    s_loc=128 → 64-row chunks is rejected with a clear error)."""
+    from triton_dist_tpu.ops.ring_attention import ring_attention
+    q, k, v = _qkv_sds(ctx1d, N8, s_loc=256)
+
+    def loss(a, b, c):
+        return ring_attention(ctx1d, a, b, c, axis="x", causal=True,
+                              block_q=128, block_k=128,
+                              layout="zigzag").astype(jnp.float32).sum()
+
+    compile_ok(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
+
 # -- full serving composition ------------------------------------------------
 
 def test_moe_decode_step_lowers_8dev(ctx1d):

@@ -310,6 +310,14 @@ def ring_attention_fwd(ctx: ShmemContext, q: jax.Array, k: jax.Array,
     assert (S, D) == (Sk, Dk) and v.shape == k.shape, (q.shape, k.shape)
     assert S % n == 0, f"S={S} not divisible by ranks {n}"
     assert D % 128 == 0, f"head dim {D} must be a lane multiple"
+    if zigzag and not default_interpret():
+        if S % (2 * n) or (S // (2 * n)) % 128:
+            raise ValueError(
+                f"zigzag ring attention on compiled TPU needs 128-multiple "
+                f"chunks: S={S} over {n} ranks gives S_local/2="
+                f"{S / (2 * n):g} rows per chunk, and the lse-wire tile "
+                "slices would be lane-unaligned (Mosaic tiles by 128; the "
+                "interpret-mode simulator does not enforce this)")
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
 
     def f(q_s, k_s, v_s):
