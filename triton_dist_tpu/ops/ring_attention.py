@@ -273,6 +273,42 @@ def _ring_fwd_kernel(axis, mesh_axes, causal, zigzag, sm_scale,
     )(final, o_ref, lse_ref)
 
 
+def _tile_sizes(half: int, block_q: int, block_k: int) -> tuple[int, int]:
+    """THE derived q/k tile formula — the one source for the guard and both
+    kernel bodies (``half`` is the per-rank row span: s_loc, or s_loc/2
+    for zigzag)."""
+    return math.gcd(block_q, half), math.gcd(block_k, half)
+
+
+def _check_compiled_tiles(S: int, n: int, block_q: int, block_k: int,
+                          zigzag: bool) -> None:
+    """Compiled backends need the DERIVED q/k tile sizes (``_tile_sizes``
+    of the per-rank row span — the half-chunk for zigzag) to be
+    128-multiples: the lse-wire BlockSpecs slice the row dim along LANES,
+    and Mosaic rejects sub-128 lane slices. Interpret mode accepts any
+    tiling (it doesn't model the layout), so small-shape simulator tests
+    keep working. Raises with the failing numbers."""
+    if S % n:
+        raise ValueError(
+            f"ring attention needs S divisible by ranks: S={S}, ranks={n}")
+    if default_interpret():
+        return
+    if zigzag and S % (2 * n):
+        raise ValueError(
+            f"zigzag ring attention needs S divisible by 2*ranks: "
+            f"S={S}, ranks={n}")
+    half = S // (2 * n) if zigzag else S // n
+    bq, bk = _tile_sizes(half, block_q, block_k)
+    if bq % 128 or bk % 128:
+        raise ValueError(
+            f"ring attention on compiled TPU needs 128-multiple row tiles: "
+            f"S={S} over {n} ranks ({'zigzag half-chunks of ' if zigzag else 'local rows '}"
+            f"{half}) with block_q={block_q}/block_k={block_k} derives "
+            f"tiles ({bq}, {bk}) — the lse-wire slices would be "
+            "lane-unaligned (Mosaic tiles by 128; the interpret-mode "
+            "simulator does not enforce this)")
+
+
 def ring_attention_fwd(ctx: ShmemContext, q: jax.Array, k: jax.Array,
                        v: jax.Array, axis: str | None = None,
                        causal: bool = True, sm_scale: float | None = None,
@@ -310,14 +346,7 @@ def ring_attention_fwd(ctx: ShmemContext, q: jax.Array, k: jax.Array,
     assert (S, D) == (Sk, Dk) and v.shape == k.shape, (q.shape, k.shape)
     assert S % n == 0, f"S={S} not divisible by ranks {n}"
     assert D % 128 == 0, f"head dim {D} must be a lane multiple"
-    if zigzag and not default_interpret():
-        if S % (2 * n) or (S // (2 * n)) % 128:
-            raise ValueError(
-                f"zigzag ring attention on compiled TPU needs 128-multiple "
-                f"chunks: S={S} over {n} ranks gives S_local/2="
-                f"{S / (2 * n):g} rows per chunk, and the lse-wire tile "
-                "slices would be lane-unaligned (Mosaic tiles by 128; the "
-                "interpret-mode simulator does not enforce this)")
+    _check_compiled_tiles(S, n, block_q, block_k, zigzag)
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
 
     def f(q_s, k_s, v_s):
@@ -328,8 +357,7 @@ def ring_attention_fwd(ctx: ShmemContext, q: jax.Array, k: jax.Array,
         half = s_loc // 2 if zigzag else s_loc
         if zigzag:
             assert s_loc % 2 == 0, "zigzag needs an even local row count"
-        bq = math.gcd(block_q, half)
-        bk = math.gcd(block_k, half)
+        bq, bk = _tile_sizes(half, block_q, block_k)
         BH, BHkv = Bl * Hql, Bl * Hkvl
         q3 = q_s.reshape(BH, s_loc, D)
         k3 = k_s.reshape(BHkv, s_loc, D)
@@ -680,6 +708,7 @@ def ring_attention_bwd(ctx: ShmemContext, q, k, v, o, lse, do,
     D = q.shape[-1]
     assert layout in ("contiguous", "zigzag"), layout
     zigzag = layout == "zigzag"
+    _check_compiled_tiles(q.shape[2], n, block_q, block_k, zigzag)
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
 
     def f(q_s, k_s, v_s, o_s, lse_s, do_s):
@@ -688,8 +717,7 @@ def ring_attention_bwd(ctx: ShmemContext, q, k, v, o, lse, do,
         if zigzag:
             assert s_loc % 2 == 0, "zigzag needs an even local row count"
         half = s_loc // 2 if zigzag else s_loc
-        bq = math.gcd(block_q, half)
-        bk = math.gcd(block_k, half)
+        bq, bk = _tile_sizes(half, block_q, block_k)
         BH, BHkv = Bl * Hql, Bl * Hkvl
         q3 = q_s.reshape(BH, s_loc, D)
         k3 = k_s.reshape(BHkv, s_loc, D)
