@@ -21,19 +21,17 @@ import jax  # noqa: E402
 
 from triton_dist_tpu.utils.env import force_virtual_cpu_devices  # noqa: E402
 
-force_virtual_cpu_devices(12, skip_if_satisfied=False)
+_N_DEVICES = int(os.environ.get("TDT_TEST_DEVICES", "12"))
+force_virtual_cpu_devices(_N_DEVICES, skip_if_satisfied=False)
 
-assert jax.device_count() == 12, (
-    f"expected 12 virtual CPU devices, got {jax.devices()}"
+assert jax.device_count() == _N_DEVICES, (
+    f"expected {_N_DEVICES} virtual CPU devices, got {jax.devices()}"
 )
 
-# NOTE: kernel tests build meshes over a *subset* of the 12 virtual devices.
-# The Pallas TPU interpreter's device threads can deadlock when every device
-# thread simultaneously blocks in semaphore waits/barriers (threads pile up
-# in the interpreter's internal _barrier/_allocate_buffer); keeping spare
-# non-participating devices avoids it — 8 participants out of 12 devices is
-# verified reliable, 8/8 is not. Most tests use a 4-way mesh for speed;
-# TEST_WORLD_WIDE exercises the driver's exact 8-way configuration
-# (tests/test_eight_way.py).
+# Most tests use a 4-way mesh for speed; TEST_WORLD_WIDE exercises the
+# driver's exact 8-way configuration (tests/test_eight_way.py, and the
+# full-participation 8-of-8 sweep in test_full_participation.py via
+# TDT_TEST_DEVICES=8). The default keeps 12 devices so the wide tests also
+# cover the participants-<-devices subset shape users hit on real pods.
 TEST_WORLD = 4
 TEST_WORLD_WIDE = 8

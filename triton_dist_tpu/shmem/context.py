@@ -59,6 +59,13 @@ def initialize_distributed(axis_names: Sequence[str] = ("x",),
         # hand (coordinator address jax reads itself).
         nproc = os.environ.get("JAX_NUM_PROCESSES")
         pid = os.environ.get("JAX_PROCESS_ID")
+        if (nproc is None) != (pid is None):
+            missing = "JAX_PROCESS_ID" if pid is None else "JAX_NUM_PROCESSES"
+            present = "JAX_NUM_PROCESSES" if pid is None else "JAX_PROCESS_ID"
+            raise RuntimeError(
+                f"{present} is set but {missing} is not; ad-hoc multi-host "
+                "bootstrap needs both (see scripts/launch.sh), or neither "
+                "on a managed cluster where jax auto-detects them")
         jax.distributed.initialize(
             num_processes=int(nproc) if nproc else None,
             process_id=int(pid) if pid else None)
@@ -69,6 +76,45 @@ def initialize_distributed(axis_names: Sequence[str] = ("x",),
     if n_mesh > devices.size:
         raise ValueError(f"mesh_shape {mesh_shape} needs {n_mesh} devices, "
                          f"only {devices.size} available")
+    if (n_mesh == devices.size and n_mesh > 1
+            and devices[0].platform == "cpu"
+            and not jax.distributed.is_initialized()
+            and os.environ.get("TDT_NO_CPU_SPARES") != "1"):
+        # (n_mesh > 1: a single-device mesh has no cross-device waits to
+        # deadlock — don't churn the backend for it.)
+        # (single-process only: in a jax.distributed cluster the local
+        # device count is recorded with the coordination service, and
+        # re-creating the backend with extra local devices is rejected —
+        # "Different local topology for node 0". Multi-process interpret
+        # runs keep the spare-device responsibility with the launcher.)
+        # Full-participation interpreter deadlock workaround: the Pallas
+        # TPU interpreter's per-device kernel threads run on the CPU
+        # client's execution pool, which is sized by device count. When
+        # EVERY device thread blocks in a semaphore wait simultaneously
+        # (any collective with enough in-kernel work), no pool thread is
+        # left to drive the cross-device progress machinery and the
+        # process hangs (reproduced: ag_gemm [512,512]x[512,1024] at
+        # 8-of-8 deadlocks; identical shape at 8-of-12 runs in 4 s).
+        # Transparently re-point jax at n + max(4, n) virtual devices
+        # (spares = n: thinner ratios still starved occasionally — a
+        # 12-of-18 run was observed taking 169 s vs the usual 6 s)
+        # and build the mesh over the first n, so a user's all-device
+        # CPU mesh just works. Real-chip meshes are untouched.
+        # Re-pointing REPLACES the backend: arrays/meshes created before
+        # this call die with a deleted-client error — warn so the failure
+        # is attributable (create the context first, or opt out).
+        import warnings
+        warnings.warn(
+            f"initialize_distributed: CPU mesh spans all {n_mesh} visible "
+            "devices; provisioning spare virtual devices to avoid the "
+            "interpreter's full-participation deadlock. This resets the "
+            "jax CPU backend — jax arrays created before this call are "
+            "invalidated (set TDT_NO_CPU_SPARES=1 to opt out).",
+            stacklevel=2)
+        from triton_dist_tpu.utils.env import force_virtual_cpu_devices
+        force_virtual_cpu_devices(n_mesh + max(4, n_mesh),
+                                  skip_if_satisfied=False)
+        devices = np.array(jax.devices())
     dev_grid = None
     if n_mesh == devices.size and devices[0].platform == "tpu":
         # Topology-aware device ordering: ring/relay neighbors along the
