@@ -91,7 +91,7 @@ def test_ag_gemm_context_rejects_outer_jit(ctx):
     n = ctx.num_ranks
     M = K = 16 * n
     agc = create_ag_gemm_context(ctx, M // n, K, jnp.float32, axis="x")
-    with pytest.raises(AssertionError, match="must not be called under"):
+    with pytest.raises(RuntimeError, match="eager-only"):
         jax.jit(lambda a, b: agc(a, b))(
             jnp.zeros((M, K)), jnp.zeros((K, 128 * n)))
 
@@ -146,3 +146,32 @@ def test_gemm_rs_context_stateful(ctx):
                                      out_specs=P("x")))(a, b)
         assert_allclose(np.asarray(c), np.asarray(gold), rtol=1e-4,
                         atol=1e-4)
+
+
+def test_context_cache_lru_and_trace_error(ctx):
+    """r3 Weak #8: the eager contexts' per-shape step caches are bounded
+    (LRU eviction) and calling them under a trace raises a descriptive
+    RuntimeError, not a bare assert."""
+    import triton_dist_tpu.ops.common as common
+
+    n = ctx.num_ranks
+    M = K = 8 * n
+    agc = create_ag_gemm_context(ctx, M // n, K, jnp.float32, axis="x")
+    a = jax.random.normal(jax.random.key(0), (M, K), jnp.float32)
+    a_s = ctx.shard(a, P("x"))
+
+    with pytest.raises(RuntimeError, match="eager-only"):
+        jax.jit(lambda x: agc(x, x))(a_s)
+
+    old = common._CONTEXT_CACHE_SIZE
+    common._CONTEXT_CACHE_SIZE = 2
+    try:
+        for n_cols in (128, 256, 384, 128):
+            b = jax.random.normal(jax.random.key(1), (K, n_cols * n),
+                                  jnp.float32)
+            c = agc(a_s, ctx.shard(b, P(None, "x")))
+            np.testing.assert_allclose(np.asarray(c), np.asarray(a @ b),
+                                       rtol=5e-2, atol=5e-1)
+            assert len(agc._steps) <= 2
+    finally:
+        common._CONTEXT_CACHE_SIZE = old

@@ -133,6 +133,34 @@ def _ag_1d(ctx: ShmemContext, x: jax.Array, axis: str, method: str):
     return sm(x)
 
 
+# Below this per-rank shard size the gather is latency-bound and the
+# one-hop full-mesh push wins; above it the per-link-bandwidth-optimal
+# ring does. 256 KB ≈ the crossover implied by v5e ICI (~45 GB/s/link,
+# ~1 µs hop overhead): push sends (n-1) x bytes over one step, ring
+# pipelines n-1 single-segment hops.
+_PUSH_BYTES_CEILING = 256 * 1024
+
+
+def _auto_method(ctx: ShmemContext, x: jax.Array, axis) -> str:
+    """Topology/shape-keyed method pick — the analog of the reference's
+    NVLink/NUMA-topology dispatch (allgather.py:54-69 backed by
+    utils.py:504-607). The TPU topology signal is the mesh itself: how many
+    axes the gather spans (one ICI ring vs a torus/multi-slice hierarchy,
+    slow tier first by ``initialize_distributed`` convention) and the
+    per-rank payload size (latency- vs bandwidth-bound regime)."""
+    axis_names = ctx.axis_names
+    spans_multi = (axis is None and len(axis_names) > 1) or (
+        isinstance(axis, tuple) and len(axis) > 1)
+    shard_bytes = (x.size // max(ctx.axis_size(axis), 1)) * x.dtype.itemsize
+    if spans_multi:
+        # hierarchy: single-kernel relay for small payloads (fewest
+        # kernel/barrier rounds), per-axis rings for bandwidth-bound sizes
+        return "push_2d" if shard_bytes <= _PUSH_BYTES_CEILING else "ring_2d"
+    if ctx.axis_size(axis) <= 4 or shard_bytes <= _PUSH_BYTES_CEILING:
+        return "push"
+    return "ring"
+
+
 def all_gather(ctx: ShmemContext, x: jax.Array, axis: str | None = None,
                method: str = "auto"):
     """AllGather ``x`` (sharded on dim 0 along ``axis``) → replicated global
@@ -146,10 +174,7 @@ def all_gather(ctx: ShmemContext, x: jax.Array, axis: str | None = None,
     if axis is None and len(axis_names) == 1:
         axis = axis_names[0]
     if method == "auto":
-        if axis is None and len(axis_names) > 1:
-            method = "ring_2d"
-        else:
-            method = "push" if ctx.axis_size(axis) <= 4 else "ring"
+        method = _auto_method(ctx, x, axis)
     if method in ("ring_2d", "push_2d"):
         if len(axis_names) < 2 and not (isinstance(axis, tuple)
                                         and len(axis) > 1):

@@ -38,8 +38,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
-from triton_dist_tpu.ops.common import collective_id_for, norm_axis as _norm_axis
-from triton_dist_tpu.ops.gemm import GemmConfig, emit_gemm
+from triton_dist_tpu.ops.common import (collective_id_for, lru_step,
+                                         norm_axis as _norm_axis,
+                                         require_eager)
+from triton_dist_tpu.ops.gemm import (GemmConfig, best_gemm_config,
+                                       emit_gemm)
 from triton_dist_tpu.shmem import device as shd
 from triton_dist_tpu.shmem.context import ShmemContext
 from triton_dist_tpu.utils import default_interpret
@@ -186,6 +189,15 @@ def _ag_gemm_kernel(axis, mesh_axes, cfg, out_dtype,
                             send_sems, recv_sems, emit)
 
 
+def _default_cfg(ctx, a, b, axis) -> GemmConfig:
+    """Shape-keyed default tiles (measured-best table, docs/benchmarks.md):
+    the per-segment GEMM is [M/n, K] x [K, N/n]."""
+    n = ctx.axis_size(axis)
+    M, K = a.shape
+    return best_gemm_config(max(M // n, 1), max(b.shape[1] // n, 1), K,
+                            jnp.dtype(a.dtype).itemsize)
+
+
 def _validate(ctx, a, b, axis, cfg):
     n = ctx.axis_size(axis)
     M, K = a.shape
@@ -271,7 +283,7 @@ def ag_gemm(ctx: ShmemContext, a: jax.Array, b: jax.Array,
     context-owned symmetric workspace (reference parity:
     create_ag_gemm_intra_node_context, allgather_gemm.py:785-832)."""
     axis = _norm_axis(ctx, axis)
-    cfg = cfg or GemmConfig()
+    cfg = cfg or _default_cfg(ctx, a, b, axis)
     out_dtype = out_dtype or a.dtype
     mesh_axes = ctx.axis_names
     n, M, K, m_local = _validate(ctx, a, b, axis, cfg)
@@ -297,7 +309,7 @@ def ag_gemm_ws(ctx: ShmemContext, a: jax.Array, b: jax.Array, ws: jax.Array,
     ``create_ag_gemm_workspace``. ``axis`` may be a tuple (hierarchical
     2-tier path, see ``ag_gemm``)."""
     axis = _norm_axis(ctx, axis)
-    cfg = cfg or GemmConfig()
+    cfg = cfg or _default_cfg(ctx, a, b, axis)
     out_dtype = out_dtype or a.dtype
     mesh_axes = ctx.axis_names
     n, M, K, m_local = _validate(ctx, a, b, axis, cfg)
@@ -331,10 +343,11 @@ def create_ag_gemm_workspace(ctx: ShmemContext, m_local: int, k: int,
 @dataclasses.dataclass
 class AgGemmContext:
     """Stateful sugar over ``ag_gemm_ws``: owns the symmetric workspace and
-    a per-shape cache of donated jitted steps, so eager callers get in-place
-    workspace reuse without threading state themselves. Do NOT wrap calls in
-    an outer ``jax.jit`` (each step is already jitted; under an outer trace
-    the state update would leak) — use ``ag_gemm_ws`` inside jit/scan.
+    a per-shape LRU cache of donated jitted steps, so eager callers get
+    in-place workspace reuse without threading state themselves. Do NOT
+    wrap calls in an outer ``jax.jit`` (each step is already jitted; under
+    an outer trace the state update would leak) — use ``ag_gemm_ws`` inside
+    jit/scan.
     """
     ctx: ShmemContext
     axis: str
@@ -343,18 +356,13 @@ class AgGemmContext:
 
     def __call__(self, a: jax.Array, b: jax.Array,
                  cfg: GemmConfig | None = None, out_dtype=None) -> jax.Array:
-        from jax._src import core as jcore
-        assert jcore.trace_state_clean(), (
-            "AgGemmContext must not be called under jit/vmap tracing; "
-            "use ag_gemm_ws and thread the workspace explicitly")
+        require_eager("AgGemmContext", "ag_gemm_ws")
         key = (a.shape, b.shape, str(a.dtype), cfg, out_dtype)
-        if key not in self._steps:
-            self._steps[key] = jax.jit(
-                lambda ws, a, b: ag_gemm_ws(self.ctx, a, b, ws,
-                                            axis=self.axis, cfg=cfg,
-                                            out_dtype=out_dtype)[::-1],
-                donate_argnums=(0,))
-        self.ws, c = self._steps[key](self.ws, a, b)
+        step = lru_step(self._steps, key, lambda: jax.jit(
+            lambda ws, a, b: ag_gemm_ws(self.ctx, a, b, ws, axis=self.axis,
+                                        cfg=cfg, out_dtype=out_dtype)[::-1],
+            donate_argnums=(0,)))
+        self.ws, c = step(self.ws, a, b)
         return c
 
 

@@ -51,6 +51,62 @@ class GemmConfig:
         return self.vmem_bytes(K, bytes_per_el) <= budget
 
 
+# Measured-best tile table (docs/benchmarks.md sweeps, v5e): tried in
+# order, first config whose tiles divide the problem and fit the VMEM
+# budget wins. This is the analog of the reference's topology/shape-keyed
+# config pick (its GEMM configs are keyed per shape in the perf tests,
+# test_ag_gemm_intra_node.py:153-160) — here the key is divisibility, so
+# one ordered list covers all six model shapes plus the 4096^3 headline.
+_MEASURED_BEST = (
+    GemmConfig(512, 512, 2048),   # 179 TFLOP/s @ 4096^3; best square tile
+    GemmConfig(512, 256, 2048),   # LLaMA-7B-class N (256-divisible only)
+    GemmConfig(1024, 384, 1024),  # Qwen2-72B-class N (384- not 256-div.)
+    GemmConfig(1024, 256, 1024),  # tall-M fallback at large N
+    GemmConfig(256, 256, 4096),
+    GemmConfig(256, 256),
+    GemmConfig(256, 128),
+    GemmConfig(128, 128),
+)
+
+
+def best_gemm_config(m_rows: int, n_cols: int, K: int, itemsize: int,
+                     budget: int = 12 * 2**20) -> GemmConfig:
+    """Default tile pick for ``[m_rows, K] @ [K, n_cols]`` inside an overlap
+    kernel — ``m_rows``/``n_cols`` are the *per-segment* dims the GEMM
+    actually tiles over (local M for AG-GEMM, full N for GEMM-RS). Returns
+    the first measured-best config (``_MEASURED_BEST``) that divides the
+    shape and fits the scoped-VMEM budget; falls back to the largest
+    aligned tile for small/odd shapes so ``cfg=None`` never asserts."""
+    for cfg in _MEASURED_BEST:
+        if (m_rows % cfg.block_m == 0 and n_cols % cfg.block_n == 0
+                and (cfg.block_k is None or K % cfg.block_k == 0)
+                and cfg.vmem_ok(K, itemsize, budget)):
+            return cfg
+    # Odd/tiny shapes (tests, sub-128 toys): largest power-of-two tile that
+    # divides each dim, VMEM-guarded by K-splitting if possible.
+    def _tile(dim: int, cap: int) -> int:
+        t = 1
+        while t * 2 <= min(dim, cap) and dim % (t * 2) == 0:
+            t *= 2
+        return t
+    bm, bn = _tile(m_rows, 512), _tile(n_cols, 512)
+    while True:
+        for bk in (None, 4096, 2048, 1024, 512, 256, 128):
+            cfg = GemmConfig(bm, bn, bk)
+            if ((bk is None or K % bk == 0)
+                    and cfg.vmem_ok(K, itemsize, budget)):
+                return cfg
+        # No candidate block_k divides K (or fits): shrink the output tile
+        # and retry — halving a power-of-two divisor keeps divisibility,
+        # and the full-K strip eventually fits the budget.
+        if bm >= bn and bm > 1:
+            bm //= 2
+        elif bn > 1:
+            bn //= 2
+        else:
+            return GemmConfig(1, 1, None)
+
+
 def emit_gemm(a_ref, b_ref, out_ref, cfg: GemmConfig, out_dtype=None):
     """Run a pipelined GEMM ``out = a @ b`` over HBM refs, inside a kernel.
 

@@ -34,7 +34,8 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from triton_dist_tpu.ops.common import collective_id_for, norm_axis as _norm_axis
-from triton_dist_tpu.ops.gemm import GemmConfig, emit_gemm
+from triton_dist_tpu.ops.gemm import (GemmConfig, best_gemm_config,
+                                       emit_gemm)
 from triton_dist_tpu.shmem import device as shd
 from triton_dist_tpu.shmem.context import ShmemContext
 from triton_dist_tpu.utils import default_interpret
@@ -156,7 +157,7 @@ def _gemm_rs_2d(ctx, a, b, axes, cfg, out_dtype, ws=None, stage=None):
     buffers are persistent aliased operands (returned for re-threading)."""
     from triton_dist_tpu.ops.reduce_scatter import _rs_call
 
-    cfg = cfg or GemmConfig()
+    cfg = cfg or _default_cfg(ctx, a, b, axes)
     out_dtype = out_dtype or a.dtype
     acc_dtype = jnp.float32 if out_dtype == jnp.bfloat16 else out_dtype
     mesh_axes = ctx.axis_names
@@ -228,6 +229,15 @@ def _gemm_rs_2d(ctx, a, b, axes, cfg, out_dtype, ws=None, stage=None):
     sm = ctx.shard_map(f, in_specs=(P(None, axes), P(axes, None)),
                        out_specs=P(axes))
     return sm(a, b)
+
+
+def _default_cfg(ctx, a, b, axis) -> GemmConfig:
+    """Shape-keyed default tiles (measured-best table, docs/benchmarks.md):
+    the per-segment GEMM here is [M/n, K/n] x [K/n, N]."""
+    n = ctx.axis_size(axis)
+    M, K = a.shape
+    return best_gemm_config(max(M // n, 1), b.shape[1], max(K // n, 1),
+                            jnp.dtype(a.dtype).itemsize)
 
 
 def _validate(ctx, a, b, axis, cfg):
@@ -325,7 +335,7 @@ def gemm_rs(ctx: ShmemContext, a: jax.Array, b: jax.Array,
     axis = _norm_axis(ctx, axis)
     if isinstance(axis, tuple):
         return _gemm_rs_2d(ctx, a, b, axis, cfg, out_dtype)
-    cfg = cfg or GemmConfig()
+    cfg = cfg or _default_cfg(ctx, a, b, axis)
     out_dtype = out_dtype or a.dtype
     acc_dtype = jnp.float32 if out_dtype == jnp.bfloat16 else out_dtype
     mesh_axes = ctx.axis_names
@@ -356,7 +366,7 @@ def gemm_rs_ws(ctx: ShmemContext, a: jax.Array, b: jax.Array,
     if isinstance(axis, tuple):
         return _gemm_rs_2d(ctx, a, b, axis, cfg, out_dtype,
                            ws=ws, stage=stage)
-    cfg = cfg or GemmConfig()
+    cfg = cfg or _default_cfg(ctx, a, b, axis)
     out_dtype = out_dtype or a.dtype
     acc_dtype = jnp.float32 if out_dtype == jnp.bfloat16 else out_dtype
     mesh_axes = ctx.axis_names
@@ -414,18 +424,15 @@ class GemmRsContext:
 
     def __call__(self, a: jax.Array, b: jax.Array,
                  cfg: GemmConfig | None = None, out_dtype=None) -> jax.Array:
-        from jax._src import core as jcore
-        assert jcore.trace_state_clean(), (
-            "GemmRsContext must not be called under jit/vmap tracing; "
-            "use gemm_rs_ws and thread the workspace explicitly")
+        from triton_dist_tpu.ops.common import lru_step, require_eager
+        require_eager("GemmRsContext", "gemm_rs_ws")
         key = (a.shape, b.shape, str(a.dtype), cfg, out_dtype)
-        if key not in self._steps:
-            self._steps[key] = jax.jit(
-                lambda ws, stage, a, b: gemm_rs_ws(
-                    self.ctx, a, b, ws, stage, axis=self.axis, cfg=cfg,
-                    out_dtype=out_dtype),
-                donate_argnums=(0, 1))
-        c, self.ws, self.stage = self._steps[key](self.ws, self.stage, a, b)
+        step = lru_step(self._steps, key, lambda: jax.jit(
+            lambda ws, stage, a, b: gemm_rs_ws(
+                self.ctx, a, b, ws, stage, axis=self.axis, cfg=cfg,
+                out_dtype=out_dtype),
+            donate_argnums=(0, 1)))
+        c, self.ws, self.stage = step(self.ws, self.stage, a, b)
         return c
 
 
