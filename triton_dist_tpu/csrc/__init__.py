@@ -136,3 +136,15 @@ def a2a_bincount(dest: np.ndarray, n_dst: int) -> np.ndarray:
 
 __all__ = ["get_lib", "moe_align_block_size", "a2a_slot_assign",
            "a2a_bincount"]
+
+
+def native_or_none(fname: str, *args, **kw):
+    """Named once: the host-routing-table dispatch pattern. Calls the
+    native twin ``fname`` and returns its result, or None when the native
+    library is unavailable (TDT_NO_NATIVE=1 / no toolchain) so the caller
+    falls back to its jnp twin. Keeps the fallback policy in one place
+    (a future "warn when native is missing" change lands here only)."""
+    try:
+        return globals()[fname](*args, **kw)
+    except RuntimeError:
+        return None

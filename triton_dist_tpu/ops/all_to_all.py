@@ -156,10 +156,11 @@ def all_to_all_push(ctx: ShmemContext, *arrays: jax.Array,
         import math
         assert n_arrays >= 2, "quantized wire needs payload + scale arrays"
         _, cap, H = arrays[0].shape[-3:]
-        if cap % 128 == 0:
-            # in-kernel per-arrival dequant (sub-128 caps would need
-            # unaligned lane slices of the scale wire, which Mosaic
-            # rejects — those fall back to the post-kernel pass below)
+        if cap % 128 == 0 and H % 128 == 0:
+            # in-kernel per-arrival dequant (sub-128 caps or hidden dims
+            # would need unaligned lane slices — gcd(512, H) < 128 makes
+            # the (128, bn) BlockSpec lane-unaligned — which Mosaic
+            # rejects; those fall back to the post-kernel pass below)
             dequant = (jnp.dtype(dequant_to), cap, H, math.gcd(512, H))
 
     def f(*shards):
@@ -269,8 +270,21 @@ def route_tokens(a2a: EpAllToAllContext, topk_ids: jax.Array):
     allocation, ep_a2a.py:64-147). ``topk_ids`` is the *local* [T, topk]
     expert assignment. Returns (dest [T,k], slot [T,k], valid [T,k]) where
     ``slot`` is the token's position in the capacity-padded lane to rank
-    ``dest``. Pure jnp — runs under jit/shard_map per device."""
+    ``dest``. Pure jnp under jit/shard_map; a host routing table (numpy
+    ``topk_ids``) takes the native C++ path (``csrc.a2a_slot_assign`` —
+    the registered-host-op analog, csrc registry.cc:32-44) with no device
+    round-trip. The twins are cross-tested in test_tools.py."""
+    import numpy as np
     T, k = topk_ids.shape
+    if isinstance(topk_ids, np.ndarray) and not isinstance(
+            topk_ids, jax.Array):
+        from triton_dist_tpu import csrc
+        dest = topk_ids.astype(np.int32) // a2a.experts_per_rank
+        res = csrc.native_or_none("a2a_slot_assign", dest.reshape(-1),
+                                  a2a.n_ranks, a2a.capacity)
+        if res is not None:
+            slot, valid = res
+            return dest, slot.reshape(T, k), valid.reshape(T, k)
     dest = topk_ids // a2a.experts_per_rank                      # [T,k]
     slot, valid = _slot_assign(dest.reshape(-1), a2a.n_ranks, a2a.capacity)
     return dest, slot.reshape(T, k), valid.reshape(T, k)

@@ -42,7 +42,29 @@ def align_tokens_by_expert(ids: jax.Array, num_experts: int, block_m: int,
     ``used_block_count``) as a 4th element, computed from the counts this
     layout already materializes — callers that need both avoid a second
     one-hot pass over ``ids``.
+
+    Host routing tables (numpy ``ids``) take the native C++ path
+    (``csrc.moe_align_block_size`` — the analog of the reference's
+    registered host op, csrc moe_utils.cu:61-356 via registry.cc:32-44):
+    no device round-trip, no one-hot materialization. Traced/device ids
+    use the jnp twin below; the two are cross-tested in test_tools.py.
     """
+    import numpy as np
+    if isinstance(ids, np.ndarray) and not isinstance(ids, jax.Array):
+        from triton_dist_tpu import csrc
+        res = csrc.native_or_none("moe_align_block_size", ids, num_experts,
+                                  block_m)
+        if res is not None:
+            g, v, b = res
+            if not with_used_count:
+                return g, v, b
+            # out-of-range ids (>= E) are invalid rows in both twins'
+            # layouts — they must not count toward the block bound
+            in_range = ids[(ids >= 0) & (ids < num_experts)]
+            counts = np.bincount(in_range.astype(np.int64),
+                                 minlength=num_experts)
+            n_used = max(1, int(np.sum(-(-counts // block_m))))
+            return g, v, b, np.int32(n_used)
     T = ids.shape[0]
     E = num_experts
     bm = block_m
