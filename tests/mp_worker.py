@@ -35,14 +35,24 @@ def main() -> None:
     assert jax.process_count() == 2, jax.process_count()
     me = jax.process_index()
 
-    # pure-XLA collective across both processes' devices
-    sharding = NamedSharding(ctx.mesh, P("x"))
-    ones = jax.jit(lambda: jnp.ones((8, 128), jnp.float32),
-                   out_shardings=sharding)()
-    total = jax.jit(
-        ctx.shard_map(lambda s: jax.lax.psum(jnp.sum(s), "x"),
-                      in_specs=P("x"), out_specs=P()))(ones)
-    np.testing.assert_allclose(np.asarray(total), 8 * 128)
+    # pure-XLA collective across both processes' devices, traced into a
+    # merged per-host-track profile when the harness asks for one
+    from triton_dist_tpu.utils.perf import group_profile
+
+    prof_dir = os.environ.get("TDT_PROF_DIR")
+    with group_profile("mp", do_prof=prof_dir is not None,
+                       out_dir=prof_dir or "prof"):
+        sharding = NamedSharding(ctx.mesh, P("x"))
+        ones = jax.jit(lambda: jnp.ones((8, 128), jnp.float32),
+                       out_shardings=sharding)()
+        total = jax.jit(
+            ctx.shard_map(lambda s: jax.lax.psum(jnp.sum(s), "x"),
+                          in_specs=P("x"), out_specs=P()))(ones)
+        np.testing.assert_allclose(np.asarray(total), 8 * 128)
+    if prof_dir and me == 0:
+        merged = os.path.join(prof_dir, "mp", "merged.trace.json.gz")
+        assert os.path.exists(merged), f"missing merged trace {merged}"
+        print("MP_PROF_MERGED", flush=True)
 
     # autotuned op: both configs timed on every process, consensus = MAX
     calls = []

@@ -74,3 +74,45 @@ def test_two_process_cluster(via_launch_sh):
     picks = {m for out in outs
              for m in re.findall(r"picked=([0-9.]+)", out)}
     assert len(picks) == 1, f"processes picked different configs: {picks}"
+
+
+def test_two_process_merged_profile(tmp_path):
+    """Multi-host ``group_profile``: both processes trace, process 0 merges
+    one Perfetto-loadable timeline with per-host tracks (reference
+    utils.py:282-501 parity)."""
+    import gzip
+    import json
+
+    addr = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for pid in range(2):
+        env = _worker_env(pid, 2, addr, generic_env=False)
+        env["TDT_PROF_DIR"] = str(tmp_path)
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"profiled workers timed out; partial: {outs}")
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+    assert any("MP_PROF_MERGED" in o for o in outs), outs
+
+    merged = tmp_path / "mp" / "merged.trace.json.gz"
+    assert merged.exists()
+    with gzip.open(merged, "rt") as f:
+        data = json.load(f)
+    names = {ev["args"]["name"] for ev in data["traceEvents"]
+             if ev.get("ph") == "M" and ev.get("name") == "process_name"}
+    hosts = {n.split("/")[0] for n in names}
+    assert {"host0", "host1"} <= hosts, f"per-host tracks missing: {names}"
+    # both processes contributed real events, not just metadata
+    pids = {ev.get("pid", 0) for ev in data["traceEvents"]}
+    assert any(p >= 200000 for p in pids) and any(
+        100000 <= p < 200000 for p in pids), sorted(pids)[:10]

@@ -49,15 +49,83 @@ def perf_func(func, iters: int = 10, warmup_iters: int = 3, return_result: bool 
 
 
 @contextlib.contextmanager
-def group_profile(name: str = "trace", do_prof: bool = True, out_dir: str = "prof"):
+def group_profile(name: str = "trace", do_prof: bool = True,
+                  out_dir: str = "prof", merge: bool = True):
     """Profile the enclosed region into ``{out_dir}/{name}`` (TensorBoard /
-    Perfetto format). Multi-host merging is native to jax's profiler."""
+    Perfetto format).
+
+    Multi-process jobs (``jax.process_count() > 1`` over a shared
+    filesystem): each process traces into ``{path}/proc{i}`` (jax names
+    trace files by *hostname*, which collides for same-host processes),
+    then process 0 merges every process's chrome trace into ONE
+    Perfetto-loadable ``{path}/merged.trace.json.gz`` with per-host track
+    names — the analog of the reference's gather-and-merge
+    ``group_profile`` (reference python/triton_dist/utils.py:282-501,
+    which all-gathers per-rank chrome traces over the process group and
+    rewrites pids into per-rank tracks)."""
     if not do_prof:
         yield
         return
     path = f"{out_dir}/{name}"
-    jax.profiler.start_trace(path)
+    multi = jax.process_count() > 1
+    local = f"{path}/proc{jax.process_index()}" if multi else path
+    jax.profiler.start_trace(local)
     try:
         yield
     finally:
         jax.profiler.stop_trace()
+        if multi and merge:
+            from jax.experimental import multihost_utils
+            # every process must have flushed its trace before the merge
+            multihost_utils.sync_global_devices(f"group_profile:{name}")
+            if jax.process_index() == 0:
+                merge_process_traces(path)
+
+
+def merge_process_traces(path: str) -> str | None:
+    """Merge ``{path}/proc*/`` chrome traces into
+    ``{path}/merged.trace.json.gz``: one timeline, pids offset per process
+    and tracks labeled ``host{i}/...``. Returns the merged file path (None
+    when no per-process traces were found). Standalone so offline tooling
+    can merge traces gathered from real pod hosts by other means."""
+    import glob
+    import gzip
+    import json
+    import os
+
+    events = []
+    found = False
+    for proc_dir in sorted(glob.glob(f"{path}/proc*")):
+        # host index from the directory name, NOT enumeration order —
+        # lexicographic glob order misassigns labels at 10+ processes
+        # (proc10 sorts before proc2)
+        try:
+            i = int(os.path.basename(proc_dir)[len("proc"):])
+        except ValueError:
+            continue
+        traces = (glob.glob(f"{proc_dir}/**/*.trace.json.gz",
+                            recursive=True)
+                  + glob.glob(f"{proc_dir}/**/*.trace.json", recursive=True))
+        base = (i + 1) * 100000
+        for t in sorted(traces):
+            opener = gzip.open if t.endswith(".gz") else open
+            with opener(t, "rt") as f:
+                data = json.load(f)
+            found = True
+            for ev in data.get("traceEvents", []):
+                if "pid" in ev:
+                    ev = dict(ev)
+                    ev["pid"] = base + int(ev["pid"])
+                    if (ev.get("ph") == "M"
+                            and ev.get("name") == "process_name"):
+                        args = dict(ev.get("args", {}))
+                        args["name"] = f"host{i}/{args.get('name', '')}"
+                        ev["args"] = args
+                events.append(ev)
+    if not found:
+        return None
+    out = os.path.join(path, "merged.trace.json.gz")
+    with gzip.open(out, "wt") as f:
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ns"}, f)
+    return out
