@@ -313,6 +313,43 @@ def bench_decode(ctx, i1: int, i2: int, B: int = 1, Hq: int = 32,
     return res
 
 
+# --- EP-dispatch wire model (the DeepEP-comparison analog) -----------------
+#
+# The reference's headline 137 µs dispatch (README.md:55) is 32 H800 ranks,
+# fp8 wire, 128 tok/rank, topk 8, hidden 7168 — multi-rank hardware this
+# environment does not have. The honest substitute (VERDICT r3 #6/#7):
+# measure the n=1 kernel (routing + slot compute + local copy, no wire
+# benefit) and extrapolate with an explicit, checkable per-link model:
+#
+#   t(n) = t_kernel(n=1)                      measured
+#        + bytes_out * (n-1)/n / ICI_EGRESS   wire serialization
+#        + (n-1) * HOP_US                     per-peer put issue/latency
+#
+#   bytes_out = tok/rank * topk * (hidden * wire_bytes + 4)   (f32 scale
+#   channel rides per token-slot; worst case all-remote routing)
+#
+# v5e public figures: 4 ICI links/chip x ~45 GB/s one-way = ~180 GB/s
+# egress; sub-µs per-hop latency, rounded up to 1 µs per remote peer to
+# absorb semaphore-signal cost. Multi-chip measurements must replace the
+# model terms; until then vs_baseline for the a2a metric is
+# reference_137us / t_model(32) — i.e. >1 means the model predicts beating
+# the reference's published number on same-scale hardware.
+_ICI_EGRESS_GBS = 180.0
+_HOP_US = 1.0
+_REFERENCE_DISPATCH_US = 137.0   # 32x H800 (reference README.md:55)
+
+
+def a2a_dispatch_model_us(measured_n1_us: float, n: int,
+                          tokens_per_rank: int = 128, topk: int = 8,
+                          hidden: int = 7168, wire_bytes: int = 1) -> float:
+    """Model-extrapolated dispatch latency at ``n`` ranks from the measured
+    n=1 kernel time (see module comment above for the model and its
+    parameters)."""
+    bytes_out = tokens_per_rank * topk * (hidden * wire_bytes + 4)
+    wire_us = bytes_out * (n - 1) / n / (_ICI_EGRESS_GBS * 1e3)
+    return measured_n1_us + wire_us + (n - 1) * _HOP_US
+
+
 # The reference's perf-shape table (test_ag_gemm_intra_node.py:153-160):
 # AG-GEMM M/N/K per model family, M = 8192 token rows.
 MODEL_SHAPES = {
@@ -366,7 +403,7 @@ def sweep():
                               "error": f"{type(e).__name__}: {e}"[:150]}))
 
 
-def main():
+def main(a2a_primary: bool = False):
     import math
 
     from triton_dist_tpu.ops.gemm import GemmConfig
@@ -450,6 +487,22 @@ def main():
                            wire_dtype=jnp.float8_e4m3fn, **a2a_shape)
         extras["a2a_dispatch_fp8_us"] = round(d8 * 1e6, 1)
         extras["a2a_roundtrip_fp8_us"] = round(r8 * 1e6, 1)
+        if not on_cpu() and n_dev == 1:
+            # first-class DeepEP-comparison metric: model-extrapolated 8-
+            # and 32-rank dispatch from the measured n=1 fp8 kernel (see
+            # the wire-model comment above MODEL_SHAPES). n=1 only — a
+            # multi-chip measurement already contains real wire/hop cost,
+            # and adding the modeled terms would double-count them (real
+            # multi-chip numbers supersede the model entirely).
+            m8 = a2a_dispatch_model_us(d8 * 1e6, 8, **{
+                k: v for k, v in a2a_shape.items() if k != "num_experts"})
+            m32 = a2a_dispatch_model_us(d8 * 1e6, 32, **{
+                k: v for k, v in a2a_shape.items() if k != "num_experts"})
+            extras["a2a_model"] = {
+                "n8_us": round(m8, 1), "n32_us": round(m32, 1),
+                "vs_reference_137us": round(_REFERENCE_DISPATCH_US / m32, 3),
+                "ici_egress_gbs": _ICI_EGRESS_GBS, "hop_us": _HOP_US,
+            }
     except Exception as e:
         extras["a2a_fp8_error"] = f"{type(e).__name__}: {e}"[:200]
 
@@ -460,6 +513,33 @@ def main():
         "vs_baseline": round(tflops / baseline, 3),
         "extras": extras,
     }
+    if a2a_primary:
+        # `a2a` argv mode: the DeepEP-comparison line (BASELINE.md second
+        # target). value = measured fp8 dispatch at the current rank count;
+        # vs_baseline = reference 137 µs / model-extrapolated 32-rank time
+        # (>1 ⇒ the model predicts beating the published number at scale;
+        # at n>1 the model is absent by design — real numbers supersede it).
+        import sys
+        am = extras.get("a2a_model", {})
+        value = extras.get("a2a_dispatch_fp8_us")
+        a2a_extras = {**extras, "ag_gemm_tflops_per_chip": round(tflops, 2)}
+        if value is None:
+            # fail loudly: a null metric with rc 0 would be recorded as a
+            # vacuous success by any harness reading this line
+            a2a_extras["status"] = "unavailable"
+            a2a_extras.setdefault(
+                "error", extras.get("a2a_fp8_error",
+                                    "fp8 dispatch not measured"))
+        print(json.dumps({
+            "metric": "a2a_dispatch_us",
+            "value": value,
+            "unit": "us",
+            "vs_baseline": am.get("vs_reference_137us"),
+            "extras": a2a_extras,
+        }))
+        if value is None:
+            sys.exit(1)
+        return
     _record_healthy(result)
     print(json.dumps(result))
 
@@ -520,4 +600,4 @@ if __name__ == "__main__":
     if "--sweep" in sys.argv:
         sweep()
     else:
-        main()
+        main(a2a_primary="a2a" in sys.argv)

@@ -63,9 +63,12 @@ def _online_softmax_body(s, kv_len, q_ref, k_ref, v_ref, out_ref, lse_ref,
     def _():
         Hq, D = acc.shape
         G = Hq // n_kv_heads
-        q = q_ref[0].astype(jnp.float32).reshape(n_kv_heads, G, D)
-        k = k_ref[0].astype(jnp.float32)             # [Hkv, block_s, D]
-        v = v_ref[0].astype(jnp.float32)             # [Hkv, block_s, D]
+        # operands stay in the input dtype (f32 accumulate): upcasting
+        # bf16 first would run the MXU at its slower f32 rate (see the
+        # ring-attention pipeline note)
+        q = q_ref[0].reshape(n_kv_heads, G, D)
+        k = k_ref[0]                                 # [Hkv, block_s, D]
+        v = v_ref[0]                                 # [Hkv, block_s, D]
         scores = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * sm_scale  # [Hkv, G, bs]
@@ -78,7 +81,7 @@ def _online_softmax_body(s, kv_len, q_ref, k_ref, v_ref, out_ref, lse_ref,
         p = jnp.exp(scores - m_new)                  # [Hq, block_s]
         l_i[...] = l_i[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(
-            p.reshape(n_kv_heads, G, block_s), v,
+            p.reshape(n_kv_heads, G, block_s).astype(v.dtype), v,
             (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32).reshape(Hq, D)
         acc[...] = acc[...] * alpha + pv

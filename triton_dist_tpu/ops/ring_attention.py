@@ -109,9 +109,11 @@ def _attn_step_pipeline(step_init, causal, zigzag, sm_scale, D, bq, bk,
         kv_t = _tile_off(zigzag, c, kv_lo, kv_hi, kvi * bk)
 
         def compute():
-            qf = q_blk[0].astype(jnp.float32)
-            kf = k_blk[0].astype(jnp.float32)
-            s_ij = lax.dot_general(qf, kf, (((1,), (1,)), ((), ())),
+            # matmul operands stay in the INPUT dtype (f32 accumulate):
+            # upcasting bf16 q/k to f32 first would run the MXU at its
+            # ~4x-slower f32 rate — the round-2 42%-MFU bottleneck
+            s_ij = lax.dot_general(q_blk[0], k_blk[0],
+                                   (((1,), (1,)), ((), ())),
                                    preferred_element_type=jnp.float32)
             s_ij = s_ij * sm_scale
             if causal:
@@ -132,7 +134,7 @@ def _attn_step_pipeline(step_init, causal, zigzag, sm_scale, D, bq, bk,
             alpha = jnp.exp(m_p - m_c)
             l_c = l_p * alpha + jnp.sum(p, axis=-1, keepdims=True)
             acc_c = acc_p * alpha + lax.dot_general(
-                p, v_blk[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+                p.astype(v_blk.dtype), v_blk[0], (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
             out_blk[0, :, :D] = acc_c
@@ -438,7 +440,7 @@ def _bwd_dq_pipeline(step_init, causal, zigzag, scale, D, bq, bk, offs,
                 causal, scale, bq, bk, q_t, kv_t,
                 q_blk, do_blk, lse_blk, dl_blk, k_blk, v_blk)
             dq_o[0] += lax.dot_general(
-                dS, k_blk[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+                dS.astype(k_blk.dtype), k_blk[0], (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
 
         if causal:
@@ -505,10 +507,10 @@ def _bwd_dkv_pipeline(step_init, causal, zigzag, scale, D, bq, bk, offs,
                 causal, scale, bq, bk, q_t, kv_t,
                 q_blk, do_blk, lse_blk, dl_blk, k_blk, v_blk)
             g_o[0, :, :D] += lax.dot_general(
-                dS, q_blk[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+                dS.astype(q_blk.dtype), q_blk[0], (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
             g_o[0, :, D:] += lax.dot_general(
-                p, do_blk[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+                p.astype(do_blk.dtype), do_blk[0], (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
         if causal:
@@ -543,12 +545,10 @@ def _bwd_dkv_pipeline(step_init, causal, zigzag, scale, D, bq, bk, offs,
 def _recompute_p_ds(causal, scale, bq, bk, q_pos0, kv_pos0,
                     q_blk, do_blk, lse_blk, dl_blk, k_blk, v_blk):
     """Shared backward-tile math: recompute p from (q, k, lse), then
-    dS = p * (do @ v^T - delta). Returns (p, dS, keep-mask)."""
-    qf = q_blk[0].astype(jnp.float32)
-    kf = k_blk[0].astype(jnp.float32)
-    dof = do_blk[0].astype(jnp.float32)
-    vf = v_blk[0].astype(jnp.float32)
-    s_ij = lax.dot_general(qf, kf, (((1,), (1,)), ((), ())),
+    dS = p * (do @ v^T - delta). Returns (p, dS, keep-mask). Matmul
+    operands stay in the input dtype (f32 accumulate) — see the forward
+    pipeline's MXU-rate note."""
+    s_ij = lax.dot_general(q_blk[0], k_blk[0], (((1,), (1,)), ((), ())),
                            preferred_element_type=jnp.float32) * scale
     lse_row = lse_blk[0].T          # [bq, 1]
     delta_row = dl_blk[0].T         # [bq, 1]
@@ -559,7 +559,7 @@ def _recompute_p_ds(causal, scale, bq, bk, q_pos0, kv_pos0,
         kpos = kv_pos0 + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         keep = kpos <= qpos
         p = jnp.where(keep, p, 0.0)
-    dp = lax.dot_general(dof, vf, (((1,), (1,)), ((), ())),
+    dp = lax.dot_general(do_blk[0], v_blk[0], (((1,), (1,)), ((), ())),
                          preferred_element_type=jnp.float32)
     dS = p * (dp - delta_row)
     return p, dS, keep
