@@ -249,8 +249,37 @@ def bench_moe(ctx, i1: int, i2: int, tokens_rows: int = 1024,
     return out
 
 
+def attn_sweep():
+    """Ring-attention tile sweep at the bench shape (VERDICT r3 #7: the
+    42%-MFU sweep stopped at the VMEM cliff; re-sweep after the
+    dtype-preserving matmul change). One JSON line per tile config."""
+    from triton_dist_tpu.shmem.context import initialize_distributed
+    from triton_dist_tpu.utils import on_cpu
+    n_dev = len(jax.devices())
+    ctx = initialize_distributed(axis_names=("x",), mesh_shape=(n_dev,))
+    peak = chip_peak_tflops()
+    smoke = on_cpu()   # interpret mode: API smoke at a tiny shape only
+    tiles = ([(128, 128)] if smoke
+             else [(512, 512), (1024, 512), (512, 1024), (1024, 1024),
+                   (2048, 1024), (1024, 2048), (2048, 2048)])
+    shape = dict(s_loc=256, Hq=4, Hkv=2) if smoke else {}
+    for bq, bk in tiles:
+        try:
+            res = bench_attn(ctx, i1=1 if smoke else 10,
+                             i2=3 if smoke else 110,
+                             block_q=bq, block_k=bk, **shape)
+            t = res["attn_tflops_per_chip"]
+            print(json.dumps({"block_q": bq, "block_k": bk,
+                              "attn_tflops_per_chip": t,
+                              "mfu_pct": round(100 * t / peak, 1)}))
+        except Exception as e:
+            print(json.dumps({"block_q": bq, "block_k": bk,
+                              "error": f"{type(e).__name__}: {e}"[:120]}))
+
+
 def bench_attn(ctx, i1: int, i2: int, B: int = 1, Hq: int = 16,
-               Hkv: int = 4, D: int = 128, s_loc: int = 4096
+               Hkv: int = 4, D: int = 128, s_loc: int = 4096,
+               block_q: int = 1024, block_k: int = 1024
                ) -> dict[str, float]:
     """Causal ring-attention forward TFLOP/s per chip (at n=1: the blockwise
     flash kernel itself — MXU efficiency of the per-step inner loop)."""
@@ -268,7 +297,8 @@ def bench_attn(ctx, i1: int, i2: int, B: int = 1, Hq: int = 16,
     ks_, vs_ = ctx.shard(k, spec), ctx.shard(v, spec)
 
     def step(qq, _):
-        o = ring_attention(ctx, qq, ks_, vs_, axis=axis, causal=True)
+        o = ring_attention(ctx, qq, ks_, vs_, axis=axis, causal=True,
+                           block_q=block_q, block_k=block_k)
         return qq + (o * jnp.asarray(1e-20, o.dtype))
 
     s = _per_iter(make_chain_timer(step, ctx.shard(q, spec),
@@ -599,5 +629,7 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--sweep" in sys.argv:
         sweep()
+    elif "--attn-sweep" in sys.argv:
+        attn_sweep()
     else:
         main(a2a_primary="a2a" in sys.argv)

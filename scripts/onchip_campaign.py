@@ -1,0 +1,133 @@
+"""Round-4 on-chip campaign — ONE command for the moment the tunnel heals.
+
+The entire round-3/4 perf story is code-complete but unmeasured (the
+device tunnel has been wedged since round 2's killed dispatch_2d run).
+This script executes the full measurement campaign in order, each step a
+subprocess with its own timeout, logging everything under
+``docs/onchip_r4/`` so the results survive a mid-campaign wedge:
+
+    python scripts/onchip_campaign.py             # everything
+    python scripts/onchip_campaign.py bench sweep # specific steps
+
+Steps (in order; later steps run even if an earlier one fails, EXCEPT
+that everything stops if the preflight finds the tunnel wedged):
+
+    bisect      scripts/bisect_a2a_onchip.py — serial twins first,
+                client-side compile, narrows the dispatch_2d hang
+                (VERDICT r4 #2) without being able to wedge the device
+    bench       python bench.py — headline AG-GEMM + a2a/decode/attn/moe
+                extras incl. the fp8 wire model (VERDICT r4 #1/#6)
+    a2a         python bench.py a2a — the DeepEP-comparison line
+    sweep       python bench.py --sweep — six model shapes
+    attn_sweep  python bench.py --attn-sweep — ring-attention tiles after
+                the dtype-preserving matmul change (VERDICT r4 #7)
+
+After a full green run: paste the numbers into docs/benchmarks.md
+(replace every "awaiting re-measurement"), update the autotable in
+ops/gemm.py::_MEASURED_BEST if a sweep winner moved, and commit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_DIR = os.path.join(REPO, "docs", "onchip_r4")
+
+STEPS = [
+    # (name, argv, timeout_s)
+    ("bisect", [sys.executable, os.path.join(REPO, "scripts",
+                                             "bisect_a2a_onchip.py")], 7200),
+    ("bench", [sys.executable, os.path.join(REPO, "bench.py")], 3600),
+    ("a2a", [sys.executable, os.path.join(REPO, "bench.py"), "a2a"], 3600),
+    ("sweep", [sys.executable, os.path.join(REPO, "bench.py"),
+               "--sweep"], 5400),
+    ("attn_sweep", [sys.executable, os.path.join(REPO, "bench.py"),
+                    "--attn-sweep"], 5400),
+]
+
+
+def preflight(timeout_s: int = 240) -> bool:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            timeout=timeout_s, capture_output=True, text=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> int:
+    want = set(sys.argv[1:])
+    known = {name for name, _, _ in STEPS}
+    unknown = want - known
+    if unknown:
+        print(f"unknown step(s) {sorted(unknown)}; choose from "
+              f"{sorted(known)}", file=sys.stderr)
+        return 2
+    print("[campaign] preflight: backend reachability ...", flush=True)
+    if not preflight():
+        print("[campaign] BACKEND UNREACHABLE — tunnel still wedged; "
+              "re-run when it heals.", flush=True)
+        return 3
+    print("[campaign] preflight OK", flush=True)
+    os.makedirs(OUT_DIR, exist_ok=True)
+    summary = {}
+    for name, argv, timeout_s in STEPS:
+        if want and name not in want:
+            continue
+        log_path = os.path.join(OUT_DIR, f"{name}.log")
+        print(f"[campaign] {name} -> {log_path} ...", flush=True)
+        t0 = time.time()
+        try:
+            with open(log_path, "w") as log:
+                r = subprocess.run(argv, cwd=REPO, timeout=timeout_s,
+                                   stdout=log, stderr=subprocess.STDOUT)
+            rc = r.returncode
+        except subprocess.TimeoutExpired:
+            rc = "timeout"
+        dt = time.time() - t0
+        tail = ""
+        try:
+            with open(log_path) as f:
+                lines = [ln.rstrip() for ln in f if ln.strip()]
+            tail = lines[-1] if lines else ""
+        except OSError:
+            pass
+        summary[name] = {"rc": rc, "secs": round(dt, 1), "tail": tail[:400]}
+        print(f"[campaign] {name}: rc={rc} in {dt:.0f}s", flush=True)
+        # a bench/bisect failure is data, not a reason to skip the rest —
+        # but if the tunnel wedged mid-campaign, everything after would
+        # just burn its timeout in backend discovery
+        if rc != 0 and not preflight(120):
+            print("[campaign] tunnel wedged mid-campaign; stopping.",
+                  flush=True)
+            break
+    # merge into any prior summary so a subset rerun (e.g. after a
+    # mid-campaign wedge) doesn't clobber the earlier steps' record
+    summary_path = os.path.join(OUT_DIR, "summary.json")
+    merged = {}
+    try:
+        with open(summary_path) as f:
+            merged = json.load(f)
+    except (OSError, ValueError):
+        pass
+    merged.update(summary)
+    with open(summary_path, "w") as f:
+        json.dump(merged, f, indent=2)
+    print("\n=== campaign summary ===")
+    for k, v in summary.items():
+        print(f"{k:11s} rc={v['rc']} {v['secs']}s  {v['tail'][:120]}")
+    ok = summary and all(v["rc"] == 0 for v in summary.values())
+    print(f"\nartifacts: {OUT_DIR}/  " +
+          ("ALL GREEN — update docs/benchmarks.md and commit."
+           if ok else "some steps failed; see logs."))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,6 +2,8 @@
 (parity targets: reference python/triton_dist/autotuner.py,
 tools/compile_aot.py, csrc/moe_utils.cu)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -280,3 +282,21 @@ def test_host_routing_tables_take_native_path(monkeypatch):
     np.testing.assert_array_equal(d_n, np.asarray(d_j))
     np.testing.assert_array_equal(s_n, np.asarray(s_j))
     np.testing.assert_array_equal(ok_n, np.asarray(ok_j))
+
+
+def test_a2a_dispatch_wire_model():
+    """The DeepEP-comparison wire model (bench.py): explicit, checkable
+    terms — measured n=1 kernel + egress bytes over ICI + per-peer hops."""
+    import bench   # repo root is on sys.path via conftest
+
+    # n=1: no wire, no hops — the model returns the measurement itself
+    assert bench.a2a_dispatch_model_us(65.0, 1) == 65.0
+    # DeepSeek-infer shape at 32 ranks, fp8 wire: 128*8*(7168+4) bytes
+    # egress * 31/32 over 180e3 B/us + 31 hops + kernel
+    m32 = bench.a2a_dispatch_model_us(65.0, 32)
+    bytes_out = 128 * 8 * (7168 + 4)
+    expect = 65.0 + bytes_out * 31 / 32 / 180e3 + 31.0
+    assert abs(m32 - expect) < 1e-6
+    # monotone in n: more ranks, more hops (wire term saturates)
+    m8 = bench.a2a_dispatch_model_us(65.0, 8)
+    assert 65.0 < m8 < m32
