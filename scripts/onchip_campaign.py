@@ -52,9 +52,15 @@ STEPS = [
 
 
 def preflight(timeout_s: int = 240) -> bool:
+    """Reachable AND an accelerator: a CPU-fallback backend would run the
+    whole campaign in interpret smoke mode and stamp simulator numbers
+    'ALL GREEN' — that must read as unreachable here."""
     try:
         r = subprocess.run(
-            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print(len(d), d[0].platform); "
+             "raise SystemExit(1 if d[0].platform == 'cpu' else 0)"],
             timeout=timeout_s, capture_output=True, text=True)
         return r.returncode == 0
     except subprocess.TimeoutExpired:

@@ -2,8 +2,6 @@
 (parity targets: reference python/triton_dist/autotuner.py,
 tools/compile_aot.py, csrc/moe_utils.cu)."""
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np

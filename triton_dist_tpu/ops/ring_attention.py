@@ -67,6 +67,18 @@ def _tile_off(zigzag, c, lo, hi, start):
     return jnp.where(start < c, lo + start, hi + (start - c))
 
 
+def _causal_tile_dispatch(q_t, kv_t, bq, bk, compute):
+    """Route one causal tile to the cheapest body: skip fully-masked
+    tiles, run interior tiles mask-free, pay the iota+where mask only on
+    diagonal tiles (the kernel is VPU-bound, so interior tiles must not
+    generate mask work — docs/benchmarks.md roofline note)."""
+    has_work = kv_t <= q_t + (bq - 1)
+    interior = kv_t + (bk - 1) <= q_t
+    pl.when(jnp.logical_and(has_work, interior))(lambda: compute(False))
+    pl.when(jnp.logical_and(has_work, jnp.logical_not(interior)))(
+        lambda: compute(True))
+
+
 def _attn_step_pipeline(step_init, causal, zigzag, sm_scale, D, bq, bk,
                         offs, BH, Hq, Hkv, S,
                         q_ref, k_src, v_src, st_in, st_out):
@@ -108,7 +120,7 @@ def _attn_step_pipeline(step_init, causal, zigzag, sm_scale, D, bq, bk,
         q_t = _tile_off(zigzag, c, q_lo, q_hi, qi * bq)
         kv_t = _tile_off(zigzag, c, kv_lo, kv_hi, kvi * bk)
 
-        def compute():
+        def compute(masked: bool):
             # matmul operands stay in the INPUT dtype (f32 accumulate):
             # upcasting bf16 q/k to f32 first would run the MXU at its
             # ~4x-slower f32 rate — the round-2 42%-MFU bottleneck
@@ -116,7 +128,7 @@ def _attn_step_pipeline(step_init, causal, zigzag, sm_scale, D, bq, bk,
                                    (((1,), (1,)), ((), ())),
                                    preferred_element_type=jnp.float32)
             s_ij = s_ij * sm_scale
-            if causal:
+            if masked:
                 qpos = q_t + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
                 kpos = kv_t + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
                 keep = kpos <= qpos
@@ -128,7 +140,7 @@ def _attn_step_pipeline(step_init, causal, zigzag, sm_scale, D, bq, bk,
 
             m_c = jnp.maximum(jnp.max(s_ij, axis=-1, keepdims=True), m_p)
             p = jnp.exp(s_ij - m_c)
-            if causal:
+            if masked:
                 # exp(-1e30 - (-1e30)) == 1 on fully-masked rows; re-mask
                 p = jnp.where(keep, p, 0.0)
             alpha = jnp.exp(m_p - m_c)
@@ -142,11 +154,9 @@ def _attn_step_pipeline(step_init, causal, zigzag, sm_scale, D, bq, bk,
             out_blk[0, :, D + 128:] = jnp.broadcast_to(l_c, (bq, 128))
 
         if causal:
-            # a tile is fully masked iff its first kv position is beyond
-            # its last q position — skip its MXU work entirely
-            pl.when(kv_t <= q_t + (bq - 1))(compute)
+            _causal_tile_dispatch(q_t, kv_t, bq, bk, compute)
         else:
-            compute()
+            compute(False)
 
     in_specs = [
         pl.BlockSpec((1, bq, D), lambda bh, qi, kvi: (bh, qi, 0)),
@@ -435,18 +445,18 @@ def _bwd_dq_pipeline(step_init, causal, zigzag, scale, D, bq, bk, offs,
         q_t = _tile_off(zigzag, c, q_lo, q_hi, qi * bq)
         kv_t = _tile_off(zigzag, c, kv_lo, kv_hi, kvi * bk)
 
-        def compute():
+        def compute(masked: bool):
             p, dS, keep = _recompute_p_ds(
-                causal, scale, bq, bk, q_t, kv_t,
+                masked, scale, bq, bk, q_t, kv_t,
                 q_blk, do_blk, lse_blk, dl_blk, k_blk, v_blk)
             dq_o[0] += lax.dot_general(
                 dS.astype(k_blk.dtype), k_blk[0], (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
 
         if causal:
-            pl.when(kv_t <= q_t + (bq - 1))(compute)
+            _causal_tile_dispatch(q_t, kv_t, bq, bk, compute)
         else:
-            compute()
+            compute(False)
 
     in_specs = [
         pl.BlockSpec((1, bq, D), lambda bh, qi, kvi: (bh, qi, 0)),
@@ -502,9 +512,9 @@ def _bwd_dkv_pipeline(step_init, causal, zigzag, scale, D, bq, bk, offs,
         q_t = _tile_off(zigzag, c, q_lo, q_hi, qi * bq)
         kv_t = _tile_off(zigzag, c, kv_lo, kv_hi, kvi * bk)
 
-        def compute():
+        def compute(masked: bool):
             p, dS, keep = _recompute_p_ds(
-                causal, scale, bq, bk, q_t, kv_t,
+                masked, scale, bq, bk, q_t, kv_t,
                 q_blk, do_blk, lse_blk, dl_blk, k_blk, v_blk)
             g_o[0, :, :D] += lax.dot_general(
                 dS.astype(q_blk.dtype), q_blk[0], (((0,), (0,)), ((), ())),
@@ -514,9 +524,9 @@ def _bwd_dkv_pipeline(step_init, causal, zigzag, scale, D, bq, bk, offs,
                 preferred_element_type=jnp.float32)
 
         if causal:
-            pl.when(kv_t <= q_t + (bq - 1))(compute)
+            _causal_tile_dispatch(q_t, kv_t, bq, bk, compute)
         else:
-            compute()
+            compute(False)
 
     in_specs = [
         pl.BlockSpec((1, bq, D),
@@ -542,19 +552,21 @@ def _bwd_dkv_pipeline(step_init, causal, zigzag, scale, D, bq, bk, offs,
     )(*args, g_out)
 
 
-def _recompute_p_ds(causal, scale, bq, bk, q_pos0, kv_pos0,
+def _recompute_p_ds(masked, scale, bq, bk, q_pos0, kv_pos0,
                     q_blk, do_blk, lse_blk, dl_blk, k_blk, v_blk):
     """Shared backward-tile math: recompute p from (q, k, lse), then
     dS = p * (do @ v^T - delta). Returns (p, dS, keep-mask). Matmul
     operands stay in the input dtype (f32 accumulate) — see the forward
-    pipeline's MXU-rate note."""
+    pipeline's MXU-rate note. ``masked`` is python-static: True only for
+    diagonal causal tiles (``_causal_tile_dispatch``); interior tiles run
+    the mask-free body."""
     s_ij = lax.dot_general(q_blk[0], k_blk[0], (((1,), (1,)), ((), ())),
                            preferred_element_type=jnp.float32) * scale
     lse_row = lse_blk[0].T          # [bq, 1]
     delta_row = dl_blk[0].T         # [bq, 1]
     p = jnp.exp(s_ij - lse_row)
     keep = None
-    if causal:
+    if masked:
         qpos = q_pos0 + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         kpos = kv_pos0 + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         keep = kpos <= qpos
