@@ -259,9 +259,14 @@ def attn_sweep():
     ctx = initialize_distributed(axis_names=("x",), mesh_shape=(n_dev,))
     peak = chip_peak_tflops()
     smoke = on_cpu()   # interpret mode: API smoke at a tiny shape only
-    tiles = ([(128, 128)] if smoke
-             else [(512, 512), (1024, 512), (512, 1024), (1024, 1024),
-                   (2048, 1024), (1024, 2048), (2048, 2048)])
+    if smoke:
+        tiles = [(128, 128)]
+    else:
+        # the autotuner's candidate list, plus over-budget probes so the
+        # sweep validates the VMEM-prune boundary empirically (expected
+        # to fail compile; a probe that RUNS means the prune is too tight)
+        from triton_dist_tpu.ops.autotuned import _ATTN_CANDIDATES
+        tiles = list(_ATTN_CANDIDATES) + [(2048, 1024), (1024, 2048)]
     shape = dict(s_loc=256, Hq=4, Hkv=2) if smoke else {}
     for bq, bk in tiles:
         try:

@@ -123,18 +123,27 @@ def moe_reduce_rs_autotuned(ctx: ShmemContext, tokens, ids, topk_weights,
 
 
 # ring attention: tune the (block_q, block_k) tile pair — measured range
-# on v5e at S=4096: 52.9 (512^2) -> 83.1 (1024^2) TFLOP/s; 2048-wide tiles
-# exceed the scoped-VMEM budget at D=128 (docs/benchmarks.md)
+# on v5e at S=4096: 52.9 (512^2) -> 83.1 (1024^2) TFLOP/s with the old
+# f32-operand kernel. 2048-tall/square tiles can NEVER fit: the f32
+# score+p intermediates alone are >= 16 MB at D=128. What bf16 operands
+# DO enable is the wide-bk asymmetric tile (512, 2048) — its q/k/v
+# pipeline blocks halve, bringing it under budget (the prune below is
+# dtype-aware so it stays excluded for f32 inputs). `bench.py
+# --attn-sweep` sweeps this list plus over-budget probes of the cliff.
 _ATTN_CANDIDATES = [(512, 512), (512, 1024), (1024, 512), (1024, 1024),
-                    (256, 512), (256, 256)]
+                    (512, 2048), (256, 512), (256, 256)]
 
 
 def _prune_attn(bqbk, args, kw) -> bool:
     q = args[1]
     D = q.shape[-1]
     bq, bk = bqbk
-    # score tile + q/k/v/state VMEM blocks, double-buffered f32
-    vmem = 4 * (bq * bk + (bq + 2 * bk) * D + bq * (D + 256)) * 2
+    itemsize = jnp.dtype(q.dtype).itemsize
+    # q + k + v pipeline blocks (input dtype, double-buffered) + packed
+    # [acc||m||l] f32 state (double-buffered) + f32 s_ij/p intermediates
+    vmem = (2 * itemsize * (bq + 2 * bk) * D
+            + 2 * 4 * bq * (D + 256)
+            + 2 * 4 * bq * bk)
     return vmem <= 14 * 2**20
 
 
