@@ -129,12 +129,17 @@ STAGES = [
 
 
 def preflight(timeout_s: int = 180) -> bool:
-    """Backend reachability, probed in a subprocess: a wedged tunnel hangs
-    jax.devices() in ANY process with the device plugin registered, and
-    that hang must not be misread as a kernel-stage failure."""
+    """Backend reachable AND an accelerator, probed in a subprocess: a
+    wedged tunnel hangs jax.devices() in ANY process with the device
+    plugin registered (a hang must not be misread as a kernel-stage
+    failure), and a CPU-fallback backend would run every stage in
+    interpreter mode — interpreter results must never read as on-chip
+    bisection evidence."""
     try:
         r = subprocess.run(
-            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); print(len(d), d[0].platform); "
+             "raise SystemExit(1 if d[0].platform == 'cpu' else 0)"],
             timeout=timeout_s, capture_output=True, text=True)
         return r.returncode == 0
     except subprocess.TimeoutExpired:

@@ -12,15 +12,23 @@ subprocess with its own timeout, logging everything under
 Steps (in order; later steps run even if an earlier one fails, EXCEPT
 that everything stops if the preflight finds the tunnel wedged):
 
-    bisect      scripts/bisect_a2a_onchip.py — serial twins first,
-                client-side compile, narrows the dispatch_2d hang
-                (VERDICT r4 #2) without being able to wedge the device
     bench       python bench.py — headline AG-GEMM + a2a/decode/attn/moe
                 extras incl. the fp8 wire model (VERDICT r4 #1/#6)
     a2a         python bench.py a2a — the DeepEP-comparison line
     sweep       python bench.py --sweep — six model shapes
     attn_sweep  python bench.py --attn-sweep — ring-attention tiles after
                 the dtype-preserving matmul change (VERDICT r4 #7)
+    bisect      scripts/bisect_a2a_onchip.py — serial twins first,
+                client-side compile, narrows the dispatch_2d hang
+                (VERDICT r4 #2)
+
+ORDER MATTERS: the bench/sweep steps exercise only the 1-axis kernels
+that already ran clean on-chip in round 2 — they are banked FIRST. The
+bisect's 2-tier dispatch graphs are the ones whose round-2 execution
+wedged the device for >30 h; running them last means a re-wedge costs
+the remaining bisect stages, not the scoreboard numbers. (The bisect
+itself uses client-side compile + per-stage subprocess timeouts, so a
+compile hang stays local — but execution-side wedges remain possible.)
 
 After a full green run: paste the numbers into docs/benchmarks.md
 (replace every "awaiting re-measurement"), update the autotable in
@@ -39,15 +47,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT_DIR = os.path.join(REPO, "docs", "onchip_r4")
 
 STEPS = [
-    # (name, argv, timeout_s)
-    ("bisect", [sys.executable, os.path.join(REPO, "scripts",
-                                             "bisect_a2a_onchip.py")], 7200),
+    # (name, argv, timeout_s) — safe 1-axis measurements first, the
+    # wedge-risky 2-tier bisect LAST (see module docstring)
     ("bench", [sys.executable, os.path.join(REPO, "bench.py")], 3600),
     ("a2a", [sys.executable, os.path.join(REPO, "bench.py"), "a2a"], 3600),
     ("sweep", [sys.executable, os.path.join(REPO, "bench.py"),
                "--sweep"], 5400),
     ("attn_sweep", [sys.executable, os.path.join(REPO, "bench.py"),
                     "--attn-sweep"], 5400),
+    ("bisect", [sys.executable, os.path.join(REPO, "scripts",
+                                             "bisect_a2a_onchip.py")], 7200),
 ]
 
 
