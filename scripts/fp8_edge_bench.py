@@ -1,0 +1,102 @@
+"""On-chip microbench for the fp8-wire edge wirings (VERDICT r4 #6).
+
+Round-2 measured 128.8 µs fp8 dispatch (quant-pre-gather + post-kernel
+dequant); round-3 replaced both edges untested (fused f32 gather+quant +
+in-kernel dequant) and the round-4 campaign measured it at 201.8 µs — a
+regression. This sweeps all four (quant_edge, dequant_edge) wirings of the
+1-tier dispatch at the DeepSeek-infer shape so docs/benchmarks.md records a
+measured table and the context default is the winner, not a guess.
+
+    python scripts/fp8_edge_bench.py              # full sweep
+    python scripts/fp8_edge_bench.py --quick      # fewer chain iters
+
+Prints one JSON line per wiring plus the bf16 reference point.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+from bench import _per_iter, make_chain_timer  # noqa: E402
+from triton_dist_tpu.ops.all_to_all import (  # noqa: E402
+    create_all_to_all_context, dispatch)
+from triton_dist_tpu.shmem.context import initialize_distributed  # noqa: E402
+from triton_dist_tpu.utils import on_cpu  # noqa: E402
+
+
+def bench_wiring(ctx, quant_edge, dequant_edge, i1, i2, shape):
+    a2a = create_all_to_all_context(
+        ctx, axis=ctx.axis_names[0], wire_dtype=jnp.float8_e4m3fn,
+        quant_edge=quant_edge, dequant_edge=dequant_edge, **shape)
+    n = a2a.n_ranks
+    T = n * shape["max_tokens"]
+    H = shape["hidden"]
+    tokens = ctx.shard(jax.random.normal(jax.random.key(0), (T, H),
+                                         jnp.float32).astype(jnp.bfloat16),
+                       P("x"))
+    ids = ctx.shard(jax.random.randint(jax.random.key(1),
+                                       (T, shape["topk"]), 0,
+                                       shape["num_experts"]), P("x"))
+
+    def step(t, i):
+        recv, _, _ = dispatch(a2a, t, i)
+        eps = (jnp.sum(recv.astype(jnp.float32)) * 1e-20).astype(t.dtype)
+        return t + eps
+
+    return _per_iter(make_chain_timer(step, tokens, ids), i1, i2)
+
+
+def main() -> int:
+    quick = "--quick" in sys.argv
+    n_dev = len(jax.devices())
+    ctx = initialize_distributed(axis_names=("x",), mesh_shape=(n_dev,))
+    if on_cpu():
+        shape = dict(max_tokens=16, hidden=256, topk=2, num_experts=8)
+        i1, i2 = 1, 3
+    else:
+        shape = dict(max_tokens=128, hidden=7168, topk=8, num_experts=64)
+        i1, i2 = (10, 410) if quick else (10, 1610)
+
+    # bf16 reference point (no wire)
+    bf = create_all_to_all_context(ctx, axis=ctx.axis_names[0], **shape)
+    T, H = ctx.axis_size("x") * shape["max_tokens"], shape["hidden"]
+    tokens = ctx.shard(jax.random.normal(jax.random.key(0), (T, H),
+                                         jnp.float32).astype(jnp.bfloat16),
+                       P("x"))
+    ids = ctx.shard(jax.random.randint(jax.random.key(1),
+                                       (T, shape["topk"]), 0,
+                                       shape["num_experts"]), P("x"))
+
+    def bf_step(t, i):
+        recv, _, _ = dispatch(bf, t, i)
+        eps = (jnp.sum(recv.astype(jnp.float32)) * 1e-20).astype(t.dtype)
+        return t + eps
+
+    s = _per_iter(make_chain_timer(bf_step, tokens, ids), i1, i2)
+    print(json.dumps({"wiring": "bf16_reference",
+                      "dispatch_us": round(s * 1e6, 1)}), flush=True)
+
+    for qe in ("pre", "fused"):
+        for de in ("post", "kernel"):
+            try:
+                s = bench_wiring(ctx, qe, de, i1, i2, shape)
+                print(json.dumps({"wiring": f"{qe}+{de}",
+                                  "dispatch_us": round(s * 1e6, 1)}),
+                      flush=True)
+            except Exception as e:
+                print(json.dumps({"wiring": f"{qe}+{de}",
+                                  "error": f"{type(e).__name__}: {e}"[:160]}),
+                      flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -218,3 +218,40 @@ def test_dispatch_combine_capacity_drop_semantics(ctx):
     surv_w = valid.reshape(T, topk).sum(axis=1) * 0.5
     np.testing.assert_allclose(out, toks * surv_w[:, None], rtol=1e-5,
                                atol=1e-5)
+
+
+@pytest.mark.parametrize("quant_edge", ["pre", "fused"])
+@pytest.mark.parametrize("dequant_edge", ["kernel", "post"])
+def test_quantized_wire_edge_strategies(ctx, quant_edge, dequant_edge):
+    """Every (quant_edge, dequant_edge) wiring of the fp8 wire produces the
+    same roundtrip result: "pre" quantizes source rows then gathers, "fused"
+    quantizes per gathered slot — identical scales bit-for-bit (same
+    reduction over the same row); dequant in-kernel vs post-pass is pure
+    placement. The measured-best wiring (docs/benchmarks.md fp8-edge table)
+    is the default; the others must stay correct to remain selectable."""
+    n = ctx.num_ranks
+    T, H, topk = n * 8, 256, 2
+    mk = lambda qe, de: create_all_to_all_context(
+        ctx, max_tokens=T // n, hidden=H, topk=topk, num_experts=2 * n,
+        axis="x", capacity=128, dtype=jnp.bfloat16,
+        wire_dtype=jnp.float8_e4m3fn, quant_edge=qe, dequant_edge=de)
+    a2a = mk(quant_edge, dequant_edge)
+    ref = mk("pre", "post")
+
+    tokens = jax.random.normal(jax.random.key(9), (T, H), jnp.float32
+                               ).astype(jnp.bfloat16)
+    ids = jax.random.randint(jax.random.key(10), (T, topk), 0, 2 * n)
+    w = jnp.ones((T, topk), jnp.float32) / topk
+
+    def roundtrip(c, t, i, ww):
+        recv, _, layout = dispatch(c, t, i)
+        return combine(c, recv, layout, ww)
+
+    args = (ctx.shard(tokens, P("x")), ctx.shard(ids, P("x")),
+            ctx.shard(w, P("x")))
+    out = jax.jit(lambda *a: roundtrip(a2a, *a))(*args)
+    gold = jax.jit(lambda *a: roundtrip(ref, *a))(*args)
+    assert_allclose(np.asarray(out, np.float32),
+                    np.asarray(gold, np.float32), atol=1e-6, rtol=1e-6)
+    assert_allclose(np.asarray(out, np.float32),
+                    np.asarray(tokens, np.float32), rtol=0.15, atol=0.15)

@@ -416,3 +416,31 @@ def test_dispatch_combine_2d_fp8_aligned_cap(ctx2d):
     err = np.abs(np.asarray(out) - np.asarray(tokens))
     scale = np.abs(np.asarray(tokens)).max(axis=-1, keepdims=True)
     assert np.max(err / (scale + 1e-6)) < 0.03, np.max(err / (scale + 1e-6))
+
+
+def test_dispatch_2d_quant_edge_parity(ctx2d):
+    """"pre" (quantize source rows, gather wire-dtype) and "fused" (gather
+    then quantize per slot) build bit-identical tier-1 wire buffers — the
+    per-slot amax is the same reduction over the same row — so the 2-tier
+    roundtrip must agree exactly between the two, and both must reproduce
+    the tokens through identity experts up to quantization error."""
+    n, T, H, topk, E = 6, 8, 128, 2, 12
+    mk = lambda qe: create_all_to_all_context_2d(
+        ctx2d, max_tokens=T, hidden=H, topk=topk, num_experts=E,
+        dtype=jnp.float32, wire_dtype=jnp.int8, quant_edge=qe,
+        dequant_edge="post")
+    tokens = jax.random.normal(jax.random.key(7), (n * T, H), jnp.float32)
+    ids = jax.random.randint(jax.random.key(8), (n * T, topk), 0, E)
+    w = jnp.full((n * T, topk), 1.0 / topk)
+    spec = P(("a", "b"))
+    ts, is_, ws = (ctx2d.shard(t, spec) for t in (tokens, ids, w))
+
+    outs = {}
+    for qe in ("pre", "fused"):
+        a2a = mk(qe)
+        recv_tok, _, layouts = dispatch_2d(a2a, ts, is_)
+        outs[qe] = np.asarray(combine_2d(a2a, recv_tok, layouts, ws))
+    np.testing.assert_array_equal(outs["fused"], outs["pre"])
+    err = np.abs(outs["pre"] - np.asarray(tokens))
+    scale = np.abs(np.asarray(tokens)).max(axis=-1, keepdims=True)
+    assert np.max(err / (scale + 1e-6)) < 0.03, np.max(err / (scale + 1e-6))

@@ -127,7 +127,8 @@ def _a2a_kernel(axis, mesh_axes, n_arrays, dequant, refs):
 def all_to_all_push(ctx: ShmemContext, *arrays: jax.Array,
                     axis: str | None = None,
                     spec: P | None = None,
-                    dequant_to=None) -> tuple[jax.Array, ...]:
+                    dequant_to=None,
+                    fuse_dequant: bool = True) -> tuple[jax.Array, ...]:
     """Generic low-latency All-to-All: each input is locally ``[n, ...]``
     where slot p is the payload destined for peer p along ``axis``. Returns
     same-shaped arrays where local slot p holds the payload *received from*
@@ -144,7 +145,10 @@ def all_to_all_push(ctx: ShmemContext, *arrays: jax.Array,
     kernel (quantized-wire convention: ``arrays[0]`` is the [n, cap, H]
     payload, ``arrays[-1]`` its per-slot f32 scale wire). The first returned
     array is then [n, cap, H] in ``<dtype>`` — each peer's slot dequantized
-    as it arrived, overlapping the waits for later peers."""
+    as it arrived, overlapping the waits for later peers.
+    ``fuse_dequant=False`` keeps the dequant as one post-kernel XLA pass
+    instead (cheaper at n=1 where there are no later-peer waits to hide the
+    in-kernel pipeline behind; see docs/benchmarks.md fp8-edge table)."""
     axis = axis or ctx.axis_names[0]
     n = ctx.axis_size(axis)
     mesh_axes = ctx.axis_names
@@ -156,7 +160,7 @@ def all_to_all_push(ctx: ShmemContext, *arrays: jax.Array,
         import math
         assert n_arrays >= 2, "quantized wire needs payload + scale arrays"
         _, cap, H = arrays[0].shape[-3:]
-        if cap % 128 == 0 and H % 128 == 0:
+        if fuse_dequant and cap % 128 == 0 and H % 128 == 0:
             # in-kernel per-arrival dequant (sub-128 caps or hidden dims
             # would need unaligned lane slices — gcd(512, H) < 128 makes
             # the (128, bn) BlockSpec lane-unaligned — which Mosaic
@@ -223,7 +227,18 @@ class EpAllToAllContext:
     quantized rows plus an f32 scale side-channel payload, halving (vs bf16)
     the wire bytes — the reference's fp8+scales showcase protocol
     (low_latency_all_to_all.py:60-88, README.md:55). Dequantization happens
-    at the receiving edge; expert compute stays in ``dtype``."""
+    at the receiving edge; expert compute stays in ``dtype``.
+
+    The two wire-edge strategies (measured on-chip, docs/benchmarks.md):
+    - ``quant_edge``: "pre" quantizes the T source rows once and gathers
+      quantized rows + scales through the slot map (wire-dtype HBM traffic
+      only); "fused" gathers rows and quantizes per slot in one logical
+      pass — which XLA materializes as an f32 [n*cap, H] intermediate,
+      topk× the rows, measured 1.9× slower at the DeepSeek-infer shape.
+    - ``dequant_edge``: "post" = one XLA pass after the collective;
+      "kernel" = per-arrival in-kernel dequant overlapping later peers'
+      waits (only meaningful at n>1 — at n=1 there is nothing to overlap
+      and the in-kernel pipeline is pure serial cost)."""
     ctx: ShmemContext
     axis: str
     max_tokens: int      # tokens per rank entering dispatch
@@ -233,6 +248,15 @@ class EpAllToAllContext:
     capacity: int        # slots per (src,dst) rank pair
     dtype: jnp.dtype = jnp.bfloat16
     wire_dtype: jnp.dtype | None = None
+    quant_edge: str = "pre"       # "pre" | "fused"
+    dequant_edge: str = "auto"    # "auto" | "kernel" | "post"
+
+    def _dequant_in_kernel(self) -> bool:
+        if self.dequant_edge == "auto":
+            # n=1 has no later-peer waits for the in-kernel pipeline to
+            # hide behind; the post-pass is the measured win there
+            return self.n_ranks > 1
+        return self.dequant_edge == "kernel"
 
     @property
     def n_ranks(self) -> int:
@@ -248,10 +272,15 @@ def create_all_to_all_context(ctx: ShmemContext, max_tokens: int, hidden: int,
                               capacity: int | None = None,
                               axis: str | None = None,
                               dtype=jnp.bfloat16,
-                              wire_dtype=None) -> EpAllToAllContext:
+                              wire_dtype=None,
+                              quant_edge: str = "pre",
+                              dequant_edge: str = "auto"
+                              ) -> EpAllToAllContext:
     axis = axis or ctx.axis_names[0]
     n = ctx.axis_size(axis)
     assert num_experts % n == 0, (num_experts, n)
+    assert quant_edge in ("pre", "fused"), quant_edge
+    assert dequant_edge in ("auto", "kernel", "post"), dequant_edge
     if capacity is None:
         capacity = max_tokens * topk  # worst case: everything to one rank
     wire_itemsize = jnp.dtype(wire_dtype or dtype).itemsize
@@ -262,7 +291,9 @@ def create_all_to_all_context(ctx: ShmemContext, max_tokens: int, hidden: int,
                              num_experts=num_experts, capacity=capacity,
                              dtype=jnp.dtype(dtype),
                              wire_dtype=(jnp.dtype(wire_dtype)
-                                         if wire_dtype is not None else None))
+                                         if wire_dtype is not None else None),
+                             quant_edge=quant_edge,
+                             dequant_edge=dequant_edge)
 
 
 def route_tokens(a2a: EpAllToAllContext, topk_ids: jax.Array):
@@ -321,10 +352,16 @@ def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
         src = _slot_src_map(d_f, s_drop,
                             jnp.arange(T * k, dtype=jnp.int32) // k,
                             n, cap, T)
-        if wire is not None:
-            # fused gather+quant: one pass builds the wire buffer + scales
+        if wire is not None and a2a.quant_edge == "pre":
+            # measured best: quantize the T source rows once, gather
+            # wire-dtype rows + scales (see _slot_gather_prequant)
+            send_buf, send_sc = _slot_gather_prequant(tok_shard, src, wire,
+                                                      n, id_cols, cap)
+        elif wire is not None:
+            # fused gather+quant: one logical pass builds wire buf + scales
             send_buf, sc = _slot_gather_quant(tok_shard, src, wire)
-            send_sc = jnp.ones((n, id_cols), jnp.float32).at[:, :cap].set(sc)
+            send_sc = jnp.ones((n, id_cols), jnp.float32).at[:, :cap].set(
+                sc).reshape(n, -1, 128)
         else:
             send_buf = _slot_gather(tok_shard, src, a2a.dtype)
         send_ids = jnp.full((n, id_cols), -1, jnp.int32).at[
@@ -333,7 +370,7 @@ def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
         # lane-aligned on real TPUs
         outs = (send_buf, send_ids.reshape(n, id_cols // 128, 128))
         if wire is not None:
-            outs += (send_sc.reshape(n, -1, 128),)
+            outs += (send_sc,)
         return outs + (dest, slot, valid)
 
     n_wire = 3 if wire is not None else 2
@@ -344,11 +381,12 @@ def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
     else:
         send_buf, send_ids, dest, slot, valid = sm(tokens, topk_ids)
     if wire is not None:
-        # dequant fused into the collective: each peer's slot converts on
-        # arrival, overlapping later peers' waits
+        # dequant at the receive edge: in-kernel per-arrival (overlapping
+        # later peers' waits) or one post-kernel pass, per the context's
+        # dequant_edge policy
         recv_tokens, recv_ids_wire, _ = all_to_all_push(
             ctx, send_buf, send_ids, send_sc, axis=axis,
-            dequant_to=a2a.dtype)
+            dequant_to=a2a.dtype, fuse_dequant=a2a._dequant_in_kernel())
     else:
         recv_tokens, recv_ids_wire = all_to_all_push(ctx, send_buf, send_ids,
                                                      axis=axis)
@@ -383,7 +421,8 @@ def combine(a2a: EpAllToAllContext, processed: jax.Array, layout,
         pq, psc = ctx.shard_map(qpack, in_specs=P(axis),
                                 out_specs=(P(axis), P(axis)))(processed)
         back, _ = all_to_all_push(ctx, pq, psc, axis=axis,
-                                  dequant_to=a2a.dtype)
+                                  dequant_to=a2a.dtype,
+                                  fuse_dequant=a2a._dequant_in_kernel())
     else:
         (back,) = all_to_all_push(ctx, processed, axis=axis)
 
@@ -458,17 +497,20 @@ def _quant(x: jax.Array, wire_dtype) -> tuple[jax.Array, jax.Array]:
 
 def _slot_gather_quant(rows, src, wire_dtype):
     """Fused ``_slot_gather`` + ``_quant``: build the [n_dst, cap, H]
-    quantized send buffer AND its per-slot f32 scales in ONE pass over the
-    gathered rows. The unfused form (quantize [T, H], gather q, gather
-    scales) costs two extra full memory passes that measured ~2× the bf16
-    dispatch at n=1 — pure edge overhead that would ride the multi-chip
-    critical path too. Reference parity: scales ride the same kernel as the
-    payload, no extra passes (low_latency_all_to_all.py:60-88).
+    quantized send buffer AND its per-slot f32 scales in ONE logical pass
+    over the gathered rows. On-chip this is NOT the fast path: XLA
+    materializes the gathered rows as an f32 [n_dst*cap, H] intermediate —
+    topk× the source rows at 4 B/elem — and the round-4 measurement put it
+    1.9× behind the ``quant_edge="pre"`` wiring (quantize the T source rows
+    once, gather wire-dtype rows + scales) at the DeepSeek-infer shape.
+    Kept selectable via ``quant_edge="fused"``: at small topk or tiny T the
+    single-pass form can still win, and it is the bit-parity twin the tests
+    pin the "pre" path against.
 
     A token routed to k slots has its amax recomputed per slot — identical
-    scale each time (bit-for-bit: same reduction over the same row), trading
-    a little VPU redundancy for whole HBM passes. Unfilled slots quantize to
-    zeros with scale 1 (``_quant``'s zero-row rule)."""
+    scale each time (bit-for-bit: same reduction over the same row).
+    Unfilled slots quantize to zeros with scale 1 (``_quant``'s zero-row
+    rule)."""
     R = rows.shape[0]
     H = rows.shape[-1]
     filled = src < R
@@ -478,6 +520,21 @@ def _slot_gather_quant(rows, src, wire_dtype):
     q, scale = _quant(take.reshape(-1, H), wire_dtype)
     return (q.reshape(take.shape).astype(wire_dtype),
             scale.reshape(src.shape))
+
+
+def _slot_gather_prequant(rows, src, wire_dtype, n_dst, cols, cap):
+    """``quant_edge="pre"`` send edge: quantize the source ``rows`` ONCE,
+    then gather quantized rows + per-row scales through the slot map
+    ``src`` [n_dst, cap] — all gathered HBM traffic stays in the wire
+    dtype. Returns (send_buf [n_dst, cap, H] wire, scale wire
+    [n_dst, cols//128, 128] f32 with 1.0 in unfilled/pad slots)."""
+    R = rows.shape[0]
+    q, s = _quant(rows, wire_dtype)
+    send = _slot_gather(q, src, wire_dtype)
+    sc = _slot_gather(s[:, None], src, jnp.float32)[..., 0]
+    send_sc = jnp.ones((n_dst, cols), jnp.float32).at[:, :cap].set(
+        jnp.where(src < R, sc, 1.0))
+    return send, send_sc.reshape(n_dst, -1, 128)
 
 
 def _dequant(q: jax.Array, scale: jax.Array, out_dtype) -> jax.Array:
@@ -532,6 +589,16 @@ class Ep2dAllToAllContext:
     # This is the reference's showcase configuration (inter-node fp8 A2A,
     # README.md:55) on the hierarchical path.
     wire_dtype: jnp.dtype | None = None
+    quant_edge: str = "pre"       # see EpAllToAllContext
+    dequant_edge: str = "auto"
+
+    def _dequant_in_kernel(self) -> bool:
+        if self.dequant_edge == "auto":
+            # the final (minor-tier) collective is the one that dequantizes;
+            # its peer count decides whether in-kernel dequant has later
+            # arrivals to overlap
+            return self.n_minor > 1
+        return self.dequant_edge == "kernel"
 
     @property
     def n_major(self) -> int:
@@ -556,10 +623,15 @@ def create_all_to_all_context_2d(ctx: ShmemContext, max_tokens: int,
                                  cap1: int | None = None,
                                  cap2: int | None = None,
                                  dtype=jnp.bfloat16,
-                                 wire_dtype=None) -> Ep2dAllToAllContext:
+                                 wire_dtype=None,
+                                 quant_edge: str = "pre",
+                                 dequant_edge: str = "auto"
+                                 ) -> Ep2dAllToAllContext:
     axes = axes or (ctx.axis_names[0], ctx.axis_names[1])
     n = ctx.axis_size(axes[0]) * ctx.axis_size(axes[1])
     assert num_experts % n == 0, (num_experts, n)
+    assert quant_edge in ("pre", "fused"), quant_edge
+    assert dequant_edge in ("auto", "kernel", "post"), dequant_edge
     assert hidden % 128 == 0, f"hidden={hidden} must be a lane multiple (128)"
     itemsize = jnp.dtype(wire_dtype or dtype).itemsize
     if cap1 is None:
@@ -574,7 +646,9 @@ def create_all_to_all_context_2d(ctx: ShmemContext, max_tokens: int,
                                cap1=cap1, cap2=cap2, dtype=jnp.dtype(dtype),
                                wire_dtype=(jnp.dtype(wire_dtype)
                                            if wire_dtype is not None
-                                           else None))
+                                           else None),
+                               quant_edge=quant_edge,
+                               dequant_edge=dequant_edge)
 
 
 def route_tokens_2d(a2a: Ep2dAllToAllContext, topk_ids: jax.Array):
@@ -629,14 +703,16 @@ def dispatch_2d(a2a: Ep2dAllToAllContext, tokens: jax.Array,
         meta = jnp.full((nM, c1_cols), -1, jnp.int32).at[a_dst, s_drop].set(
             eid, mode="drop")
         outs = ()
-        if wire is not None:
-            # fused gather+quant ONCE at the source; the f32 scale
-            # side-channel rides the same slot maps through both tiers
-            # (no requantization)
+        if wire is not None and a2a.quant_edge == "pre":
+            # quantize ONCE at the source; the f32 scale side-channel rides
+            # the same slot maps through both tiers (no requantization)
+            send, send_sc = _slot_gather_prequant(tok_shard, src, wire,
+                                                  nM, c1_cols, cap1)
+            outs = (send_sc,)
+        elif wire is not None:
             send, sc = _slot_gather_quant(tok_shard, src, wire)
-            send_sc = jnp.ones((nM, c1_cols), jnp.float32).at[:, :cap1].set(
-                sc)
-            outs = (send_sc.reshape(nM, -1, 128),)
+            outs = (jnp.ones((nM, c1_cols), jnp.float32).at[:, :cap1].set(
+                sc).reshape(nM, -1, 128),)
         else:
             send = _slot_gather(tok_shard, src, a2a.dtype)
         return (send, meta.reshape(nM, c1_cols // 128, 128)) + outs + (
@@ -681,7 +757,8 @@ def dispatch_2d(a2a: Ep2dAllToAllContext, tokens: jax.Array,
     *wires2, b_dst, slot2, ok2 = sm2(recv1, meta1r, *sc1r)
     recv2, meta2r, *sc2r = all_to_all_push(
         ctx, *wires2, axis=minor, spec=both,
-        dequant_to=a2a.dtype if wire is not None else None)
+        dequant_to=a2a.dtype if wire is not None else None,
+        fuse_dequant=a2a._dequant_in_kernel())
 
     unpack = ctx.shard_map(
         lambda w: jnp.where(
