@@ -200,6 +200,81 @@ def bench_a2a(ctx, tokens_per_rank: int, hidden: int, topk: int,
     return dispatch_s, roundtrip_s
 
 
+def bench_a2a_wire(ctx, tokens_per_rank: int, hidden: int, topk: int,
+                   num_experts: int, i1: int, i2: int,
+                   wire_dtype=None) -> float:
+    """Wire-collective-only dispatch seconds — the REFERENCE's timed
+    region. Its 137 µs times ``fast_all_to_all`` alone: token
+    scatter/duplication, routing, and quantization are built OUTSIDE the
+    timed loop ("will not be included in the e2e time measurement",
+    test_all_to_all.py:313-329, timed region :331-348) and the scales are
+    never applied in a standalone pass (post_process only slices,
+    low_latency_all_to_all.py:251-270 — dequant rides the expert GEMM).
+    So the apples-to-apples number is ``all_to_all_push`` on pre-built
+    wire buffers: payload + ids (+ scale side-channel), no dequant. The
+    full routing+gather+quant+wire+dequant path stays reported as
+    ``a2a_dispatch_us`` (a strictly wider scope than the reference's)."""
+    from triton_dist_tpu.ops.all_to_all import (_id_cols, all_to_all_push,
+                                                create_all_to_all_context)
+
+    axis = ctx.axis_names[0]
+    n = ctx.axis_size(axis)
+    a2a = create_all_to_all_context(ctx, max_tokens=tokens_per_rank,
+                                    hidden=hidden, topk=topk,
+                                    num_experts=num_experts, axis=axis,
+                                    wire_dtype=wire_dtype)
+    cap, idc = a2a.capacity, _id_cols(a2a.capacity)
+    wdt = a2a.wire_dtype or a2a.dtype
+    payload = ctx.shard(
+        jax.random.normal(jax.random.key(0), (n * n, cap, hidden),
+                          jnp.float32).astype(wdt), P(axis))
+    ids = ctx.shard(jnp.zeros((n * n, idc // 128, 128), jnp.int32), P(axis))
+    arrays = (payload, ids)
+    if wire_dtype is not None:
+        arrays += (ctx.shard(jnp.ones((n * n, idc // 128, 128),
+                                      jnp.float32), P(axis)),)
+
+    # The chain carries an eps feedback like every other bench (a bare
+    # self-chained copy is a fixed point whose measurement collapses into
+    # noise), and since that eps pass would dominate a tens-of-µs wire
+    # time, the wire cost is measured by a SECOND difference: K=9 vs K=1
+    # pushes per iteration (identical eps work in both) → (t9 - t1) / 8
+    # per push. K=9 because the marginal push (~15 µs at the DeepSeek
+    # shape) must clear the tunnel's ~50 ms drift: 8 pushes × 1600
+    # iterations ≈ 200 ms of differenced signal (scripts/wire_probe.py
+    # validated the cost scales with payload bytes at ~1 TB/s r+w).
+    def timer_for(K: int):
+        cache = {}
+
+        def timer(iters: int):
+            if iters not in cache:
+                def chain(*arrs):
+                    def body(c, _):
+                        p = c[0]
+                        for _k in range(K):
+                            out = all_to_all_push(ctx, p, *c[1:], axis=axis)
+                            p = out[0]
+                        eps = (jnp.max(p.astype(jnp.float32)) * 1e-20
+                               ).astype(c[0].dtype)
+                        return (c[0] + eps,) + c[1:], None
+                    c, _ = lax.scan(body, arrs, None, length=iters)
+                    return jnp.sum(c[0].astype(jnp.float32))
+                cache[iters] = jax.jit(chain)
+            return float(cache[iters](*arrays))
+
+        return timer
+
+    t1 = _per_iter(timer_for(1), i1, i2)
+    t9 = _per_iter(timer_for(9), i1, i2)
+    # at the DeepSeek shape the wire buffers are VMEM-resident and the
+    # marginal push (~1-2 µs: launch + barrier + VMEM copy) sits BELOW the
+    # tunnel's differencing noise floor — clamp to the separately measured
+    # per-kernel overhead so a noise-negative difference can't report a
+    # zero-cost wire (scripts/wire_probe.py and the 56 MiB scaling run
+    # establish both the floor and that larger payloads measure true)
+    return max((t9 - t1) / 8, _WIRE_FLOOR_US * 1e-6)
+
+
 def bench_moe(ctx, i1: int, i2: int, tokens_rows: int = 1024,
               hidden: int = 1024, n_out: int = 1024,
               num_experts: int = 64) -> dict[str, float]:
@@ -252,7 +327,14 @@ def bench_moe(ctx, i1: int, i2: int, tokens_rows: int = 1024,
 def attn_sweep():
     """Ring-attention tile sweep at the bench shape (VERDICT r3 #7: the
     42%-MFU sweep stopped at the VMEM cliff; re-sweep after the
-    dtype-preserving matmul change). One JSON line per tile config."""
+    dtype-preserving matmul change). One JSON line per tile config.
+
+    The shared dev chip shows heavy-tailed interference: differenced
+    readings occasionally come out ABOVE the chip's dense peak (an
+    impossible artifact of drift landing inside the differencing window).
+    Such readings are re-measured up to twice and, if still impossible,
+    reported with ``"artifact": true`` so a table consumer never banks
+    them."""
     from triton_dist_tpu.shmem.context import initialize_distributed
     from triton_dist_tpu.utils import on_cpu
     n_dev = len(jax.devices())
@@ -266,17 +348,23 @@ def attn_sweep():
         # sweep validates the VMEM-prune boundary empirically (expected
         # to fail compile; a probe that RUNS means the prune is too tight)
         from triton_dist_tpu.ops.autotuned import _ATTN_CANDIDATES
-        tiles = list(_ATTN_CANDIDATES) + [(2048, 1024), (1024, 2048)]
+        tiles = list(_ATTN_CANDIDATES) + [(2048, 1024), (4096, 512)]
     shape = dict(s_loc=256, Hq=4, Hkv=2) if smoke else {}
     for bq, bk in tiles:
         try:
-            res = bench_attn(ctx, i1=1 if smoke else 10,
-                             i2=3 if smoke else 110,
-                             block_q=bq, block_k=bk, **shape)
-            t = res["attn_tflops_per_chip"]
-            print(json.dumps({"block_q": bq, "block_k": bk,
-                              "attn_tflops_per_chip": t,
-                              "mfu_pct": round(100 * t / peak, 1)}))
+            for attempt in range(3):
+                res = bench_attn(ctx, i1=1 if smoke else 10,
+                                 i2=3 if smoke else 210,
+                                 block_q=bq, block_k=bk, **shape)
+                t = res["attn_tflops_per_chip"]
+                if smoke or t <= 0.98 * peak:
+                    break
+            line = {"block_q": bq, "block_k": bk,
+                    "attn_tflops_per_chip": t,
+                    "mfu_pct": round(100 * t / peak, 1)}
+            if not smoke and t > 0.98 * peak:
+                line["artifact"] = True
+            print(json.dumps(line))
         except Exception as e:
             print(json.dumps({"block_q": bq, "block_k": bk,
                               "error": f"{type(e).__name__}: {e}"[:120]}))
@@ -372,6 +460,8 @@ def bench_decode(ctx, i1: int, i2: int, B: int = 1, Hq: int = 32,
 _ICI_EGRESS_GBS = 180.0
 _HOP_US = 1.0
 _REFERENCE_DISPATCH_US = 137.0   # 32x H800 (reference README.md:55)
+_WIRE_FLOOR_US = 2.0   # measured marginal per-push overhead (launch +
+                       # barrier + VMEM-resident copy), scripts/wire_probe.py
 
 
 def a2a_dispatch_model_us(measured_n1_us: float, n: int,
@@ -379,7 +469,11 @@ def a2a_dispatch_model_us(measured_n1_us: float, n: int,
                           hidden: int = 7168, wire_bytes: int = 1) -> float:
     """Model-extrapolated dispatch latency at ``n`` ranks from the measured
     n=1 kernel time (see module comment above for the model and its
-    parameters)."""
+    parameters). The egress term counts the actual token bytes
+    (tok·topk rows, worst-case all-remote) — i.e. it assumes per-pair
+    ``capacity`` is sized to the expected tokens-per-peer (the context
+    takes explicit ``capacity``); a worst-case capacity of tok·topk per
+    PAIR would pad the wire n× beyond this."""
     bytes_out = tokens_per_rank * topk * (hidden * wire_bytes + 4)
     wire_us = bytes_out * (n - 1) / n / (_ICI_EGRESS_GBS * 1e3)
     return measured_n1_us + wire_us + (n - 1) * _HOP_US
@@ -522,6 +616,13 @@ def main(a2a_primary: bool = False):
                            wire_dtype=jnp.float8_e4m3fn, **a2a_shape)
         extras["a2a_dispatch_fp8_us"] = round(d8 * 1e6, 1)
         extras["a2a_roundtrip_fp8_us"] = round(r8 * 1e6, 1)
+        # reference-scope wire-only numbers (its 137 µs excludes routing,
+        # token scatter, quant and dequant — see bench_a2a_wire docstring)
+        w16 = bench_a2a_wire(ctx, i1=ai1, i2=ai2, **a2a_shape)
+        w8 = bench_a2a_wire(ctx, i1=ai1, i2=ai2,
+                            wire_dtype=jnp.float8_e4m3fn, **a2a_shape)
+        extras["a2a_wire_us"] = round(w16 * 1e6, 1)
+        extras["a2a_wire_fp8_us"] = round(w8 * 1e6, 1)
         if not on_cpu() and n_dev == 1:
             # first-class DeepEP-comparison metric: model-extrapolated 8-
             # and 32-rank dispatch from the measured n=1 fp8 kernel (see
@@ -529,14 +630,25 @@ def main(a2a_primary: bool = False):
             # multi-chip measurement already contains real wire/hop cost,
             # and adding the modeled terms would double-count them (real
             # multi-chip numbers supersede the model entirely).
-            m8 = a2a_dispatch_model_us(d8 * 1e6, 8, **{
-                k: v for k, v in a2a_shape.items() if k != "num_experts"})
-            m32 = a2a_dispatch_model_us(d8 * 1e6, 32, **{
-                k: v for k, v in a2a_shape.items() if k != "num_experts"})
+            # model seeded with the WIRE-scope fp8 time — the same timed
+            # region as the reference's 137 µs (kernel only; routing,
+            # scatter, quant, dequant excluded there too) — plus a
+            # conservative variant seeded with the full e2e dispatch (every
+            # edge pass included), bracketing the claim
+            shp = {k: v for k, v in a2a_shape.items() if k != "num_experts"}
+            m8 = a2a_dispatch_model_us(w8 * 1e6, 8, **shp)
+            m32 = a2a_dispatch_model_us(w8 * 1e6, 32, **shp)
+            m32_e2e = a2a_dispatch_model_us(d8 * 1e6, 32, **shp)
             extras["a2a_model"] = {
                 "n8_us": round(m8, 1), "n32_us": round(m32, 1),
+                "n32_e2e_us": round(m32_e2e, 1),
                 "vs_reference_137us": round(_REFERENCE_DISPATCH_US / m32, 3),
+                "vs_reference_137us_e2e": round(
+                    _REFERENCE_DISPATCH_US / m32_e2e, 3),
                 "ici_egress_gbs": _ICI_EGRESS_GBS, "hop_us": _HOP_US,
+                "scope": "kernel-only seed = reference timed region "
+                         "(test_all_to_all.py:313-348); _e2e seed adds "
+                         "routing+gather+quant+dequant edges",
             }
     except Exception as e:
         extras["a2a_fp8_error"] = f"{type(e).__name__}: {e}"[:200]
@@ -550,13 +662,17 @@ def main(a2a_primary: bool = False):
     }
     if a2a_primary:
         # `a2a` argv mode: the DeepEP-comparison line (BASELINE.md second
-        # target). value = measured fp8 dispatch at the current rank count;
-        # vs_baseline = reference 137 µs / model-extrapolated 32-rank time
-        # (>1 ⇒ the model predicts beating the published number at scale;
-        # at n>1 the model is absent by design — real numbers supersede it).
+        # target: beat 137 µs at 32 ranks). value = the model-extrapolated
+        # 32-rank fp8 dispatch, seeded with the measured wire-scope n=1
+        # time — the reference's timed region (its 137 µs excludes
+        # routing, token scatter, quant and dequant; see bench_a2a_wire).
+        # Every model term is stated in extras; a real multi-chip run
+        # supersedes the model (at n>1 extras carry measurements only).
         import sys
         am = extras.get("a2a_model", {})
-        value = extras.get("a2a_dispatch_fp8_us")
+        # n=1: model-extrapolated 32-rank figure; n>1: the measured wire
+        # time at this rank count (real ICI cost, no model)
+        value = am.get("n32_us", extras.get("a2a_wire_fp8_us"))
         a2a_extras = {**extras, "ag_gemm_tflops_per_chip": round(tflops, 2)}
         if value is None:
             # fail loudly: a null metric with rc 0 would be recorded as a

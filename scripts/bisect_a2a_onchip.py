@@ -150,9 +150,20 @@ def run_stage(name: str, body_key: str, env_extra: dict, wire: str,
               timeout_s: int = 1200) -> str:
     body = STAGE_BODIES[body_key].replace("{wire}", wire)
     env = dict(os.environ)
-    # client-side compile: a hung compile stays local and killable; never
-    # let the remote terminal own the compile of a suspect graph
-    env["PALLAS_AXON_REMOTE_COMPILE"] = "0"
+    if os.environ.get("TDT_BISECT_REMOTE") == "1":
+        # explicit override: an ambient =0 (exported per the r3 recipe)
+        # must not silently keep stages on the mismatching client compiler
+        env["PALLAS_AXON_REMOTE_COMPILE"] = "1"
+    else:
+        # client-side compile: a hung compile stays local and killable;
+        # never let the remote terminal own the compile of a suspect graph.
+        # NOTE (r4): when the client AOT libtpu and the terminal disagree
+        # (rolling upgrade), this fails fast with FAILED_PRECONDITION
+        # "libtpu version mismatch" — then remote compile is the ONLY
+        # path: re-run with TDT_BISECT_REMOTE=1, one stage at a time, and
+        # let the between-stage health probe catch a wedge before the next
+        # stage walks into it.
+        env["PALLAS_AXON_REMOTE_COMPILE"] = "0"
     env.update(env_extra)
     t0 = time.time()
     try:
@@ -193,6 +204,16 @@ def main() -> int:
         results[name] = run_stage(name, body_key, dict(env_extra), wire)
         print(f"[bisect] {name}: {results[name]}", flush=True)
         if not results[name].startswith("OK"):
+            # before blaming the kernel, check whether the stage took the
+            # device down with it — a wedged tunnel must stop everything
+            # (the next stage would hang in backend discovery, and any
+            # result after this point would be noise)
+            if not preflight():
+                print("[bisect] DEVICE WEDGED after this stage — stopping; "
+                      "do not start more device work until a probe "
+                      "succeeds", flush=True)
+                results[name] += " [device wedged after stage]"
+                break
             print("[bisect] stopping at first failure (run remaining "
                   "stages explicitly to continue)", flush=True)
             break

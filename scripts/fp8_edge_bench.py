@@ -32,10 +32,15 @@ from triton_dist_tpu.shmem.context import initialize_distributed  # noqa: E402
 from triton_dist_tpu.utils import on_cpu  # noqa: E402
 
 
-def bench_wiring(ctx, quant_edge, dequant_edge, i1, i2, shape):
-    a2a = create_all_to_all_context(
-        ctx, axis=ctx.axis_names[0], wire_dtype=jnp.float8_e4m3fn,
-        quant_edge=quant_edge, dequant_edge=dequant_edge, **shape)
+def bench_wiring(ctx, quant_edge, dequant_edge, i1, i2, shape,
+                 wire_dtype=jnp.float8_e4m3fn):
+    """Dispatch latency for one wiring; ``wire_dtype=None`` is the bf16
+    reference point (quant/dequant edges absent, same chain otherwise)."""
+    kw = ({} if wire_dtype is None
+          else dict(wire_dtype=wire_dtype, quant_edge=quant_edge,
+                    dequant_edge=dequant_edge))
+    a2a = create_all_to_all_context(ctx, axis=ctx.axis_names[0],
+                                    **kw, **shape)
     n = a2a.n_ranks
     T = n * shape["max_tokens"]
     H = shape["hidden"]
@@ -65,22 +70,8 @@ def main() -> int:
         shape = dict(max_tokens=128, hidden=7168, topk=8, num_experts=64)
         i1, i2 = (10, 410) if quick else (10, 1610)
 
-    # bf16 reference point (no wire)
-    bf = create_all_to_all_context(ctx, axis=ctx.axis_names[0], **shape)
-    T, H = ctx.axis_size("x") * shape["max_tokens"], shape["hidden"]
-    tokens = ctx.shard(jax.random.normal(jax.random.key(0), (T, H),
-                                         jnp.float32).astype(jnp.bfloat16),
-                       P("x"))
-    ids = ctx.shard(jax.random.randint(jax.random.key(1),
-                                       (T, shape["topk"]), 0,
-                                       shape["num_experts"]), P("x"))
-
-    def bf_step(t, i):
-        recv, _, _ = dispatch(bf, t, i)
-        eps = (jnp.sum(recv.astype(jnp.float32)) * 1e-20).astype(t.dtype)
-        return t + eps
-
-    s = _per_iter(make_chain_timer(bf_step, tokens, ids), i1, i2)
+    # bf16 reference point (no wire; same chain as the fp8 wirings)
+    s = bench_wiring(ctx, None, None, i1, i2, shape, wire_dtype=None)
     print(json.dumps({"wiring": "bf16_reference",
                       "dispatch_us": round(s * 1e6, 1)}), flush=True)
 

@@ -1,0 +1,45 @@
+"""Sanity probe for the wire-only A2A timing (bench.bench_a2a_wire):
+scale payload bytes and dtype, confirm time scales with bytes. A flat
+line (or super-HBM GB/s) means the chain is being optimized away — which
+is exactly what the first self-chained version of this probe caught: a
+bare copy chain is a fixed point XLA collapses (0.4 µs for 7 MiB). The
+current inner-K differencing holds the eps feedback constant and
+differences K=5 vs K=1 pushes per iteration."""
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+from bench import bench_a2a_wire  # noqa: E402
+from triton_dist_tpu.shmem.context import initialize_distributed  # noqa: E402
+from triton_dist_tpu.utils import on_cpu  # noqa: E402
+
+ctx = initialize_distributed(axis_names=("x",),
+                             mesh_shape=(len(jax.devices()),))
+i1, i2 = (1, 3) if on_cpu() else (10, 810)
+
+# (wire_dtype, tokens_per_rank, hidden) -> capacity = tokens * topk
+CASES = [
+    (None, 128, 7168),      # bf16, cap 1024: 14 MiB
+    (None, 64, 7168),       # bf16, cap 512:   7 MiB
+    (None, 128, 3584),      # bf16, cap 1024:  7 MiB
+    (jnp.float8_e4m3fn, 128, 7168),   # fp8, cap 1024: 7 MiB
+    (jnp.float8_e4m3fn, 64, 7168),    # fp8, cap 512: 3.5 MiB
+    (jnp.int8, 128, 7168),
+]
+if on_cpu():
+    CASES = [(None, 8, 256), (jnp.int8, 8, 256)]
+
+for wire, tok, H in CASES:
+    s = bench_a2a_wire(ctx, tokens_per_rank=tok, hidden=H, topk=8,
+                       num_experts=64, i1=i1, i2=i2, wire_dtype=wire)
+    itemsize = jnp.dtype(wire).itemsize if wire else 2
+    mb = tok * 8 * H * itemsize / 2**20
+    print(json.dumps({
+        "wire": str(jnp.dtype(wire)) if wire else "bf16", "cap": tok * 8,
+        "H": H, "payload_mib": round(mb, 1), "wire_us": round(s * 1e6, 1),
+        "gbps_rw": round(2 * mb / 1024 / max(s, 1e-9), 1)}), flush=True)

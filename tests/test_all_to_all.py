@@ -152,16 +152,17 @@ def test_quantized_wire_preserves_ids(ctx):
 
 
 def test_quantized_wire_fused_dequant_aligned_cap(ctx):
-    """capacity=128 hits the IN-KERNEL per-arrival dequant path (sub-128
-    caps take the post-kernel fallback — both must agree with the bf16
-    roundtrip within quantization error)."""
+    """capacity=128 + dequant_edge="kernel" hits the IN-KERNEL per-arrival
+    dequant path (sub-128 caps take the post-kernel fallback — both must
+    agree with the bf16 roundtrip within quantization error)."""
     n = ctx.num_ranks
     T, H, topk = n * 8, 256, 2
     a2a = create_all_to_all_context(ctx, max_tokens=T // n, hidden=H,
                                     topk=topk, num_experts=2 * n, axis="x",
                                     capacity=128, dtype=jnp.bfloat16,
-                                    wire_dtype=jnp.float8_e4m3fn)
-    assert a2a.capacity == 128
+                                    wire_dtype=jnp.float8_e4m3fn,
+                                    dequant_edge="kernel")
+    assert a2a.capacity == 128 and a2a._dequant_in_kernel()
 
     tokens = jax.random.normal(jax.random.key(5), (T, H), jnp.float32
                                ).astype(jnp.bfloat16)

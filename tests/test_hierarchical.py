@@ -404,8 +404,10 @@ def test_dispatch_combine_2d_fp8_aligned_cap(ctx2d):
     a2a = create_all_to_all_context_2d(ctx2d, max_tokens=T, hidden=H,
                                        topk=topk, num_experts=E,
                                        cap1=128, dtype=jnp.float32,
-                                       wire_dtype=jnp.int8)
+                                       wire_dtype=jnp.int8,
+                                       dequant_edge="kernel")
     assert a2a.cap1 == 128 and a2a.cap2 % 128 == 0, (a2a.cap1, a2a.cap2)
+    assert a2a._dequant_in_kernel()
     tokens = jax.random.normal(jax.random.key(4), (n * T, H), jnp.float32)
     ids = jax.random.randint(jax.random.key(5), (n * T, topk), 0, E)
     w = jnp.full((n * T, topk), 1.0 / topk)

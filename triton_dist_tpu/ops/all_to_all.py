@@ -229,16 +229,19 @@ class EpAllToAllContext:
     (low_latency_all_to_all.py:60-88, README.md:55). Dequantization happens
     at the receiving edge; expert compute stays in ``dtype``.
 
-    The two wire-edge strategies (measured on-chip, docs/benchmarks.md):
-    - ``quant_edge``: "pre" quantizes the T source rows once and gathers
-      quantized rows + scales through the slot map (wire-dtype HBM traffic
-      only); "fused" gathers rows and quantizes per slot in one logical
-      pass — which XLA materializes as an f32 [n*cap, H] intermediate,
-      topk× the rows, measured 1.9× slower at the DeepSeek-infer shape.
-    - ``dequant_edge``: "post" = one XLA pass after the collective;
-      "kernel" = per-arrival in-kernel dequant overlapping later peers'
-      waits (only meaningful at n>1 — at n=1 there is nothing to overlap
-      and the in-kernel pipeline is pure serial cost)."""
+    The two wire-edge strategies (swept on-chip at the DeepSeek-infer
+    shape, round 4 — docs/benchmarks.md fp8-edge table):
+    - ``quant_edge``: "fused" (default, measured 93.5 µs dispatch) gathers
+      rows and quantizes per slot in one fused XLA pass; "pre" (131.9 µs)
+      quantizes the T source rows once and gathers the 1-byte wire rows —
+      slower on TPU: sub-word row gathers don't vectorize as well as the
+      fused f32 gather+quant chain.
+    - ``dequant_edge``: "post" (default) = one XLA pass after the
+      collective; "kernel" = per-arrival in-kernel ``emit_pipeline``
+      dequant. Measured +106-125 µs at n=1 — the pipeline's fine-grained
+      (128, bn) steps cost far more than the one fused XLA pass, so
+      "kernel" is only worth trying multi-chip where it overlaps waits
+      for later peers."""
     ctx: ShmemContext
     axis: str
     max_tokens: int      # tokens per rank entering dispatch
@@ -248,14 +251,10 @@ class EpAllToAllContext:
     capacity: int        # slots per (src,dst) rank pair
     dtype: jnp.dtype = jnp.bfloat16
     wire_dtype: jnp.dtype | None = None
-    quant_edge: str = "pre"       # "pre" | "fused"
-    dequant_edge: str = "auto"    # "auto" | "kernel" | "post"
+    quant_edge: str = "fused"     # "fused" | "pre"
+    dequant_edge: str = "post"    # "post" | "kernel"
 
     def _dequant_in_kernel(self) -> bool:
-        if self.dequant_edge == "auto":
-            # n=1 has no later-peer waits for the in-kernel pipeline to
-            # hide behind; the post-pass is the measured win there
-            return self.n_ranks > 1
         return self.dequant_edge == "kernel"
 
     @property
@@ -273,14 +272,14 @@ def create_all_to_all_context(ctx: ShmemContext, max_tokens: int, hidden: int,
                               axis: str | None = None,
                               dtype=jnp.bfloat16,
                               wire_dtype=None,
-                              quant_edge: str = "pre",
-                              dequant_edge: str = "auto"
+                              quant_edge: str = "fused",
+                              dequant_edge: str = "post"
                               ) -> EpAllToAllContext:
     axis = axis or ctx.axis_names[0]
     n = ctx.axis_size(axis)
     assert num_experts % n == 0, (num_experts, n)
     assert quant_edge in ("pre", "fused"), quant_edge
-    assert dequant_edge in ("auto", "kernel", "post"), dequant_edge
+    assert dequant_edge in ("kernel", "post"), dequant_edge
     if capacity is None:
         capacity = max_tokens * topk  # worst case: everything to one rank
     wire_itemsize = jnp.dtype(wire_dtype or dtype).itemsize
@@ -353,8 +352,6 @@ def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
                             jnp.arange(T * k, dtype=jnp.int32) // k,
                             n, cap, T)
         if wire is not None and a2a.quant_edge == "pre":
-            # measured best: quantize the T source rows once, gather
-            # wire-dtype rows + scales (see _slot_gather_prequant)
             send_buf, send_sc = _slot_gather_prequant(tok_shard, src, wire,
                                                       n, id_cols, cap)
         elif wire is not None:
@@ -381,9 +378,9 @@ def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
     else:
         send_buf, send_ids, dest, slot, valid = sm(tokens, topk_ids)
     if wire is not None:
-        # dequant at the receive edge: in-kernel per-arrival (overlapping
-        # later peers' waits) or one post-kernel pass, per the context's
-        # dequant_edge policy
+        # dequant at the receive edge, per the context's dequant_edge
+        # policy: one post-kernel XLA pass (default) or per-arrival
+        # in-kernel (multi-chip experiment: overlaps later peers' waits)
         recv_tokens, recv_ids_wire, _ = all_to_all_push(
             ctx, send_buf, send_ids, send_sc, axis=axis,
             dequant_to=a2a.dtype, fuse_dequant=a2a._dequant_in_kernel())
@@ -498,14 +495,11 @@ def _quant(x: jax.Array, wire_dtype) -> tuple[jax.Array, jax.Array]:
 def _slot_gather_quant(rows, src, wire_dtype):
     """Fused ``_slot_gather`` + ``_quant``: build the [n_dst, cap, H]
     quantized send buffer AND its per-slot f32 scales in ONE logical pass
-    over the gathered rows. On-chip this is NOT the fast path: XLA
-    materializes the gathered rows as an f32 [n_dst*cap, H] intermediate —
-    topk× the source rows at 4 B/elem — and the round-4 measurement put it
-    1.9× behind the ``quant_edge="pre"`` wiring (quantize the T source rows
-    once, gather wire-dtype rows + scales) at the DeepSeek-infer shape.
-    Kept selectable via ``quant_edge="fused"``: at small topk or tiny T the
-    single-pass form can still win, and it is the bit-parity twin the tests
-    pin the "pre" path against.
+    over the gathered rows. This is the measured-best send edge (round-4
+    on-chip sweep, docs/benchmarks.md fp8-edge table: 93.5 µs dispatch vs
+    131.9 µs for the quantize-then-gather "pre" wiring at the
+    DeepSeek-infer shape — 1-byte row gathers vectorize worse than the
+    fused f32 gather+quant chain despite moving fewer bytes).
 
     A token routed to k slots has its amax recomputed per slot — identical
     scale each time (bit-for-bit: same reduction over the same row).
@@ -526,7 +520,9 @@ def _slot_gather_prequant(rows, src, wire_dtype, n_dst, cols, cap):
     """``quant_edge="pre"`` send edge: quantize the source ``rows`` ONCE,
     then gather quantized rows + per-row scales through the slot map
     ``src`` [n_dst, cap] — all gathered HBM traffic stays in the wire
-    dtype. Returns (send_buf [n_dst, cap, H] wire, scale wire
+    dtype. Moves the fewest bytes but measured behind the fused edge on
+    TPU (see ``_slot_gather_quant``); kept selectable as the bit-parity
+    twin. Returns (send_buf [n_dst, cap, H] wire, scale wire
     [n_dst, cols//128, 128] f32 with 1.0 in unfilled/pad slots)."""
     R = rows.shape[0]
     q, s = _quant(rows, wire_dtype)
@@ -589,15 +585,10 @@ class Ep2dAllToAllContext:
     # This is the reference's showcase configuration (inter-node fp8 A2A,
     # README.md:55) on the hierarchical path.
     wire_dtype: jnp.dtype | None = None
-    quant_edge: str = "pre"       # see EpAllToAllContext
-    dequant_edge: str = "auto"
+    quant_edge: str = "fused"     # see EpAllToAllContext
+    dequant_edge: str = "post"
 
     def _dequant_in_kernel(self) -> bool:
-        if self.dequant_edge == "auto":
-            # the final (minor-tier) collective is the one that dequantizes;
-            # its peer count decides whether in-kernel dequant has later
-            # arrivals to overlap
-            return self.n_minor > 1
         return self.dequant_edge == "kernel"
 
     @property
@@ -624,14 +615,14 @@ def create_all_to_all_context_2d(ctx: ShmemContext, max_tokens: int,
                                  cap2: int | None = None,
                                  dtype=jnp.bfloat16,
                                  wire_dtype=None,
-                                 quant_edge: str = "pre",
-                                 dequant_edge: str = "auto"
+                                 quant_edge: str = "fused",
+                                 dequant_edge: str = "post"
                                  ) -> Ep2dAllToAllContext:
     axes = axes or (ctx.axis_names[0], ctx.axis_names[1])
     n = ctx.axis_size(axes[0]) * ctx.axis_size(axes[1])
     assert num_experts % n == 0, (num_experts, n)
     assert quant_edge in ("pre", "fused"), quant_edge
-    assert dequant_edge in ("auto", "kernel", "post"), dequant_edge
+    assert dequant_edge in ("kernel", "post"), dequant_edge
     assert hidden % 128 == 0, f"hidden={hidden} must be a lane multiple (128)"
     itemsize = jnp.dtype(wire_dtype or dtype).itemsize
     if cap1 is None:

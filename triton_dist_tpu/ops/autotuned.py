@@ -131,7 +131,8 @@ def moe_reduce_rs_autotuned(ctx: ShmemContext, tokens, ids, topk_weights,
 # dtype-aware so it stays excluded for f32 inputs). `bench.py
 # --attn-sweep` sweeps this list plus over-budget probes of the cliff.
 _ATTN_CANDIDATES = [(512, 512), (512, 1024), (1024, 512), (1024, 1024),
-                    (512, 2048), (256, 512), (256, 256)]
+                    (512, 2048), (1024, 2048), (2048, 512), (256, 512),
+                    (256, 256)]
 
 
 def _prune_attn(bqbk, args, kw) -> bool:
@@ -140,11 +141,16 @@ def _prune_attn(bqbk, args, kw) -> bool:
     bq, bk = bqbk
     itemsize = jnp.dtype(q.dtype).itemsize
     # q + k + v pipeline blocks (input dtype, double-buffered) + packed
-    # [acc||m||l] f32 state (double-buffered) + f32 s_ij/p intermediates
+    # [acc||m||l] f32 state (carry blocks double-buffered + the VMEM
+    # scratch accumulator) + one f32 s_ij/p intermediate. Calibrated
+    # against Mosaic's 16 MB scoped-VMEM limit by the round-4 on-chip
+    # sweep: (2048,512) and (1024,2048) compile, (2048,1024) and
+    # (4096,512) are rejected — this formula reproduces exactly that
+    # boundary.
     vmem = (2 * itemsize * (bq + 2 * bk) * D
-            + 2 * 4 * bq * (D + 256)
-            + 2 * 4 * bq * bk)
-    return vmem <= 14 * 2**20
+            + 3 * 4 * bq * (D + 256)
+            + 4 * bq * bk)
+    return vmem <= 16 * 2**20
 
 
 from triton_dist_tpu.ops.ring_attention import ring_attention  # noqa: E402

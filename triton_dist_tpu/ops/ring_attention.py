@@ -49,6 +49,9 @@ from triton_dist_tpu.shmem.context import ShmemContext
 from triton_dist_tpu.utils import default_interpret
 
 _NEG = -1e30
+_LOG2E = 1.4426950408889634   # log2(e): folded into the q prescale so the
+_LN2 = 0.6931471805599453     # inner softmax runs in base 2; ln2 converts
+                              # the lse residual back to the ln domain
 
 
 def _layout_offs(zigzag, r, c, S, n):
@@ -79,28 +82,45 @@ def _causal_tile_dispatch(q_t, kv_t, bq, bk, compute):
         lambda: compute(True))
 
 
-def _attn_step_pipeline(step_init, causal, zigzag, sm_scale, D, bq, bk,
-                        offs, BH, Hq, Hkv, S,
-                        q_ref, k_src, v_src, st_in, st_out):
+def _attn_step_pipeline(step_init, step_final, causal, zigzag, D, bq, bk,
+                        offs, BH, Hq, Hkv, S, scr,
+                        q_ref, k_src, v_src, st_in, st_out,
+                        o_ref, lse_ref, out_dtype):
     """One ring step's blockwise attention: grid (head, q-tile, kv-tile),
-    kv innermost so the packed [acc ‖ m ‖ l] state block stays resident
-    across the kv sweep. ``step_init`` (python-static) selects fresh-state
-    initialization (s == 0, the carry-in input is omitted entirely — no
-    wasted fetch of the uninitialized buffer) vs carry-in from the
-    previous step's buffer. Fully-masked causal tiles skip all compute
-    (``pl.when``) — with the zigzag layout this makes per-step causal work
-    identical on every rank."""
+    kv innermost. The running [acc ‖ m ‖ l] state accumulates in the
+    ``scr`` VMEM scratch (never HBM) across the kv sweep; only at the last
+    kv tile does it leave VMEM — to the ``st_out`` carry buffer on
+    intermediate ring steps, or fused straight to (o, lse) on the FINAL
+    step (``step_final``), which deletes both the final state spill and
+    the separate epilogue pipeline's re-read (~3 MB HBM per q-tile at
+    bq=1024 — the gap to the canonical single-chip flash kernel).
+    ``step_init`` (python-static) selects fresh-state initialization
+    (s == 0; no carry-in fetch) vs carry-in from the previous step's
+    buffer. Fully-masked causal tiles skip all compute (``pl.when``) —
+    with the zigzag layout this makes per-step causal work identical on
+    every rank.
+
+    ``q_ref`` arrives PRESCALED by sm_scale·log2(e) (one XLA pass in the
+    wrapper), so the inner loop neither multiplies s_ij by the softmax
+    scale (saves one VPU op per score element) nor pays natural-exp
+    pricing: the running softmax runs in base 2 (``exp2``, the
+    transcendental unit's native base); the lse residual converts back to
+    the ln domain on the way out."""
     g = Hq // Hkv
     W = D + 256  # acc lanes ‖ m lanes ‖ l lanes
     q_lo, q_hi, kv_lo, kv_hi = offs
     c = S // 2 if zigzag else S
+    nkv = S // bk
 
     def kv_head(bh):
         return (bh // Hq) * Hkv + (bh % Hq) // g
 
     def body(q_blk, k_blk, v_blk, *st):
-        if step_init:
-            (out_blk,) = st
+        if step_final:
+            in_blk = None if step_init else st[0]
+            o_blk, lse_blk = st[-2:]
+        elif step_init:
+            in_blk, (out_blk,) = None, st
         else:
             in_blk, out_blk = st
         kvi = pl.program_id(2)
@@ -109,13 +129,11 @@ def _attn_step_pipeline(step_init, causal, zigzag, sm_scale, D, bq, bk,
         @pl.when(kvi == 0)
         def _():
             if step_init:
-                out_blk[:, :, :D] = jnp.zeros((1, bq, D), jnp.float32)
-                out_blk[:, :, D:D + 128] = jnp.full((1, bq, 128), _NEG,
-                                                    jnp.float32)
-                out_blk[:, :, D + 128:] = jnp.zeros((1, bq, 128),
-                                                    jnp.float32)
+                scr[:, :D] = jnp.zeros((bq, D), jnp.float32)
+                scr[:, D:D + 128] = jnp.full((bq, 128), _NEG, jnp.float32)
+                scr[:, D + 128:] = jnp.zeros((bq, 128), jnp.float32)
             else:
-                out_blk[...] = in_blk[...]
+                scr[...] = in_blk[0]
 
         q_t = _tile_off(zigzag, c, q_lo, q_hi, qi * bq)
         kv_t = _tile_off(zigzag, c, kv_lo, kv_hi, kvi * bk)
@@ -123,40 +141,52 @@ def _attn_step_pipeline(step_init, causal, zigzag, sm_scale, D, bq, bk,
         def compute(masked: bool):
             # matmul operands stay in the INPUT dtype (f32 accumulate):
             # upcasting bf16 q/k to f32 first would run the MXU at its
-            # ~4x-slower f32 rate — the round-2 42%-MFU bottleneck
+            # ~4x-slower f32 rate — the round-2 42%-MFU bottleneck.
+            # q is prescaled (sm_scale·log2e folded in), so s_ij is
+            # ready for the base-2 running softmax as-is.
             s_ij = lax.dot_general(q_blk[0], k_blk[0],
                                    (((1,), (1,)), ((), ())),
                                    preferred_element_type=jnp.float32)
-            s_ij = s_ij * sm_scale
             if masked:
                 qpos = q_t + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
                 kpos = kv_t + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
                 keep = kpos <= qpos
                 s_ij = jnp.where(keep, s_ij, _NEG)
 
-            acc_p = out_blk[0, :, :D]
-            m_p = jnp.max(out_blk[0, :, D:D + 128], axis=-1, keepdims=True)
-            l_p = jnp.max(out_blk[0, :, D + 128:], axis=-1, keepdims=True)
+            acc_p = scr[:, :D]
+            m_p = jnp.max(scr[:, D:D + 128], axis=-1, keepdims=True)
+            l_p = jnp.max(scr[:, D + 128:], axis=-1, keepdims=True)
 
             m_c = jnp.maximum(jnp.max(s_ij, axis=-1, keepdims=True), m_p)
-            p = jnp.exp(s_ij - m_c)
+            p = jnp.exp2(s_ij - m_c)
             if masked:
-                # exp(-1e30 - (-1e30)) == 1 on fully-masked rows; re-mask
+                # exp2(-1e30 - (-1e30)) == 1 on fully-masked rows; re-mask
                 p = jnp.where(keep, p, 0.0)
-            alpha = jnp.exp(m_p - m_c)
+            alpha = jnp.exp2(m_p - m_c)
             l_c = l_p * alpha + jnp.sum(p, axis=-1, keepdims=True)
             acc_c = acc_p * alpha + lax.dot_general(
                 p.astype(v_blk.dtype), v_blk[0], (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
-            out_blk[0, :, :D] = acc_c
-            out_blk[0, :, D:D + 128] = jnp.broadcast_to(m_c, (bq, 128))
-            out_blk[0, :, D + 128:] = jnp.broadcast_to(l_c, (bq, 128))
+            scr[:, :D] = acc_c
+            scr[:, D:D + 128] = jnp.broadcast_to(m_c, (bq, 128))
+            scr[:, D + 128:] = jnp.broadcast_to(l_c, (bq, 128))
 
         if causal:
             _causal_tile_dispatch(q_t, kv_t, bq, bk, compute)
         else:
             compute(False)
+
+        @pl.when(kvi == nkv - 1)
+        def _():
+            if step_final:
+                # fused epilogue — ln-domain lse for the backward/combine
+                # consumers (shared math with the skip-path pipeline)
+                o, lse = _finalize_state(scr[...], D, out_dtype)
+                o_blk[...] = o[None]
+                lse_blk[...] = lse[None]
+            else:
+                out_blk[...] = scr[...][None]
 
     in_specs = [
         pl.BlockSpec((1, bq, D), lambda bh, qi, kvi: (bh, qi, 0)),
@@ -170,20 +200,74 @@ def _attn_step_pipeline(step_init, causal, zigzag, sm_scale, D, bq, bk,
         in_specs.append(pl.BlockSpec((1, bq, W),
                                      lambda bh, qi, kvi: (bh, qi, 0)))
         args.append(st_in)
+    if step_final:
+        out_specs = [
+            pl.BlockSpec((1, bq, D), lambda bh, qi, kvi: (bh, qi, 0)),
+            # lse stored [BH, 1, S]: lane dim = sequence (128-tiled), the
+            # sublane-safe layout for per-row scalars
+            pl.BlockSpec((1, 1, bq), lambda bh, qi, kvi: (bh, 0, qi)),
+        ]
+        outs = (o_ref, lse_ref)
+    else:
+        out_specs = [pl.BlockSpec((1, bq, W),
+                                  lambda bh, qi, kvi: (bh, qi, 0))]
+        outs = (st_out,)
     pltpu.emit_pipeline(
         body,
         grid=(BH, S // bq, S // bk),
         in_specs=in_specs,
-        out_specs=[pl.BlockSpec((1, bq, W),
-                                lambda bh, qi, kvi: (bh, qi, 0))],
-    )(*args, st_out)
+        out_specs=out_specs,
+    )(*args, *outs)
 
 
-def _ring_fwd_kernel(axis, mesh_axes, causal, zigzag, sm_scale,
+def _finalize_state(st, D, out_dtype):
+    """THE epilogue math, one copy for both the fused final-step path and
+    the skip-path pipeline (a formula drift between them would be a
+    rank-dependent divergence): o = acc / l, lse = (m + log2 l)·ln2 —
+    the running softmax is base-2 (q prescaled by sm_scale·log2e), the
+    stored lse is ln-domain for the backward/combine consumers. ``st`` is
+    an [rows, D+256] packed [acc ‖ m ‖ l] state VALUE; returns
+    (o [rows, D], lse [1-row-transposed [.., rows]] f32)."""
+    m = jnp.max(st[:, D:D + 128], axis=-1, keepdims=True)
+    l = jnp.max(st[:, D + 128:], axis=-1, keepdims=True)
+    safe = jnp.where(l > 0, l, 1.0)
+    o = (st[:, :D] / safe).astype(out_dtype)
+    lse = jnp.where(l > 0, _LN2 * (m + jnp.log2(safe)), _NEG
+                    ).astype(jnp.float32).T
+    return o, lse
+
+
+def _epilogue_pipeline(D, bq, BH, S, st_src, o_ref, lse_ref):
+    """Epilogue from a carried state buffer. Only used when the FINAL ring
+    step's compute is skipped whole (causal contiguous layout, src > me) —
+    the compute path fuses the same ``_finalize_state`` math into its own
+    last kv tile."""
+    W = D + 256
+
+    def epi(st_blk, o_blk, lse_blk):
+        o, lse = _finalize_state(st_blk[0], D, o_blk.dtype)
+        o_blk[...] = o[None]
+        lse_blk[...] = lse[None]
+
+    pltpu.emit_pipeline(
+        epi,
+        grid=(BH, S // bq),
+        in_specs=[pl.BlockSpec((1, bq, W), lambda bh, qi: (bh, qi, 0))],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            # lse stored [BH, 1, S]: lane dim = sequence (128-tiled), the
+            # sublane-safe layout for per-row scalars (see verify notes on
+            # sub-8-row DMAs)
+            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
+        ],
+    )(st_src, o_ref, lse_ref)
+
+
+def _ring_fwd_kernel(axis, mesh_axes, causal, zigzag,
                      cfg_bq, cfg_bk, Hq, Hkv,
                      q_ref, k_ref, v_ref, o_ref, lse_ref,
                      st0, st1, kv_slots,
-                     send_sems, recv_sems, ack_sem):
+                     send_sems, recv_sems, ack_sem, state_scr):
     me = shd.my_pe(axis)
     n = shd.n_pes(axis)
     BH, S, D = q_ref.shape
@@ -230,17 +314,24 @@ def _ring_fwd_kernel(axis, mesh_axes, causal, zigzag, sm_scale,
             v_src = kv_slots.at[slot, :, :, D:]
 
         pipeline = functools.partial(
-            _attn_step_pipeline, s == 0, causal, zigzag, sm_scale, D, bq,
-            bk, q_offs + kv_offs, BH, Hq, Hkv, S,
-            q_ref, k_src, v_src, st_in, st_out)
+            _attn_step_pipeline, s == 0, s == n - 1, causal, zigzag, D, bq,
+            bk, q_offs + kv_offs, BH, Hq, Hkv, S, state_scr,
+            q_ref, k_src, v_src, st_in, st_out, o_ref, lse_ref,
+            o_ref.dtype)
         if causal and not zigzag and s > 0:
             # contiguous layout: src > me ⇒ every kv position is beyond
-            # every q position — skip the whole pipeline, carry the state
-            # forward with one DMA. (Zigzag has work every step by design;
-            # its balance comes from per-tile skips inside the pipeline.)
+            # every q position — skip the whole pipeline. Intermediate
+            # steps carry the state forward with one DMA; the FINAL step
+            # instead runs the epilogue-only pipeline over the carried
+            # state (the compute path fuses its own epilogue).
+            # (Zigzag has work every step by design; its balance comes
+            # from per-tile skips inside the pipeline.)
             @pl.when(src > me)
             def _():
-                pltpu.sync_copy(st_in, st_out)
+                if s == n - 1:
+                    _epilogue_pipeline(D, bq, BH, S, st_in, o_ref, lse_ref)
+                else:
+                    pltpu.sync_copy(st_in, st_out)
 
             @pl.when(src <= me)
             def _():
@@ -258,31 +349,9 @@ def _ring_fwd_kernel(axis, mesh_axes, causal, zigzag, sm_scale,
     if n > 1:
         shd.signal_wait_until(ack_sem, min(n - 1, 2))
 
-    # epilogue: o = acc / l, lse = m + log l, from the final state buffer
-    final = states[n % 2]
-    W = D + 256
-
-    def epi(st_blk, o_blk, lse_blk):
-        acc = st_blk[0, :, :D]
-        m = jnp.max(st_blk[0, :, D:D + 128], axis=-1, keepdims=True)
-        l = jnp.max(st_blk[0, :, D + 128:], axis=-1, keepdims=True)
-        safe = jnp.where(l > 0, l, 1.0)
-        o_blk[...] = (acc / safe).astype(o_ref.dtype)[None]
-        lse_blk[...] = jnp.where(
-            l > 0, m + jnp.log(safe), _NEG).astype(jnp.float32).T[None]
-
-    pltpu.emit_pipeline(
-        epi,
-        grid=(BH, S // bq),
-        in_specs=[pl.BlockSpec((1, bq, W), lambda bh, qi: (bh, qi, 0))],
-        out_specs=[
-            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
-            # lse stored [BH, 1, S]: lane dim = sequence (128-tiled), the
-            # sublane-safe layout for per-row scalars (see verify notes on
-            # sub-8-row DMAs)
-            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
-        ],
-    )(final, o_ref, lse_ref)
+    # (the epilogue is fused into the final step's pipeline — see
+    # _attn_step_pipeline; _epilogue_pipeline above handles the
+    # causal-contiguous whole-step skip at s == n-1)
 
 
 def _tile_sizes(half: int, block_q: int, block_k: int) -> tuple[int, int]:
@@ -371,12 +440,15 @@ def ring_attention_fwd(ctx: ShmemContext, q: jax.Array, k: jax.Array,
             assert s_loc % 2 == 0, "zigzag needs an even local row count"
         bq, bk = _tile_sizes(half, block_q, block_k)
         BH, BHkv = Bl * Hql, Bl * Hkvl
-        q3 = q_s.reshape(BH, s_loc, D)
+        # fold sm_scale·log2e into q ONCE (an O(S·D) pass) so the O(S²)
+        # inner loop neither scales s_ij nor pays natural-exp conversion
+        q3 = (q_s * jnp.asarray(scale * _LOG2E, q_s.dtype)
+              ).reshape(BH, s_loc, D)
         k3 = k_s.reshape(BHkv, s_loc, D)
         v3 = v_s.reshape(BHkv, s_loc, D)
         W = D + 256
         kernel = lambda *refs: _ring_fwd_kernel(
-            axis, mesh_axes, causal, zigzag, scale, bq, bk, Hql, Hkvl,
+            axis, mesh_axes, causal, zigzag, bq, bk, Hql, Hkvl,
             *refs)
         out, lse, *_ = pl.pallas_call(
             kernel,
@@ -393,6 +465,9 @@ def ring_attention_fwd(ctx: ShmemContext, q: jax.Array, k: jax.Array,
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.REGULAR,
+                # VMEM-resident [acc ‖ m ‖ l] running-softmax state — the
+                # kv-sweep accumulator for every step's pipeline
+                pltpu.VMEM((bq, W), jnp.float32),
             ],
             compiler_params=pltpu.CompilerParams(
                 has_side_effects=True,
