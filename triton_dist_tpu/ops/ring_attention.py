@@ -441,9 +441,11 @@ def ring_attention_fwd(ctx: ShmemContext, q: jax.Array, k: jax.Array,
         bq, bk = _tile_sizes(half, block_q, block_k)
         BH, BHkv = Bl * Hql, Bl * Hkvl
         # fold sm_scale·log2e into q ONCE (an O(S·D) pass) so the O(S²)
-        # inner loop neither scales s_ij nor pays natural-exp conversion
-        q3 = (q_s * jnp.asarray(scale * _LOG2E, q_s.dtype)
-              ).reshape(BH, s_loc, D)
+        # inner loop neither scales s_ij nor pays natural-exp conversion;
+        # multiply in f32 so the constant stays exact and only the result
+        # rounds to the input dtype
+        q3 = (q_s.astype(jnp.float32) * (scale * _LOG2E)
+              ).astype(q_s.dtype).reshape(BH, s_loc, D)
         k3 = k_s.reshape(BHkv, s_loc, D)
         v3 = v_s.reshape(BHkv, s_loc, D)
         W = D + 256
@@ -522,8 +524,11 @@ def _bwd_dq_pipeline(step_init, causal, zigzag, scale, D, bq, bk, offs,
 
         def compute(masked: bool):
             p, dS, keep = _recompute_p_ds(
-                masked, scale, bq, bk, q_t, kv_t,
+                masked, bq, bk, q_t, kv_t,
                 q_blk, do_blk, lse_blk, dl_blk, k_blk, v_blk)
+            # k is unscaled, so dq keeps the explicit sm_scale factor; the
+            # result is d(q), not d(q·scale·log2e) — chain rule folds the
+            # prescale constant right back out
             dq_o[0] += lax.dot_general(
                 dS.astype(k_blk.dtype), k_blk[0], (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
@@ -589,11 +594,13 @@ def _bwd_dkv_pipeline(step_init, causal, zigzag, scale, D, bq, bk, offs,
 
         def compute(masked: bool):
             p, dS, keep = _recompute_p_ds(
-                masked, scale, bq, bk, q_t, kv_t,
+                masked, bq, bk, q_t, kv_t,
                 q_blk, do_blk, lse_blk, dl_blk, k_blk, v_blk)
+            # q arrives prescaled by scale·log2e, so dS @ q² carries an
+            # extra log2e vs the wanted dS @ q · scale — ln2 cancels it
             g_o[0, :, :D] += lax.dot_general(
                 dS.astype(q_blk.dtype), q_blk[0], (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale
+                preferred_element_type=jnp.float32) * _LN2
             g_o[0, :, D:] += lax.dot_general(
                 p.astype(do_blk.dtype), do_blk[0], (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -627,19 +634,22 @@ def _bwd_dkv_pipeline(step_init, causal, zigzag, scale, D, bq, bk, offs,
     )(*args, g_out)
 
 
-def _recompute_p_ds(masked, scale, bq, bk, q_pos0, kv_pos0,
+def _recompute_p_ds(masked, bq, bk, q_pos0, kv_pos0,
                     q_blk, do_blk, lse_blk, dl_blk, k_blk, v_blk):
     """Shared backward-tile math: recompute p from (q, k, lse), then
     dS = p * (do @ v^T - delta). Returns (p, dS, keep-mask). Matmul
     operands stay in the input dtype (f32 accumulate) — see the forward
-    pipeline's MXU-rate note. ``masked`` is python-static: True only for
-    diagonal causal tiles (``_causal_tile_dispatch``); interior tiles run
-    the mask-free body."""
+    pipeline's MXU-rate note. ``q_blk`` arrives PRESCALED by
+    sm_scale·log2e (like the forward), so p = exp2(s₂ − lse·log2e) =
+    exp(s − lse) with no per-element scale multiply and the base-2
+    transcendental; the lse conversion is one (bq, 1) multiply per tile.
+    ``masked`` is python-static: True only for diagonal causal tiles
+    (``_causal_tile_dispatch``); interior tiles run the mask-free body."""
     s_ij = lax.dot_general(q_blk[0], k_blk[0], (((1,), (1,)), ((), ())),
-                           preferred_element_type=jnp.float32) * scale
-    lse_row = lse_blk[0].T          # [bq, 1]
+                           preferred_element_type=jnp.float32)
+    lse_row = lse_blk[0].T          # [bq, 1], ln-domain
     delta_row = dl_blk[0].T         # [bq, 1]
-    p = jnp.exp(s_ij - lse_row)
+    p = jnp.exp2(s_ij - lse_row * _LOG2E)
     keep = None
     if masked:
         qpos = q_pos0 + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
@@ -806,7 +816,11 @@ def ring_attention_bwd(ctx: ShmemContext, q, k, v, o, lse, do,
         half = s_loc // 2 if zigzag else s_loc
         bq, bk = _tile_sizes(half, block_q, block_k)
         BH, BHkv = Bl * Hql, Bl * Hkvl
-        q3 = q_s.reshape(BH, s_loc, D)
+        # prescale q once (sm_scale·log2e) in f32, mirroring the forward —
+        # the recompute then runs the base-2 softmax with no per-element
+        # scale and the constant never rounds to the input dtype
+        q3 = (q_s.astype(jnp.float32) * (scale * _LOG2E)
+              ).astype(q_s.dtype).reshape(BH, s_loc, D)
         k3 = k_s.reshape(BHkv, s_loc, D)
         v3 = v_s.reshape(BHkv, s_loc, D)
         o3 = o_s.reshape(BH, s_loc, D)
