@@ -152,7 +152,8 @@ def bench_ag_gemm(ctx, n_dev: int, M: int, N: int, K: int, configs,
 
 def bench_a2a(ctx, tokens_per_rank: int, hidden: int, topk: int,
               num_experts: int, i1: int, i2: int,
-              wire_dtype=None) -> tuple[float, float]:
+              wire_dtype=None, dequant_edge: str = "post"
+              ) -> tuple[float, float]:
     """(dispatch_s, roundtrip_s) per call at the DeepSeek-infer A2A shape —
     the BASELINE.md second target (reference low_latency_all_to_all.py,
     README.md:55; the reference's 137 µs number is fp8+scales, which
@@ -167,7 +168,8 @@ def bench_a2a(ctx, tokens_per_rank: int, hidden: int, topk: int,
     a2a = create_all_to_all_context(ctx, max_tokens=tokens_per_rank,
                                     hidden=hidden, topk=topk,
                                     num_experts=num_experts, axis=axis,
-                                    wire_dtype=wire_dtype)
+                                    wire_dtype=wire_dtype,
+                                    dequant_edge=dequant_edge)
     T = n * tokens_per_rank
     tokens = ctx.shard(jax.random.normal(jax.random.key(0), (T, hidden),
                                          jnp.float32).astype(jnp.bfloat16),
@@ -185,7 +187,9 @@ def bench_a2a(ctx, tokens_per_rank: int, hidden: int, topk: int,
     # un-executed dispatches would otherwise hold [n,cap,H] each)
     def disp_step(t, i):
         recv_tokens, _, _ = dispatch(a2a, t, i)
-        eps = (jnp.sum(recv_tokens.astype(jnp.float32)) * 1e-20).astype(t.dtype)
+        # expert-edge dispatch returns QuantTokens — anchor on the raw q
+        rq = getattr(recv_tokens, "q", recv_tokens)
+        eps = (jnp.sum(rq.astype(jnp.float32)) * 1e-20).astype(t.dtype)
         return t + eps
 
     dispatch_s = _per_iter(make_chain_timer(disp_step, tokens, ids), i1, i2)
@@ -194,6 +198,12 @@ def bench_a2a(ctx, tokens_per_rank: int, hidden: int, topk: int,
     # timed as a data-dependent scan — immune to host-dispatch noise
     def roundtrip(t, _ids):
         recv_tokens, _, layout = dispatch(a2a, t, _ids)
+        if hasattr(recv_tokens, "q"):
+            # expert-edge identity "expert": apply the scale once, as the
+            # real expert GEMM's accumulator would (one fused pass straight
+            # to the compute dtype — never materialize f32 rows)
+            recv_tokens = (recv_tokens.q.astype(a2a.dtype)
+                           * recv_tokens.scale[..., None].astype(a2a.dtype))
         return combine(a2a, recv_tokens, layout, w)
 
     roundtrip_s = _per_iter(make_chain_timer(roundtrip, tokens, ids), i1, i2)
@@ -618,6 +628,13 @@ def main(a2a_primary: bool = False):
                            wire_dtype=jnp.float8_e4m3fn, **a2a_shape)
         extras["a2a_dispatch_fp8_us"] = round(d8 * 1e6, 1)
         extras["a2a_roundtrip_fp8_us"] = round(r8 * 1e6, 1)
+        # expert-edge protocol: dispatch hands QuantTokens to the expert
+        # GEMM (no dequant pass anywhere) — the reference's architecture
+        d8e, r8e = bench_a2a(ctx, i1=ai1, i2=ai2,
+                             wire_dtype=jnp.float8_e4m3fn,
+                             dequant_edge="expert", **a2a_shape)
+        extras["a2a_dispatch_fp8_expert_us"] = round(d8e * 1e6, 1)
+        extras["a2a_roundtrip_fp8_expert_us"] = round(r8e * 1e6, 1)
         # reference-scope wire-only numbers (its 137 µs excludes routing,
         # token scatter, quant and dequant — see bench_a2a_wire docstring)
         w16 = bench_a2a_wire(ctx, i1=ai1, i2=ai2, **a2a_shape)

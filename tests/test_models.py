@@ -128,3 +128,36 @@ def test_moe_tp_overlap_matches_dense(ctx):
     golden = jnp.sum(sel * gv[..., None], axis=1)
     assert_allclose(np.asarray(got, np.float32), np.asarray(golden),
                     atol=5e-2, rtol=5e-2)
+
+
+def test_moe_ep_overlap_expert_edge_quant(ctx):
+    """dequant_edge="expert": dispatch returns QuantTokens (wire-dtype rows
+    + scales), the expert grouped GEMMs fold the scale into their f32
+    accumulators, and the combine epilogue folds the return-trip scale into
+    its gather — no standalone dequant pass anywhere. Must agree with the
+    same wire under dequant_edge="post" within fp tolerance (the expert
+    edge is MORE precise: fp8→f32 in the MXU accumulator vs an
+    intermediate bf16 rounding)."""
+    n = ctx.num_ranks
+    T_local, D, F, E, k = 16, 128, 128, 2 * n, 2
+    T = n * T_local
+    x = (jax.random.normal(jax.random.key(7), (T, D), jnp.float32)
+         * 0.3).astype(jnp.bfloat16)
+    router_w = jax.random.normal(jax.random.key(8), (D, E),
+                                 jnp.float32) * 0.3
+    wg = (jax.random.normal(jax.random.key(9), (E, D, F)) * 0.1
+          ).astype(jnp.bfloat16)
+    wu = (jax.random.normal(jax.random.key(10), (E, D, F)) * 0.1
+          ).astype(jnp.bfloat16)
+    wd = (jax.random.normal(jax.random.key(11), (E, F, D)) * 0.1
+          ).astype(jnp.bfloat16)
+    xs = ctx.shard(x, P("x"))
+
+    outs = {}
+    for de in ("expert", "post"):
+        layer = EPAll2AllLayer.create(ctx, max_tokens=T_local, hidden=D,
+                                      topk=k, num_experts=E, axis="x",
+                                      wire_dtype=jnp.int8, dequant_edge=de)
+        outs[de] = np.asarray(jax.jit(lambda x, l=layer: moe_mlp_ep_overlap(
+            ctx, l, x, router_w, wg, wu, wd, axis="x"))(xs), np.float32)
+    assert_allclose(outs["expert"], outs["post"], atol=2e-2, rtol=2e-2)

@@ -26,7 +26,8 @@ class EPAll2AllLayer:
     @classmethod
     def create(cls, ctx: ShmemContext, max_tokens: int, hidden: int,
                topk: int, num_experts: int, capacity: int | None = None,
-               axis=None, dtype=jnp.bfloat16, wire_dtype=None):
+               axis=None, dtype=jnp.bfloat16, wire_dtype=None,
+               quant_edge: str = "fused", dequant_edge: str = "post"):
         """``wire_dtype=jnp.float8_e4m3fn`` enables the quantized wire with
         the f32 scale side-channel (the reference's fp8 showcase protocol,
         low_latency_all_to_all.py:60-88).
@@ -43,11 +44,13 @@ class EPAll2AllLayer:
                 f"2-tier A2A takes exactly (major, minor) axes, got {axes}")
             return cls(a2a_ops.create_all_to_all_context_2d(
                 ctx, max_tokens, hidden, topk, num_experts, axes=axes,
-                cap1=capacity, dtype=dtype, wire_dtype=wire_dtype))
+                cap1=capacity, dtype=dtype, wire_dtype=wire_dtype,
+                quant_edge=quant_edge, dequant_edge=dequant_edge))
         return cls(a2a_ops.create_all_to_all_context(
             ctx, max_tokens, hidden, topk, num_experts,
             capacity=capacity, axis=axis, dtype=dtype,
-            wire_dtype=wire_dtype))
+            wire_dtype=wire_dtype, quant_edge=quant_edge,
+            dequant_edge=dequant_edge))
 
     @property
     def is_2d(self) -> bool:

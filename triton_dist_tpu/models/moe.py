@@ -218,6 +218,7 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
     the dispatch/combine run the hierarchical path and ``axis`` is taken
     from the layer; ``x2d`` is P((major, minor))-sharded.
     """
+    from triton_dist_tpu.ops.all_to_all import QuantTokens
     from triton_dist_tpu.ops.group_gemm import apply_grouped, grouped_gemm
     from triton_dist_tpu.shmem import device as shd
 
@@ -237,10 +238,11 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
     gate_vals = (gate_vals / jnp.sum(gate_vals, -1, keepdims=True))
 
     recv_tok, recv_ids, layout = a2a_layer.dispatch(x2d, gate_ids)
+    quant = isinstance(recv_tok, QuantTokens)
 
     n = ctx.axis_size(group)
 
-    def expert_ffn(tok, ids, wg, wu, wd):
+    def expert_ffn(tok, ids, wg, wu, wd, *sc):
         me = shd.my_pe(group)
         H = tok.shape[-1]
         rows = 1
@@ -248,28 +250,40 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
             rows *= d
         tflat = tok.reshape(rows, H)
         iflat = ids.reshape(rows)
+        sflat = sc[0].reshape(rows) if sc else None
         wg_l = lax.dynamic_slice_in_dim(wg, me * e_local, e_local)
         wu_l = lax.dynamic_slice_in_dim(wu, me * e_local, e_local)
         wd_l = lax.dynamic_slice_in_dim(wd, me * e_local, e_local)
 
-        # gated FFN: silu(x@wg) * (x@wu) @ wd over local experts
-        def ffn(xs, be, nb):
-            g = grouped_gemm(xs, wg_l, be, block_m=128, n_blocks_used=nb)
-            u = grouped_gemm(xs, wu_l, be, block_m=128, n_blocks_used=nb)
+        # gated FFN: silu(x@wg) * (x@wu) @ wd over local experts. On the
+        # expert-edge quantized wire, xs stays fp8/int8 and the per-row
+        # scale folds into the first two GEMMs' f32 accumulators —
+        # silu(s·(q@wg)) · s·(q@wu) == the dequantized math, row scaling
+        # commutes with the matmul
+        def ffn(xs, be, nb, *ss):
+            kw = dict(block_m=128, n_blocks_used=nb)
+            if ss:
+                kw["row_scale"] = ss[0]
+                kw["out_dtype"] = a2a.dtype
+            g = grouped_gemm(xs, wg_l, be, **kw)
+            u = grouped_gemm(xs, wu_l, be, **kw)
             hh = jax.nn.silu(g.astype(jnp.float32)).astype(u.dtype) * u
             return grouped_gemm(hh, wd_l, be, block_m=128, n_blocks_used=nb)
 
-        out = apply_grouped(tflat, iflat, e_local, ffn, block_m=128)
+        out = apply_grouped(tflat, iflat, e_local, ffn, block_m=128,
+                            row_scale=sflat)
         if is_2d:
             return out.reshape(tok.shape[:-1] + (-1,))
         return out.reshape(n, tok.shape[-2], -1)
 
     w_spec = P(None, None, None)
     sm = ctx.shard_map(expert_ffn,
-                       in_specs=(shard_spec, shard_spec, w_spec, w_spec,
-                                 w_spec),
+                       in_specs=(shard_spec,) * 2 + (w_spec,) * 3
+                       + (shard_spec,) * (1 if quant else 0),
                        out_specs=shard_spec)
-    processed = sm(recv_tok, recv_ids, we_gate, we_up, we_down)
+    args = ((recv_tok.q, recv_ids, we_gate, we_up, we_down, recv_tok.scale)
+            if quant else (recv_tok, recv_ids, we_gate, we_up, we_down))
+    processed = sm(*args)
     return a2a_layer.combine(processed, layout, gate_vals)
 
 

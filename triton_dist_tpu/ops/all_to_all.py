@@ -27,6 +27,7 @@ TPU-native redesign:
 from __future__ import annotations
 
 import dataclasses
+import typing
 
 import jax
 import jax.numpy as jnp
@@ -213,6 +214,18 @@ def all_to_all_push(ctx: ShmemContext, *arrays: jax.Array,
 # MoE EP dispatch / combine
 # ---------------------------------------------------------------------------
 
+class QuantTokens(typing.NamedTuple):
+    """Quantized-wire tokens as dispatched: ``q`` [..., cap, H] in the wire
+    dtype plus the per-slot f32 ``scale`` [..., cap]. Produced by
+    ``dispatch`` under ``dequant_edge="expert"`` — the scales are meant to
+    be consumed by the expert grouped GEMM's accumulator
+    (``ops.group_gemm.grouped_gemm(row_scale=...)``), never applied in a
+    standalone pass; the reference's fp8 protocol works the same way (its
+    post_process only slices, low_latency_all_to_all.py:251-270 — scales
+    ride into the expert GEMM)."""
+    q: jax.Array
+    scale: jax.Array
+
 @dataclasses.dataclass(frozen=True)
 class EpAllToAllContext:
     """Analog of the reference's A2A context dataclass
@@ -241,7 +254,13 @@ class EpAllToAllContext:
       dequant. Measured +106-125 µs at n=1 — the pipeline's fine-grained
       (128, bn) steps cost far more than the one fused XLA pass, so
       "kernel" is only worth trying multi-chip where it overlaps waits
-      for later peers."""
+      for later peers. "expert" skips dequantization entirely:
+      ``dispatch`` returns ``QuantTokens(q, scale)`` and the expert
+      grouped GEMM folds the scale into its f32 accumulator
+      (``grouped_gemm(row_scale=...)``) — no dequant pass anywhere, and
+      the expert reads half the token bytes. This is the reference's
+      architecture (scales ride into the expert GEMM; its post_process
+      never applies them)."""
     ctx: ShmemContext
     axis: str
     max_tokens: int      # tokens per rank entering dispatch
@@ -279,7 +298,7 @@ def create_all_to_all_context(ctx: ShmemContext, max_tokens: int, hidden: int,
     n = ctx.axis_size(axis)
     assert num_experts % n == 0, (num_experts, n)
     assert quant_edge in ("pre", "fused"), quant_edge
-    assert dequant_edge in ("kernel", "post"), dequant_edge
+    assert dequant_edge in ("kernel", "post", "expert"), dequant_edge
     if capacity is None:
         capacity = max_tokens * topk  # worst case: everything to one rank
     wire_itemsize = jnp.dtype(wire_dtype or dtype).itemsize
@@ -377,7 +396,16 @@ def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
         send_buf, send_ids, send_sc, dest, slot, valid = sm(tokens, topk_ids)
     else:
         send_buf, send_ids, dest, slot, valid = sm(tokens, topk_ids)
-    if wire is not None:
+    if wire is not None and a2a.dequant_edge == "expert":
+        # no dequantization anywhere: tokens stay in the wire dtype and the
+        # scales ride alongside for the expert GEMM's accumulator
+        recv_q, recv_ids_wire, recv_sc = all_to_all_push(
+            ctx, send_buf, send_ids, send_sc, axis=axis)
+        unpack_sc = ctx.shard_map(
+            lambda w: w.reshape(n, -1)[:, :cap],
+            in_specs=P(axis), out_specs=P(axis))
+        recv_tokens = QuantTokens(q=recv_q, scale=unpack_sc(recv_sc))
+    elif wire is not None:
         # dequant at the receive edge, per the context's dequant_edge
         # policy: one post-kernel XLA pass (default) or per-arrival
         # in-kernel (multi-chip experiment: overlaps later peers' waits)
@@ -417,28 +445,42 @@ def combine(a2a: EpAllToAllContext, processed: jax.Array, layout,
 
         pq, psc = ctx.shard_map(qpack, in_specs=P(axis),
                                 out_specs=(P(axis), P(axis)))(processed)
-        back, _ = all_to_all_push(ctx, pq, psc, axis=axis,
-                                  dequant_to=a2a.dtype,
-                                  fuse_dequant=a2a._dequant_in_kernel())
+        if a2a.dequant_edge == "expert":
+            # no full-buffer dequant: the scale is gathered with the token
+            # in the combine epilogue and folded into the f32 weighted sum
+            back, back_sc = all_to_all_push(ctx, pq, psc, axis=axis)
+        else:
+            back, _ = all_to_all_push(ctx, pq, psc, axis=axis,
+                                      dequant_to=a2a.dtype,
+                                      fuse_dequant=a2a._dequant_in_kernel())
+            back_sc = None
     else:
         (back,) = all_to_all_push(ctx, processed, axis=axis)
+        back_sc = None
 
-    def gather_back(back_shard, dest, slot, valid, w):
+    def gather_back(back_shard, dest, slot, valid, w, *sc):
         # back_shard: [n, cap, H] — slot (d, c) = my token processed by rank d
         d_f = dest.reshape(-1)
         s_f = jnp.where(valid, slot, 0).reshape(-1)
         tok = back_shard[d_f, s_f]                                # [T*k, H]
-        tok = jnp.where(valid.reshape(-1)[:, None], tok, 0.0)
+        tok = jnp.where(valid.reshape(-1)[:, None], tok, 0).astype(
+            jnp.float32)
+        if sc:
+            s2d = sc[0].reshape(n, -1)[:, :cap]                   # [n, cap]
+            tok = tok * jnp.where(valid.reshape(-1), s2d[d_f, s_f],
+                                  1.0)[:, None]
         T = dest.shape[0]
-        tok = tok.reshape(T, k, H).astype(jnp.float32)
+        tok = tok.reshape(T, k, H)
         return jnp.sum(tok * w[..., None].astype(jnp.float32),
                        axis=1).astype(a2a.dtype)
 
     dest, slot, valid = layout
+    n_sc = 1 if back_sc is not None else 0
     sm = ctx.shard_map(gather_back,
-                       in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
+                       in_specs=(P(axis),) * (5 + n_sc),
                        out_specs=P(axis))
-    return sm(back, dest, slot, valid, topk_weights)
+    return sm(back, dest, slot, valid, topk_weights,
+              *((back_sc,) if back_sc is not None else ()))
 
 
 # ---------------------------------------------------------------------------
@@ -622,7 +664,10 @@ def create_all_to_all_context_2d(ctx: ShmemContext, max_tokens: int,
     n = ctx.axis_size(axes[0]) * ctx.axis_size(axes[1])
     assert num_experts % n == 0, (num_experts, n)
     assert quant_edge in ("pre", "fused"), quant_edge
-    assert dequant_edge in ("kernel", "post"), dequant_edge
+    assert dequant_edge in ("kernel", "post"), (
+        f"dequant_edge={dequant_edge!r}: the 2-tier dispatch does not "
+        "return QuantTokens yet — use the 1-tier context for the "
+        "expert-edge protocol, or 'post'/'kernel' here")
     assert hidden % 128 == 0, f"hidden={hidden} must be a lane multiple (128)"
     itemsize = jnp.dtype(wire_dtype or dtype).itemsize
     if cap1 is None:

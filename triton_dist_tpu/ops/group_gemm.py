@@ -116,9 +116,19 @@ def used_block_count(ids: jax.Array, num_experts: int, block_m: int):
     return jnp.maximum(1, jnp.sum((counts + bm - 1) // bm)).astype(jnp.int32)
 
 
+def _gemm_block(t_blk, w_blk, sc_row, out_dtype):
+    """THE grouped-GEMM accumulator body, shared by the bounded and
+    unbounded paths: f32 MXU accumulate, optional per-row dequant scale
+    fold (``sc_row`` [block_m] f32 or None), cast to ``out_dtype``."""
+    acc = jnp.dot(t_blk[...], w_blk[0], preferred_element_type=jnp.float32)
+    if sc_row is not None:
+        acc = acc * sc_row[:, None]
+    return acc.astype(out_dtype)
+
+
 def emit_grouped_gemm(t_ref, w_ref, o_ref, be_ref, base_blk,
                       block_m: int, block_n: int, out_dtype=None,
-                      n_blocks_used=None):
+                      n_blocks_used=None, sc_ref=None):
     """In-kernel pipelined grouped GEMM over HBM refs:
     ``o[i*bm:(i+1)*bm] = t[i*bm:(i+1)*bm] @ w[be_ref[base_blk + i]]``.
 
@@ -137,7 +147,10 @@ def emit_grouped_gemm(t_ref, w_ref, o_ref, be_ref, base_blk,
     ``num_tokens_post_padded`` early-exit, allgather_group_gemm.py:278-285).
     Output rows past ``n_blocks_used * block_m`` are left UNWRITTEN — the
     caller must mask by row validity (``apply_grouped`` and the fused MoE
-    unscrambles already do)."""
+    unscrambles already do).
+
+    ``sc_ref`` (optional [P // block_m, block_m] f32 ref) folds a per-row
+    dequant scale into the accumulator — see ``grouped_gemm.row_scale``."""
     import math
 
     P, H = t_ref.shape
@@ -149,11 +162,14 @@ def emit_grouped_gemm(t_ref, w_ref, o_ref, be_ref, base_blk,
     m_steps = (P // block_m if n_blocks_used is None
                else jnp.minimum(n_blocks_used, P // block_m))
 
-    def body(t_blk, w_blk, o_blk):
-        o_blk[...] = jnp.dot(t_blk[...], w_blk[0],
-                             preferred_element_type=jnp.float32
-                             ).astype(out_dtype)
+    def body(t_blk, w_blk, *rest):
+        o_blk = rest[-1]
+        sc_row = rest[0][0] if sc_ref is not None else None
+        o_blk[...] = _gemm_block(t_blk, w_blk, sc_row, out_dtype)
 
+    sc_specs = ([pl.BlockSpec((1, block_m), lambda i, j: (i, 0))]
+                if sc_ref is not None else [])
+    sc_args = (sc_ref,) if sc_ref is not None else ()
     pltpu.emit_pipeline(
         body,
         grid=(m_steps, N // block_n),
@@ -161,15 +177,16 @@ def emit_grouped_gemm(t_ref, w_ref, o_ref, be_ref, base_blk,
             pl.BlockSpec((block_m, H), lambda i, j: (i, 0)),
             pl.BlockSpec((1, H, block_n),
                          lambda i, j: (be_ref[base_blk + i], 0, j)),
-        ],
+        ] + sc_specs,
         out_specs=[pl.BlockSpec((block_m, block_n), lambda i, j: (i, j))],
-    )(t_ref, w_ref, o_ref)
+    )(t_ref, w_ref, *sc_args, o_ref)
 
 
 def grouped_gemm(tokens: jax.Array, weights: jax.Array,
                  block_expert: jax.Array, block_m: int = 128,
                  block_n: int = 128, out_dtype=None,
-                 n_blocks_used: jax.Array | None = None) -> jax.Array:
+                 n_blocks_used: jax.Array | None = None,
+                 row_scale: jax.Array | None = None) -> jax.Array:
     """``out[i*bm:(i+1)*bm] = tokens[i*bm:(i+1)*bm] @ weights[block_expert[i]]``.
 
     tokens: [P, H] (expert-aligned rows), weights: [E, H, N],
@@ -182,7 +199,15 @@ def grouped_gemm(tokens: jax.Array, weights: jax.Array,
     truncates the row-block walk at runtime, skipping the up-to-``E`` blocks
     of pure per-expert padding in the aligned layout — rows past the bound
     are returned ZEROED (callers mask by row validity anyway; zero keeps the
-    op total-function for reuse in autodiff contexts)."""
+    op total-function for reuse in autodiff contexts).
+
+    ``row_scale`` ([P] f32) folds a per-row dequantization scale into the
+    f32 accumulator: ``out_row = scale · (q_row @ w)``. Per-row scaling
+    commutes with the matmul, so quantized-wire tokens (fp8/int8 rows from
+    an EP dispatch with ``dequant_edge="expert"``) feed the MXU directly —
+    no standalone dequant pass, halved token-read bytes, and the scale is
+    applied once in f32 exactly like the reference's expert GEMM consumes
+    its scale side-channel (README.md:55 fp8 protocol)."""
     import math
 
     P, H = tokens.shape
@@ -192,15 +217,23 @@ def grouped_gemm(tokens: jax.Array, weights: jax.Array,
     # divisor, like flash_decode's block_s handling
     block_n = math.gcd(min(block_n, N), N)
     assert P % block_m == 0, (P, block_m)
-    out_dtype = out_dtype or tokens.dtype
+    out_dtype = out_dtype or (tokens.dtype if row_scale is None
+                              else jnp.bfloat16)
+    sc2d = (None if row_scale is None
+            else row_scale.astype(jnp.float32).reshape(P // block_m,
+                                                       block_m))
+    n_sc = 0 if sc2d is None else 1
 
     if n_blocks_used is None:
-        def kernel(be_ref, t_ref, w_ref, o_ref):
-            o_ref[...] = jnp.dot(t_ref[...], w_ref[0],
-                                 preferred_element_type=jnp.float32
-                                 ).astype(out_dtype)
+        def kernel(be_ref, *refs):
+            o_ref = refs[-1]
+            t_ref, w_ref = refs[:2]
+            sc_row = refs[2][0] if n_sc else None
+            o_ref[...] = _gemm_block(t_ref, w_ref, sc_row, out_dtype)
 
         grid = (P // block_m, N // block_n)
+        sc_specs = ([pl.BlockSpec((1, block_m), lambda i, j, be: (i, 0))]
+                    if n_sc else [])
         return pl.pallas_call(
             kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -210,7 +243,7 @@ def grouped_gemm(tokens: jax.Array, weights: jax.Array,
                     pl.BlockSpec((block_m, H), lambda i, j, be: (i, 0)),
                     pl.BlockSpec((1, H, block_n),
                                  lambda i, j, be: (be[i], 0, j)),
-                ],
+                ] + sc_specs,
                 out_specs=pl.BlockSpec((block_m, block_n),
                                        lambda i, j, be: (i, j)),
             ),
@@ -221,22 +254,27 @@ def grouped_gemm(tokens: jax.Array, weights: jax.Array,
                 * jnp.dtype(tokens.dtype).itemsize,
                 transcendentals=0),
             interpret=default_interpret(),
-        )(block_expert, tokens, weights)
+        )(block_expert, tokens, weights, *(() if sc2d is None else (sc2d,)))
 
     # runtime-bounded path: zero-init the output, then emit_pipeline over a
     # dynamic grid — padding blocks cost neither DMA nor MXU work
     nb = jnp.asarray(n_blocks_used, jnp.int32).reshape(1)
 
-    def kernel(be_ref, nb_ref, t_ref, w_ref, o_ref):
+    def kernel(be_ref, nb_ref, *refs):
+        o_ref = refs[-1]
+        t_ref, w_ref = refs[:2]
+        sc_ref = refs[2] if n_sc else None
         emit_grouped_gemm(t_ref, w_ref, o_ref, be_ref, 0, block_m, block_n,
-                          out_dtype, n_blocks_used=nb_ref[0])
+                          out_dtype, n_blocks_used=nb_ref[0],
+                          sc_ref=sc_ref)
 
     out = pl.pallas_call(
         kernel,
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
                   pl.BlockSpec(memory_space=pltpu.SMEM),
                   pl.BlockSpec(memory_space=pl.ANY),
-                  pl.BlockSpec(memory_space=pl.ANY)],
+                  pl.BlockSpec(memory_space=pl.ANY)]
+        + [pl.BlockSpec(memory_space=pl.ANY)] * n_sc,
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         out_shape=jax.ShapeDtypeStruct((P, N), out_dtype),
         cost_estimate=pl.CostEstimate(
@@ -245,7 +283,8 @@ def grouped_gemm(tokens: jax.Array, weights: jax.Array,
             * jnp.dtype(tokens.dtype).itemsize,
             transcendentals=0),
         interpret=default_interpret(),
-    )(block_expert, nb, tokens, weights)
+    )(block_expert, nb, tokens, weights,
+      *(() if sc2d is None else (sc2d,)))
     # rows past the bound were never written; zero them so the result is a
     # total function of the inputs
     row_blk = jnp.arange(P, dtype=jnp.int32) // block_m
@@ -254,18 +293,31 @@ def grouped_gemm(tokens: jax.Array, weights: jax.Array,
 
 
 def apply_grouped(tokens: jax.Array, ids: jax.Array, num_experts: int, fn,
-                  block_m: int = 128) -> jax.Array:
+                  block_m: int = 128,
+                  row_scale: jax.Array | None = None) -> jax.Array:
     """The shared align→gather→mask→compute→scatter-back sequence every MoE
     op needs: align rows by expert, call ``fn(x_aligned, block_expert,
     n_blocks_used) -> y_aligned`` (one or more grouped GEMMs sharing the
     alignment, runtime-bounded by the used-block count), and scatter results
     back to the original row order (invalid ids → zero rows). Returns
-    [T, N]."""
+    [T, N].
+
+    ``row_scale`` ([T] f32, quantized-wire rows): gathered through the same
+    alignment and passed to ``fn(x, block_expert, nb, scale_aligned)`` so
+    the grouped GEMMs can fold the dequant into their accumulators
+    (``grouped_gemm.row_scale``); ``tokens`` then stay in the wire dtype
+    end to end."""
     T = tokens.shape[0]
     gather_idx, row_valid, block_expert, nb = align_tokens_by_expert(
         ids, num_experts, block_m, with_used_count=True)
-    x = tokens[gather_idx] * row_valid[:, None].astype(tokens.dtype)
-    y = fn(x, block_expert, nb)
+    vmask = row_valid[:, None]
+    x = jnp.where(vmask, tokens[gather_idx], 0).astype(tokens.dtype)
+    if row_scale is not None:
+        s = jnp.where(row_valid, row_scale.astype(jnp.float32)[gather_idx],
+                      1.0)
+        y = fn(x, block_expert, nb, s)
+    else:
+        y = fn(x, block_expert, nb)
     out = jnp.zeros((T, y.shape[-1]), y.dtype)
     src = jnp.where(row_valid, gather_idx, T)
     return out.at[src].add(y * row_valid[:, None].astype(y.dtype),
