@@ -446,3 +446,27 @@ def test_dispatch_2d_quant_edge_parity(ctx2d):
     err = np.abs(outs["pre"] - np.asarray(tokens))
     scale = np.abs(np.asarray(tokens)).max(axis=-1, keepdims=True)
     assert np.max(err / (scale + 1e-6)) < 0.03, np.max(err / (scale + 1e-6))
+
+
+def test_dispatch_2d_expert_edge(ctx2d):
+    """2-tier expert-edge protocol: dispatch_2d returns QuantTokens (the
+    scale side-channel that rode both tiers), and applying the scale once
+    reproduces the "post"-edge dequantized tokens exactly — same wire
+    bits, same scales, one deferred multiply."""
+    from triton_dist_tpu.ops.all_to_all import QuantTokens
+    n, T, H, topk, E = 6, 8, 128, 2, 12
+    mk = lambda de: create_all_to_all_context_2d(
+        ctx2d, max_tokens=T, hidden=H, topk=topk, num_experts=E,
+        dtype=jnp.float32, wire_dtype=jnp.int8, dequant_edge=de)
+    tokens = jax.random.normal(jax.random.key(12), (n * T, H), jnp.float32)
+    ids = jax.random.randint(jax.random.key(13), (n * T, topk), 0, E)
+    spec = P(("a", "b"))
+    ts, is_ = ctx2d.shard(tokens, spec), ctx2d.shard(ids, spec)
+
+    qt, ids_e, lay_e = dispatch_2d(mk("expert"), ts, is_)
+    assert isinstance(qt, QuantTokens)
+    deq = np.asarray(qt.q, np.float32) * np.asarray(qt.scale)[..., None]
+    post, ids_p, _ = dispatch_2d(mk("post"), ts, is_)
+    np.testing.assert_allclose(deq, np.asarray(post, np.float32),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(ids_e), np.asarray(ids_p))

@@ -664,10 +664,7 @@ def create_all_to_all_context_2d(ctx: ShmemContext, max_tokens: int,
     n = ctx.axis_size(axes[0]) * ctx.axis_size(axes[1])
     assert num_experts % n == 0, (num_experts, n)
     assert quant_edge in ("pre", "fused"), quant_edge
-    assert dequant_edge in ("kernel", "post"), (
-        f"dequant_edge={dequant_edge!r}: the 2-tier dispatch does not "
-        "return QuantTokens yet — use the 1-tier context for the "
-        "expert-edge protocol, or 'post'/'kernel' here")
+    assert dequant_edge in ("kernel", "post", "expert"), dequant_edge
     assert hidden % 128 == 0, f"hidden={hidden} must be a lane multiple (128)"
     itemsize = jnp.dtype(wire_dtype or dtype).itemsize
     if cap1 is None:
@@ -791,10 +788,20 @@ def dispatch_2d(a2a: Ep2dAllToAllContext, tokens: jax.Array,
     sm2 = ctx.shard_map(build2, in_specs=(both,) * nw,
                         out_specs=(both,) * (nw + 3))
     *wires2, b_dst, slot2, ok2 = sm2(recv1, meta1r, *sc1r)
-    recv2, meta2r, *sc2r = all_to_all_push(
-        ctx, *wires2, axis=minor, spec=both,
-        dequant_to=a2a.dtype if wire is not None else None,
-        fuse_dequant=a2a._dequant_in_kernel())
+    if wire is not None and a2a.dequant_edge == "expert":
+        # QuantTokens out: the scale side-channel that rode both tiers is
+        # handed to the expert GEMM with the wire-dtype rows
+        recv2, meta2r, sc2w = all_to_all_push(ctx, *wires2, axis=minor,
+                                              spec=both)
+        unpack_sc = ctx.shard_map(
+            lambda w: w.reshape(nm, -1)[:, :cap2],
+            in_specs=both, out_specs=both)
+        recv2 = QuantTokens(q=recv2, scale=unpack_sc(sc2w))
+    else:
+        recv2, meta2r, *sc2r = all_to_all_push(
+            ctx, *wires2, axis=minor, spec=both,
+            dequant_to=a2a.dtype if wire is not None else None,
+            fuse_dequant=a2a._dequant_in_kernel())
 
     unpack = ctx.shard_map(
         lambda w: jnp.where(

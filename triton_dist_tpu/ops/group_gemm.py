@@ -217,8 +217,11 @@ def grouped_gemm(tokens: jax.Array, weights: jax.Array,
     # divisor, like flash_decode's block_s handling
     block_n = math.gcd(min(block_n, N), N)
     assert P % block_m == 0, (P, block_m)
+    # quantized rows can't default the output to their own (wire) dtype —
+    # follow the weights' compute dtype instead (bf16 weights → bf16 out,
+    # f32 pipeline → f32 out)
     out_dtype = out_dtype or (tokens.dtype if row_scale is None
-                              else jnp.bfloat16)
+                              else weights.dtype)
     sc2d = (None if row_scale is None
             else row_scale.astype(jnp.float32).reshape(P // block_m,
                                                        block_m))
