@@ -188,12 +188,23 @@ def _attn_step_pipeline(step_init, step_final, causal, zigzag, D, bq, bk,
             else:
                 out_blk[...] = scr[...][None]
 
+    if causal and not zigzag:
+        # fully-masked tiles are a SUFFIX of each q-row's kv sweep in the
+        # contiguous layout: clamp the kv block index to the last tile
+        # with any un-masked work, so skipped steps REVISIT the previous
+        # block instead of DMA-ing one they will never read (the pipeline
+        # skips the copy when the index is unchanged). The body still
+        # routes those steps to no-op via _causal_tile_dispatch.
+        def kv_idx(bh, qi, kvi):
+            last = jnp.maximum(
+                (q_lo - kv_lo + (qi + 1) * bq - 1) // bk, 0)
+            return (kv_head(bh), jnp.minimum(kvi, last), 0)
+    else:
+        kv_idx = lambda bh, qi, kvi: (kv_head(bh), kvi, 0)
     in_specs = [
         pl.BlockSpec((1, bq, D), lambda bh, qi, kvi: (bh, qi, 0)),
-        pl.BlockSpec((1, bk, D),
-                     lambda bh, qi, kvi: (kv_head(bh), kvi, 0)),
-        pl.BlockSpec((1, bk, D),
-                     lambda bh, qi, kvi: (kv_head(bh), kvi, 0)),
+        pl.BlockSpec((1, bk, D), kv_idx),
+        pl.BlockSpec((1, bk, D), kv_idx),
     ]
     args = [q_ref, k_src, v_src]
     if not step_init:
