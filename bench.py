@@ -364,17 +364,16 @@ def attn_sweep():
     shape = dict(s_loc=256, Hq=4, Hkv=2) if smoke else {}
     for bq, bk in tiles:
         try:
-            for attempt in range(3):
-                res = bench_attn(ctx, i1=1 if smoke else 10,
-                                 i2=3 if smoke else 210,
-                                 block_q=bq, block_k=bk, **shape)
-                t = res["attn_tflops_per_chip"]
-                if smoke or t <= 0.98 * peak:
-                    break
+            t, artifact = _plausible(
+                lambda bq=bq, bk=bk: bench_attn(
+                    ctx, i1=1 if smoke else 10, i2=3 if smoke else 210,
+                    block_q=bq, block_k=bk, **shape
+                )["attn_tflops_per_chip"],
+                frac=0.98, skip=smoke)
             line = {"block_q": bq, "block_k": bk,
                     "attn_tflops_per_chip": t,
                     "mfu_pct": round(100 * t / peak, 1)}
-            if not smoke and t > 0.98 * peak:
+            if artifact:
                 line["artifact"] = True
             print(json.dumps(line))
         except Exception as e:
@@ -469,6 +468,24 @@ def bench_decode(ctx, i1: int, i2: int, B: int = 1, Hq: int = 32,
 # model terms; until then vs_baseline for the a2a metric is
 # reference_137us / t_model(32) — i.e. >1 means the model predicts beating
 # the reference's published number on same-scale hardware.
+def _plausible(measure, frac: float, skip: bool = False,
+               attempts: int = 3) -> tuple[float, bool]:
+    """Re-measure a per-chip TFLOP/s reading that exceeds ``frac`` of the
+    dense peak — the shared dev chip's heavy-tailed interference
+    occasionally lands a differenced reading ABOVE the hardware peak
+    (observed 98-102% "MFU"), which is an artifact, not a measurement.
+    Returns (value, artifact_flag); the flag is True only if every attempt
+    was impossible. One guard for both the headline and the attention
+    sweep (``frac`` differs: 0.95 headline — legit peak ≈ 91% MFU — vs
+    0.98 attention)."""
+    cap = frac * chip_peak_tflops()
+    for _ in range(attempts):
+        t = measure()
+        if skip or t <= cap:
+            return t, False
+    return t, True
+
+
 _ICI_EGRESS_GBS = 180.0
 _HOP_US = 1.0
 _REFERENCE_DISPATCH_US = 137.0   # 32x H800 (reference README.md:55)
@@ -580,10 +597,14 @@ def main(a2a_primary: bool = False):
 
     ctx = initialize_distributed(axis_names=("x",), mesh_shape=(n_dev,))
 
-    best_s = bench_ag_gemm(ctx, n_dev, M, N, K, configs, i1, i2)
-    assert best_s < float("inf") and best_s > 0, (
-        f"no benchmark config ran (best_s={best_s})")
-    tflops = (2.0 * M * N * K / best_s) / max(n_dev, 1) / 1e12
+    def measure_headline():
+        best_s = bench_ag_gemm(ctx, n_dev, M, N, K, configs, i1, i2)
+        assert best_s < float("inf") and best_s > 0, (
+            f"no benchmark config ran (best_s={best_s})")
+        return (2.0 * M * N * K / best_s) / max(n_dev, 1) / 1e12
+
+    tflops, artifact = _plausible(measure_headline, frac=0.95,
+                                  skip=on_cpu())
     baseline = 0.6 * chip_peak_tflops()
 
     extras = {}
@@ -672,6 +693,11 @@ def main(a2a_primary: bool = False):
     except Exception as e:
         extras["a2a_fp8_error"] = f"{type(e).__name__}: {e}"[:200]
 
+    if artifact:
+        # three impossible readings in a row: report, but flagged so no
+        # consumer banks a >peak number as a measurement
+        extras["artifact"] = ("reading exceeds 95% of dense peak after 3 "
+                              "attempts (interference artifact)")
     result = {
         "metric": "ag_gemm_tflops_per_chip",
         "value": round(tflops, 2),
@@ -732,6 +758,8 @@ def _record_healthy(result: dict) -> None:
         return  # a CPU smoke must not clobber the chip reference
     if any(k.endswith("error") for k in result.get("extras", {})):
         return
+    if "artifact" in result.get("extras", {}):
+        return  # an impossible reading must not become the reference
     try:
         with open(_last_healthy_path(), "w") as f:
             json.dump({**result, "recorded_unix_time": int(time.time())}, f)
