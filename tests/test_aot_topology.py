@@ -454,3 +454,27 @@ def test_fused_sp_decode_lowers_8dev(ctx1d):
     kv = sds(ctx1d, (B,), P(), jnp.int32)
     compile_ok(lambda *a: sp_gqa_flash_decode(ctx1d, *a, ag_method="fused"),
                q, k, v, kv)
+
+
+@pytest.fixture(scope="module")
+def ctx_single(topo):
+    """1-device mesh carved from the same topology: the n=1 causal
+    contiguous path (flat valid-tile walk over SMEM tile maps) only
+    activates at axis size 1."""
+    from jax.experimental import topologies
+    mesh1 = jax.sharding.Mesh(topologies.make_mesh(
+        topo, (N8,), ("x",)).devices[:1], ("x",))
+    return ShmemContext(mesh=mesh1)
+
+
+def test_ring_attention_flat_walk_lowers_1dev(ctx_single):
+    """n=1 causal flat walk: Mosaic must accept the SMEM tile-map inputs
+    and the dynamic qi_ref[t]/kvi_ref[t] index maps in the 1-D pipeline
+    (interpret mode does not model either constraint)."""
+    from triton_dist_tpu.ops.ring_attention import ring_attention
+    B, Hq, Hkv, S, D = 1, 4, 2, 1024, 128
+    q = sds(ctx_single, (B, Hq, S, D), P(None, None, "x"), jnp.bfloat16)
+    kv = sds(ctx_single, (B, Hkv, S, D), P(None, None, "x"), jnp.bfloat16)
+    compile_ok(lambda a, b, c: ring_attention(
+        ctx_single, a, b, c, axis="x", causal=True,
+        block_q=256, block_k=256), q, kv, kv)

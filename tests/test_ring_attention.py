@@ -12,7 +12,8 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from conftest import TEST_WORLD
-from triton_dist_tpu.ops.ring_attention import ring_attention
+from triton_dist_tpu.ops.ring_attention import (ring_attention,
+                                                 ring_attention_fwd)
 from triton_dist_tpu.shmem.context import initialize_distributed
 from triton_dist_tpu.utils import assert_allclose
 
@@ -150,3 +151,31 @@ def test_ring_attention_zigzag_grad(ctx):
     for got, want in zip(g_ring, g_dense):
         assert_allclose(np.asarray(got)[:, :, inv], np.asarray(want),
                         atol=5e-3, rtol=5e-3)
+
+
+def test_single_chip_causal_flat_walk():
+    """n=1 causal contiguous takes the flat valid-tile walk (SMEM tile
+    maps; fully-masked tiles never become grid steps) — must match the
+    dense causal golden exactly in interpret mode, for tile shapes where
+    the triangle is ragged (bq != bk)."""
+    import math
+    ctx1 = initialize_distributed(axis_names=("x",), mesh_shape=(1,))
+    B, Hq, Hkv, S, D = 1, 4, 2, 512, 128
+    q = jax.random.normal(jax.random.key(0), (B, Hq, S, D), jnp.float32) * 0.5
+    k = jax.random.normal(jax.random.key(1), (B, Hkv, S, D), jnp.float32) * 0.5
+    v = jax.random.normal(jax.random.key(2), (B, Hkv, S, D), jnp.float32) * 0.5
+    for bq, bk in ((128, 128), (256, 128), (128, 256)):
+        out, lse = ring_attention_fwd(ctx1, q, k, v, axis="x", causal=True,
+                                      block_q=bq, block_k=bk)
+        g = Hq // Hkv
+        kf = np.repeat(np.asarray(k), g, 1)
+        vf = np.repeat(np.asarray(v), g, 1)
+        s = np.einsum("bhqd,bhkd->bhqk", np.asarray(q), kf) / math.sqrt(D)
+        s = np.where(np.tril(np.ones((S, S), bool)), s, -1e30)
+        m = s.max(-1, keepdims=True)
+        p = np.exp(s - m)
+        l = p.sum(-1, keepdims=True)
+        gold = np.einsum("bhqk,bhkd->bhqd", p / l, vf)
+        gold_lse = (m + np.log(l))[..., 0]
+        assert_allclose(np.asarray(out), gold, atol=2e-3, rtol=2e-3)
+        assert_allclose(np.asarray(lse), gold_lse, atol=2e-3, rtol=2e-3)

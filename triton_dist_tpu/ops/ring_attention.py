@@ -85,7 +85,7 @@ def _causal_tile_dispatch(q_t, kv_t, bq, bk, compute):
 def _attn_step_pipeline(step_init, step_final, causal, zigzag, D, bq, bk,
                         offs, BH, Hq, Hkv, S, scr,
                         q_ref, k_src, v_src, st_in, st_out,
-                        o_ref, lse_ref, out_dtype):
+                        o_ref, lse_ref, out_dtype, flat=None):
     """One ring step's blockwise attention: grid (head, q-tile, kv-tile),
     kv innermost. The running [acc ‖ m ‖ l] state accumulates in the
     ``scr`` VMEM scratch (never HBM) across the kv sweep; only at the last
@@ -105,12 +105,24 @@ def _attn_step_pipeline(step_init, step_final, causal, zigzag, D, bq, bk,
     scale (saves one VPU op per score element) nor pays natural-exp
     pricing: the running softmax runs in base 2 (``exp2``, the
     transcendental unit's native base); the lse residual converts back to
-    the ln domain on the way out."""
+    the ln domain on the way out.
+
+    ``flat`` (optional ``(n_tiles, qi_ref, kvi_ref)`` with the maps in
+    SMEM) replaces the rectangular (q-tile, kv-tile) grid with a
+    1-D walk over VALID tiles only — the single-step causal-contiguous
+    case (n=1 prefill) otherwise burns a grid step (block bookkeeping,
+    dispatch branches) on every fully-masked tile: ~37% of the grid at
+    square tiles. The same scalar-prefetch pattern as the grouped GEMM's
+    block-expert map. Only meaningful when this step is both first and
+    last (the maps encode the whole triangle)."""
     g = Hq // Hkv
     W = D + 256  # acc lanes ‖ m lanes ‖ l lanes
     q_lo, q_hi, kv_lo, kv_hi = offs
     c = S // 2 if zigzag else S
     nkv = S // bk
+    if flat is not None:
+        assert step_init and step_final, "flat walk encodes one whole step"
+        n_tiles, qi_ref, kvi_ref = flat
 
     def kv_head(bh):
         return (bh // Hq) * Hkv + (bh % Hq) // g
@@ -123,8 +135,16 @@ def _attn_step_pipeline(step_init, step_final, causal, zigzag, D, bq, bk,
             in_blk, (out_blk,) = None, st
         else:
             in_blk, out_blk = st
-        kvi = pl.program_id(2)
-        qi = pl.program_id(1)
+        if flat is not None:
+            t = pl.program_id(1)
+            qi, kvi = qi_ref[t], kvi_ref[t]
+            # last valid kv tile of this q row — same formula that built
+            # the tile list, so the flush point cannot drift from it
+            last_of_q = kvi == ((qi + 1) * bq - 1) // bk
+        else:
+            kvi = pl.program_id(2)
+            qi = pl.program_id(1)
+            last_of_q = kvi == nkv - 1
 
         @pl.when(kvi == 0)
         def _():
@@ -173,11 +193,13 @@ def _attn_step_pipeline(step_init, step_final, causal, zigzag, D, bq, bk,
             scr[:, D + 128:] = jnp.broadcast_to(l_c, (bq, 128))
 
         if causal:
+            # (under ``flat`` every enumerated tile has work; the dispatch
+            # still routes interior tiles to the mask-free body)
             _causal_tile_dispatch(q_t, kv_t, bq, bk, compute)
         else:
             compute(False)
 
-        @pl.when(kvi == nkv - 1)
+        @pl.when(last_of_q)
         def _():
             if step_final:
                 # fused epilogue — ln-domain lse for the backward/combine
@@ -187,6 +209,24 @@ def _attn_step_pipeline(step_init, step_final, causal, zigzag, D, bq, bk,
                 lse_blk[...] = lse[None]
             else:
                 out_blk[...] = scr[...][None]
+
+    if flat is not None:
+        pltpu.emit_pipeline(
+            body,
+            grid=(BH, n_tiles),
+            in_specs=[
+                pl.BlockSpec((1, bq, D), lambda bh, t: (bh, qi_ref[t], 0)),
+                pl.BlockSpec((1, bk, D),
+                             lambda bh, t: (kv_head(bh), kvi_ref[t], 0)),
+                pl.BlockSpec((1, bk, D),
+                             lambda bh, t: (kv_head(bh), kvi_ref[t], 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bq, D), lambda bh, t: (bh, qi_ref[t], 0)),
+                pl.BlockSpec((1, 1, bq), lambda bh, t: (bh, 0, qi_ref[t])),
+            ],
+        )(q_ref, k_src, v_src, o_ref, lse_ref)
+        return
 
     if causal and not zigzag:
         # fully-masked tiles are a SUFFIX of each q-row's kv sweep in the
@@ -274,11 +314,19 @@ def _epilogue_pipeline(D, bq, BH, S, st_src, o_ref, lse_ref):
     )(st_src, o_ref, lse_ref)
 
 
-def _ring_fwd_kernel(axis, mesh_axes, causal, zigzag,
-                     cfg_bq, cfg_bk, Hq, Hkv,
-                     q_ref, k_ref, v_ref, o_ref, lse_ref,
-                     st0, st1, kv_slots,
-                     send_sems, recv_sems, ack_sem, state_scr):
+def _ring_fwd_kernel(axis, mesh_axes, causal, zigzag, flat_tiles,
+                     cfg_bq, cfg_bk, Hq, Hkv, *refs):
+    if flat_tiles is not None:
+        # single-step flat walk (n=1 causal contiguous): the two SMEM
+        # tile maps ride as extra inputs after v
+        (q_ref, k_ref, v_ref, qi_map, kvi_map,
+         o_ref, lse_ref, st0, st1, kv_slots,
+         send_sems, recv_sems, ack_sem, state_scr) = refs
+        flat = (flat_tiles, qi_map, kvi_map)
+    else:
+        (q_ref, k_ref, v_ref, o_ref, lse_ref, st0, st1, kv_slots,
+         send_sems, recv_sems, ack_sem, state_scr) = refs
+        flat = None
     me = shd.my_pe(axis)
     n = shd.n_pes(axis)
     BH, S, D = q_ref.shape
@@ -328,7 +376,7 @@ def _ring_fwd_kernel(axis, mesh_axes, causal, zigzag,
             _attn_step_pipeline, s == 0, s == n - 1, causal, zigzag, D, bq,
             bk, q_offs + kv_offs, BH, Hq, Hkv, S, state_scr,
             q_ref, k_src, v_src, st_in, st_out, o_ref, lse_ref,
-            o_ref.dtype)
+            o_ref.dtype, flat=flat)
         if causal and not zigzag and s > 0:
             # contiguous layout: src > me ⇒ every kv position is beyond
             # every q position — skip the whole pipeline. Intermediate
@@ -460,8 +508,25 @@ def ring_attention_fwd(ctx: ShmemContext, q: jax.Array, k: jax.Array,
         k3 = k_s.reshape(BHkv, s_loc, D)
         v3 = v_s.reshape(BHkv, s_loc, D)
         W = D + 256
+        flat_args = ()
+        flat_specs = []
+        flat_n = None
+        if causal and not zigzag and n == 1:
+            # single-chip causal prefill: enumerate the valid (q, kv)
+            # tiles once (static — the triangle is fixed at n=1) and walk
+            # them as a 1-D grid with SMEM maps; fully-masked tiles never
+            # become grid steps (see _attn_step_pipeline's ``flat``)
+            import numpy as np
+            tiles = [(qi, kv)
+                     for qi in range(s_loc // bq)
+                     for kv in range(((qi + 1) * bq - 1) // bk + 1)]
+            flat_n = len(tiles)
+            qi_m = np.array([t[0] for t in tiles], np.int32)
+            kvi_m = np.array([t[1] for t in tiles], np.int32)
+            flat_args = (jnp.asarray(qi_m), jnp.asarray(kvi_m))
+            flat_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] * 2
         kernel = lambda *refs: _ring_fwd_kernel(
-            axis, mesh_axes, causal, zigzag, bq, bk, Hql, Hkvl,
+            axis, mesh_axes, causal, zigzag, flat_n, bq, bk, Hql, Hkvl,
             *refs)
         out, lse, *_ = pl.pallas_call(
             kernel,
@@ -472,7 +537,7 @@ def ring_attention_fwd(ctx: ShmemContext, q: jax.Array, k: jax.Array,
                 jax.ShapeDtypeStruct((BH, s_loc, W), jnp.float32),  # st1
                 jax.ShapeDtypeStruct((2, BHkv, s_loc, 2 * D), k_s.dtype),
             ),
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3 + flat_specs,
             out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 5,
             scratch_shapes=[
                 pltpu.SemaphoreType.DMA((2,)),
@@ -491,7 +556,7 @@ def ring_attention_fwd(ctx: ShmemContext, q: jax.Array, k: jax.Array,
                                 + BH * s_loc * D) * q_s.dtype.itemsize,
                 transcendentals=BH * s_loc * n * s_loc),
             interpret=default_interpret(),
-        )(q3, k3, v3)
+        )(q3, k3, v3, *flat_args)
         return (out.reshape(Bl, Hql, s_loc, D),
                 lse.reshape(Bl, Hql, s_loc))
 
