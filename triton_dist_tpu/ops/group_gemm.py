@@ -13,7 +13,6 @@ hand-written CUDA kernel: it runs on the VPU inside the same jit.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
