@@ -256,3 +256,30 @@ def test_quantized_wire_edge_strategies(ctx, quant_edge, dequant_edge):
                     np.asarray(gold, np.float32), atol=1e-6, rtol=1e-6)
     assert_allclose(np.asarray(out, np.float32),
                     np.asarray(tokens, np.float32), rtol=0.15, atol=0.15)
+
+
+def test_expected_capacity_sizing(ctx):
+    """expected_capacity gives a tuned per-pair slot budget (balanced load
+    × headroom, wire-tile rounded) and composes with the context + dispatch
+    without drops under balanced routing."""
+    from triton_dist_tpu.ops.all_to_all import expected_capacity
+    n = ctx.num_ranks
+    T_loc, topk = 32, 2
+    cap = expected_capacity(n, T_loc, topk, headroom=2.0)
+    assert cap < T_loc * topk          # strictly below the worst case
+    assert cap % 16 == 0               # bf16 wire tile rounding
+    assert expected_capacity(n, T_loc, topk, wire_dtype=jnp.int8) % 32 == 0
+    # small n: clamped to the drop-proof worst case, never beyond
+    assert expected_capacity(1, T_loc, topk, headroom=2.0) == T_loc * topk
+
+    a2a = create_all_to_all_context(ctx, max_tokens=T_loc, hidden=128,
+                                    topk=topk, num_experts=n,
+                                    capacity=cap, axis="x")
+    T = n * T_loc
+    tokens = jnp.ones((T, 128), jnp.bfloat16)
+    # balanced routing: expert e for row r = r % n (== rank r % n)
+    ids = (jnp.arange(T)[:, None] + jnp.arange(topk)[None, :]) % n
+    _, recv_ids, (dest, slot, valid) = jax.jit(
+        lambda t, i: dispatch(a2a, t, i))(
+        ctx.shard(tokens, P("x")), ctx.shard(ids.astype(jnp.int32), P("x")))
+    assert bool(jnp.all(valid)), "balanced routing must not drop at 2x headroom"

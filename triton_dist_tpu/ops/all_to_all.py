@@ -487,6 +487,25 @@ def combine(a2a: EpAllToAllContext, processed: jax.Array, layout,
 # 2-tier hierarchical EP dispatch / combine (multi-axis mesh: DCN x ICI)
 # ---------------------------------------------------------------------------
 
+def expected_capacity(n_ranks: int, max_tokens: int, topk: int,
+                      headroom: float = 2.0, wire_dtype=None) -> int:
+    """Per-(src, dst) slot budget sized to EXPECTED load instead of the
+    worst case: balanced routing sends ``max_tokens·topk/n`` rows to each
+    peer; ``headroom`` (default 2×) absorbs routing skew, and the result
+    is rounded to the wire dtype's sublane tile. The default capacity
+    (``max_tokens·topk`` per pair) is drop-proof but pads the wire n×
+    beyond the actual bytes at scale — the per-link latency model
+    (docs/benchmarks.md) assumes a tuned capacity like this one. Tokens
+    routed beyond capacity are dropped (standard expert-capacity
+    semantics), so pick ``headroom`` to taste for the workload's skew."""
+    cap = max(1, int(max_tokens * topk * headroom / max(n_ranks, 1)))
+    itemsize = jnp.dtype(wire_dtype).itemsize if wire_dtype is not None else 2
+    # never exceed the drop-proof worst case (at n <= headroom the scaled
+    # budget would otherwise pad BEYOND everything-to-one-peer)
+    return min(_cap_round(cap, itemsize),
+               _cap_round(max_tokens * topk, itemsize))
+
+
 def _cap_round(cap: int, wire_itemsize: int = 2) -> int:
     """Round a slot capacity up to the wire dtype's sublane tile (8 rows ×
     4 bytes: 8 for f32, 16 for bf16, 32 for fp8/int8) so [capacity, hidden]
