@@ -367,12 +367,20 @@ def bench_ep_block(ctx, i1: int, i2: int, T: int = 128, D: int = 7168,
     wd = (jax.random.normal(jax.random.key(4), (E, F, D)) * 0.05
           ).astype(jnp.bfloat16)
 
-    def step(xx, w):
-        y = moe_mlp_ep_overlap(ctx, layer, xx, w[0], w[1], w[2], w[3],
+    def step(c, w):
+        # tokens stay STATIC (+ a vanishing carry term): the chain timer
+        # decays its carry by 0.01/iter, and a decaying token carry would
+        # collapse the router to all-tie logits — the bounded grouped GEMM
+        # then measures a degenerate concentrated routing, not the
+        # balanced serving block. The scalar carry keeps the data
+        # dependency without perturbing the top-k picks.
+        toks = w[4] + c.astype(jnp.bfloat16)
+        y = moe_mlp_ep_overlap(ctx, layer, toks, w[0], w[1], w[2], w[3],
                                axis=axis)
-        return xx + (y * jnp.asarray(1e-20, y.dtype)).astype(xx.dtype)
+        return jnp.max(y.astype(jnp.float32)) * 1e-20
 
-    return _per_iter(make_chain_timer(step, x, (rw, wg, wu, wd)), i1, i2)
+    return _per_iter(make_chain_timer(
+        step, jnp.zeros((), jnp.float32), (rw, wg, wu, wd, x)), i1, i2)
 
 
 def attn_sweep():
@@ -647,43 +655,66 @@ def main(a2a_primary: bool = False):
     baseline = 0.6 * chip_peak_tflops()
 
     extras = {}
+
+    def attempt(label, fn):
+        """Run a sub-benchmark; retry ONCE iff the failure matches the
+        remote-compile service's transient HTTP 5xx signature (seen twice
+        on 2026-07-31 — one retry must not blemish the round record).
+        Deterministic failures surface immediately with the FIRST error;
+        a double transient records the first error too."""
+        try:
+            fn()
+            return
+        except Exception as e:
+            first = f"{type(e).__name__}: {e}"[:200]
+            if "remote_compile" not in str(e):
+                extras[f"{label}_error"] = first
+                return
+        try:
+            fn()
+        except Exception:
+            extras[f"{label}_error"] = first
+
     # per-call a2a/decode latencies are tens of µs; the chain spread must be
     # wider than the GEMM bench's for the differenced signal to clear the
     # ~50 ms tunnel jitter
     ai1, ai2 = (i1, i2) if on_cpu() else (10, 1610)
-    try:
+
+    def _a2a():
         dispatch_s, roundtrip_s = bench_a2a(ctx, i1=ai1, i2=ai2, **a2a_shape)
         extras["a2a_dispatch_us"] = round(dispatch_s * 1e6, 1)
         extras["a2a_roundtrip_us"] = round(roundtrip_s * 1e6, 1)
-    except Exception as e:  # a2a failure must not sink the primary metric
-        extras["a2a_error"] = f"{type(e).__name__}: {e}"[:200]
-    try:
+
+    attempt("a2a", _a2a)
+
+    def _decode():
         # decode per-call latency is tens of µs, so the spread must be wider
         # than the GEMM bench's for the differenced signal to clear the
-        # ~50 ms tunnel jitter
+        # ~50 ms tunnel jitter (target ≥ ~100 ms of differenced signal)
         dec_shape = (dict(s_local=256, Hq=8, Hkv=2)
                      if on_cpu() else dict(s_local=4096))
-        # target ≥ ~100 ms of differenced signal at tens-of-µs per call
         di1, di2 = (i1, i2) if on_cpu() else (10, 3610)
         extras.update(bench_decode(ctx, i1=di1, i2=di2, **dec_shape))
-    except Exception as e:
-        extras["decode_error"] = f"{type(e).__name__}: {e}"[:200]
-    try:
+
+    attempt("decode", _decode)
+
+    def _attn():
         ash = dict(s_loc=256, Hq=4, Hkv=2) if on_cpu() else {}
         extras.update(bench_attn(ctx, i1=i1, i2=i2, **ash))
-    except Exception as e:
-        extras["attn_error"] = f"{type(e).__name__}: {e}"[:200]
-    try:
+
+    attempt("attn", _attn)
+
+    def _moe():
         msh = (dict(tokens_rows=64, hidden=256, n_out=256, num_experts=8)
                if on_cpu() else {})
         mi1, mi2 = (i1, i2) if on_cpu() else (10, 1610)
         extras.update(bench_moe(ctx, i1=mi1, i2=mi2, **msh))
-    except Exception as e:
-        extras["moe_error"] = f"{type(e).__name__}: {e}"[:200]
-    try:
-        # end-to-end EP MoE serving block (reference
-        # test_ep_moe_inference parity: router → dispatch → grouped gated
-        # FFN → combine)
+
+    attempt("moe", _moe)
+
+    def _ep_block():
+        # end-to-end EP MoE serving block (reference test_ep_moe_inference
+        # parity: router → dispatch → grouped gated FFN → combine)
         if on_cpu():
             esh = dict(T=16, D=256, F=128, E=8, topk=2)
             ei1, ei2 = i1, i2
@@ -692,9 +723,10 @@ def main(a2a_primary: bool = False):
             ei1, ei2 = 10, 210
         s = bench_ep_block(ctx, i1=ei1, i2=ei2, **esh)
         extras["moe_ep_block_us"] = round(s * 1e6, 1)
-    except Exception as e:
-        extras["ep_block_error"] = f"{type(e).__name__}: {e}"[:200]
-    try:
+
+    attempt("ep_block", _ep_block)
+
+    def _fp8():
         # fp8 wire + scale side-channel — the reference's showcase protocol.
         # At n=1 this measures pure quantize/dequant overhead (no wire to
         # shrink); the halved wire bytes only pay off multi-chip.
@@ -743,8 +775,8 @@ def main(a2a_primary: bool = False):
                          "(test_all_to_all.py:313-348); _e2e seed adds "
                          "routing+gather+quant+dequant edges",
             }
-    except Exception as e:
-        extras["a2a_fp8_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    attempt("a2a_fp8", _fp8)
 
     if artifact:
         # three impossible readings in a row: report, but flagged so no
