@@ -115,7 +115,7 @@ def bench_ag_gemm(ctx, n_dev: int, M: int, N: int, K: int, configs,
     ws0 = create_ag_gemm_workspace(ctx, M // n_dev, K, jnp.bfloat16,
                                    axis="x")
 
-    best_s = float("inf")
+    best_s, best_cfg = float("inf"), None
     for cfg in configs:
         if (M // n_dev) % cfg.block_m or (N // n_dev) % cfg.block_n:
             continue
@@ -144,10 +144,12 @@ def bench_ag_gemm(ctx, n_dev: int, M: int, N: int, K: int, configs,
                     cache[iters] = jax.jit(chain)
                 return float(cache[iters](a_s, b_s, ws0))
 
-            best_s = min(best_s, _per_iter(timer, i1, i2))
+            s = _per_iter(timer, i1, i2)
+            if s < best_s:
+                best_s, best_cfg = s, cfg
         except Exception:
             continue
-    return best_s
+    return best_s, best_cfg
 
 
 def bench_a2a(ctx, tokens_per_rank: int, hidden: int, topk: int,
@@ -212,7 +214,7 @@ def bench_a2a(ctx, tokens_per_rank: int, hidden: int, topk: int,
 
 def bench_a2a_wire(ctx, tokens_per_rank: int, hidden: int, topk: int,
                    num_experts: int, i1: int, i2: int,
-                   wire_dtype=None) -> float:
+                   wire_dtype=None, clamp: bool = True) -> float:
     """Wire-collective-only dispatch seconds — the REFERENCE's timed
     region. Its 137 µs times ``fast_all_to_all`` alone: token
     scatter/duplication, routing, and quantization are built OUTSIDE the
@@ -278,6 +280,11 @@ def bench_a2a_wire(ctx, tokens_per_rank: int, hidden: int, topk: int,
 
     t1 = _per_iter(timer_for(1), i1, i2)
     t9 = _per_iter(timer_for(9), i1, i2)
+    if not clamp:
+        # raw differenced marginal push — may be noise-negative at small
+        # payloads; the payload-scaling FIT (bench_a2a_wire_fit) is the
+        # seed path, this raw form is its per-point measurement
+        return (t9 - t1) / 8
     # at the DeepSeek shape the wire buffers are VMEM-resident and the
     # marginal push (~1-2 µs: launch + barrier + VMEM copy) sits BELOW the
     # tunnel's differencing noise floor — clamp to the separately measured
@@ -285,6 +292,67 @@ def bench_a2a_wire(ctx, tokens_per_rank: int, hidden: int, topk: int,
     # zero-cost wire (scripts/wire_probe.py and the 56 MiB scaling run
     # establish both the floor and that larger payloads measure true)
     return max((t9 - t1) / 8, _WIRE_FLOOR_US * 1e-6)
+
+
+def _wire_bytes(n: int, tokens_per_rank: int, hidden: int, topk: int,
+                wire_dtype) -> int:
+    """Total bytes one ``all_to_all_push`` moves PER DEVICE at this shape:
+    the local wire arrays are [n, cap, …] (one slot per peer — global
+    [n·n, …] sharded over the n devices), each read once and written once
+    (payload + id wire + optional f32 scale wire)."""
+    from triton_dist_tpu.ops.all_to_all import _cap_round, _id_cols
+    itemsize = jnp.dtype(wire_dtype or jnp.bfloat16).itemsize
+    cap = _cap_round(tokens_per_rank * topk, itemsize)
+    idc = _id_cols(cap)
+    b = n * (cap * hidden * itemsize + idc * 4)
+    if wire_dtype is not None:
+        b += n * idc * 4
+    return 2 * b
+
+
+def bench_a2a_wire_fit(ctx, tokens_per_rank: int, hidden: int, topk: int,
+                       num_experts: int, i1: int, i2: int,
+                       wire_dtype=None,
+                       multipliers=(1, 4, 8)) -> dict:
+    """Wire seed WITHOUT the noise-floor clamp (VERDICT r4 #5): measure the
+    marginal push at 1×/4×/8× payload (the larger points resolve real
+    traffic — the 56 MiB scaling run showed cost scales with bytes), fit
+    ``t = t0 + bytes/BW`` by least squares, and evaluate the fit at the 1×
+    payload. Returns the fitted seed plus every fit term and the relative
+    residual at the largest (best-resolved) point, so a multi-chip run can
+    falsify the model from the recorded artifacts."""
+    import numpy as np
+
+    n = ctx.axis_size(ctx.axis_names[0])
+    ts, bs = [], []
+    for m in multipliers:
+        # keep the differenced signal duration roughly constant: bigger
+        # payloads need fewer chain iterations to clear the tunnel jitter
+        scale = max(1, m // 2)
+        t = bench_a2a_wire(ctx, tokens_per_rank * m, hidden, topk,
+                           num_experts, i1, max(i1 + 20, i2 // scale),
+                           wire_dtype=wire_dtype, clamp=False)
+        ts.append(t)
+        bs.append(_wire_bytes(n, tokens_per_rank * m, hidden, topk,
+                              wire_dtype))
+    A = np.vstack([np.ones(len(bs)), np.asarray(bs, np.float64)]).T
+    (t0, per_byte), *_ = np.linalg.lstsq(A, np.asarray(ts, np.float64),
+                                         rcond=None)
+    # physics floor: negative intercept/slope = noise won the fit; floor
+    # at zero rather than ever crediting negative wire cost
+    t0 = max(t0, 0.0)
+    per_byte = max(per_byte, 0.0)
+    seed_s = t0 + per_byte * bs[0]
+    pred_big = t0 + per_byte * bs[-1]
+    residual = abs(pred_big - ts[-1]) / max(abs(ts[-1]), 1e-12)
+    return {
+        "wire_us": round(seed_s * 1e6, 2),
+        "t0_us": round(t0 * 1e6, 2),
+        "gb_per_s": (round(1e-9 / per_byte, 1) if per_byte > 0 else None),
+        "points_us": [round(t * 1e6, 2) for t in ts],
+        "points_mb": [round(b / 1e6, 1) for b in bs],
+        "fit_residual_big": round(residual, 3),
+    }
 
 
 def bench_moe(ctx, i1: int, i2: int, tokens_rows: int = 1024,
@@ -381,6 +449,86 @@ def bench_ep_block(ctx, i1: int, i2: int, T: int = 128, D: int = 7168,
 
     return _per_iter(make_chain_timer(
         step, jnp.zeros((), jnp.float32), (rw, wg, wu, wd, x)), i1, i2)
+
+
+def bench_baselines(ctx, n_dev: int, M: int, N: int, K: int, cfg,
+                    i1: int, i2: int) -> dict:
+    """Non-overlap baselines at the headline shape (VERDICT r4 Missing #1 —
+    every reference perf claim is a comparison against torch+NCCL / FLUX
+    non-overlapped rows, README.md:146-163):
+
+    - ``xla_ag_dot``: plain XLA `all_gather` + `dot` under jit (GSPMD) —
+      what a user gets with sharding annotations and no custom kernel. At
+      n=1 the all_gather is the identity, so this row is XLA's own dense
+      matmul.
+    - ``pallas_matmul``: the bare Pallas GEMM pipeline (``ops.gemm.matmul``)
+      with the same tile config the overlap kernel picked — isolates the
+      GEMM engine from the overlap protocol (n=1 only: the row exists to
+      show the ag_gemm number is not "just a good matmul" hiding comm).
+    - ``ag_gemm_serial``: the overlap kernel with ``TDT_SERIAL=1`` (every
+      put completes inline before compute proceeds — comm serialized
+      against compute). At n=1 there are no remote puts, so this row
+      documents the degenerate equality; at n>1 it is the
+      overlap-disabled twin the reference plots against.
+    """
+    import os
+
+    from triton_dist_tpu.ops.gemm import matmul
+
+    a = jax.random.normal(jax.random.key(0), (M, K), jnp.float32
+                          ).astype(jnp.bfloat16)
+    b = jax.random.normal(jax.random.key(1), (K, N), jnp.float32
+                          ).astype(jnp.bfloat16)
+    out = {}
+
+    def tflops(s):
+        return round(2.0 * M * N * K / s / max(n_dev, 1) / 1e12, 1)
+
+    # 1. plain XLA all_gather + dot (GSPMD) — the no-custom-kernel row
+    a_s = ctx.shard(a, P("x"))
+    b_s = ctx.shard(b, P(None, "x"))
+
+    def f(xs, ws):
+        xg = lax.all_gather(xs, "x", axis=0, tiled=True)
+        return (xg @ ws).astype(jnp.bfloat16)
+
+    sm = ctx.shard_map(f, in_specs=(P("x"), P(None, "x")),
+                       out_specs=P(None, "x"))
+
+    def xla_step(x, w):
+        y = sm(x, w)
+        # full-reduction feedback: a y[0,0] probe would let XLA's
+        # algebraic simplifier shrink the dead matmul to one output
+        # element (the Pallas rows are opaque custom calls; this row is
+        # pure XLA and needs every output live)
+        return x + (jnp.sum(y.astype(jnp.float32)) * 1e-30).astype(x.dtype)
+
+    out["xla_ag_dot_tflops"] = tflops(
+        _per_iter(make_chain_timer(xla_step, a_s, b_s), i1, i2))
+
+    # 2. bare Pallas GEMM, same tile config as the overlap kernel
+    if n_dev == 1:
+        def mm_step(x, w):
+            y = matmul(x, w, cfg=cfg, out_dtype=jnp.bfloat16)
+            return x + (y[0, 0].astype(jnp.float32) * 1e-30).astype(x.dtype)
+
+        out["pallas_matmul_tflops"] = tflops(
+            _per_iter(make_chain_timer(mm_step, a, b), i1, i2))
+
+    # 3. overlap kernel with comm serialized (TDT_SERIAL read at trace
+    # time; fresh timers inside bench_ag_gemm retrace under the flag)
+    old = os.environ.get("TDT_SERIAL")
+    os.environ["TDT_SERIAL"] = "1"
+    try:
+        s, _ = bench_ag_gemm(ctx, n_dev, M, N, K, [cfg], i1, i2)
+        if s < float("inf"):
+            out["ag_gemm_serial_tflops"] = tflops(s)
+    finally:
+        if old is None:
+            del os.environ["TDT_SERIAL"]
+        else:
+            os.environ["TDT_SERIAL"] = old
+    return out
 
 
 def attn_sweep():
@@ -593,8 +741,8 @@ def sweep():
             # dedupe by effective tiling (block_k == K is the full-K path)
             eff = {(c.block_m, c.block_n, min(c.block_k or K, K)): c
                    for c in configs}
-            best_s = bench_ag_gemm(ctx, n_dev, M, N, K,
-                                   list(eff.values()), 10, 110)
+            best_s, _ = bench_ag_gemm(ctx, n_dev, M, N, K,
+                                      list(eff.values()), 10, 110)
             if best_s == float("inf"):
                 raise RuntimeError("no candidate config fits this shape")
             tflops = (2.0 * M * N * K / best_s) / max(n_dev, 1) / 1e12
@@ -644,10 +792,14 @@ def main(a2a_primary: bool = False):
 
     ctx = initialize_distributed(axis_names=("x",), mesh_shape=(n_dev,))
 
+    headline_cfg = {}
+
     def measure_headline():
-        best_s = bench_ag_gemm(ctx, n_dev, M, N, K, configs, i1, i2)
+        best_s, best_cfg = bench_ag_gemm(ctx, n_dev, M, N, K, configs,
+                                         i1, i2)
         assert best_s < float("inf") and best_s > 0, (
             f"no benchmark config ran (best_s={best_s})")
+        headline_cfg["cfg"] = best_cfg
         return (2.0 * M * N * K / best_s) / max(n_dev, 1) / 1e12
 
     tflops, artifact = _plausible(measure_headline, frac=0.95,
@@ -667,7 +819,17 @@ def main(a2a_primary: bool = False):
             return
         except Exception as e:
             first = f"{type(e).__name__}: {e}"[:200]
-            if "remote_compile" not in str(e):
+            # transient = the remote-compile service's HTTP 5xx signature
+            # specifically (observed form: "remote_compile: HTTP 500:
+            # tpu_compile_helper subprocess exit code 1") — a
+            # deterministic compile error also mentions remote_compile,
+            # and re-running that would double its cost; bare substring
+            # digits would false-match byte counts in error text
+            import re
+            s = str(e)
+            transient = ("remote_compile" in s
+                         and re.search(r"HTTP 5\d\d", s) is not None)
+            if not transient:
                 extras[f"{label}_error"] = first
                 return
         try:
@@ -742,12 +904,18 @@ def main(a2a_primary: bool = False):
         extras["a2a_dispatch_fp8_expert_us"] = round(d8e * 1e6, 1)
         extras["a2a_roundtrip_fp8_expert_us"] = round(r8e * 1e6, 1)
         # reference-scope wire-only numbers (its 137 µs excludes routing,
-        # token scatter, quant and dequant — see bench_a2a_wire docstring)
-        w16 = bench_a2a_wire(ctx, i1=ai1, i2=ai2, **a2a_shape)
-        w8 = bench_a2a_wire(ctx, i1=ai1, i2=ai2,
-                            wire_dtype=jnp.float8_e4m3fn, **a2a_shape)
+        # token scatter, quant and dequant — see bench_a2a_wire docstring).
+        # Seeds come from the payload-scaling FIT (no noise-floor clamp,
+        # VERDICT r4 #5): the 4×/8× points resolve real traffic and the
+        # fit extrapolates down; every term + the residual is emitted.
+        fit16 = bench_a2a_wire_fit(ctx, i1=ai1, i2=ai2, **a2a_shape)
+        fit8 = bench_a2a_wire_fit(ctx, i1=ai1, i2=ai2,
+                                  wire_dtype=jnp.float8_e4m3fn, **a2a_shape)
+        w16 = fit16["wire_us"] * 1e-6
+        w8 = fit8["wire_us"] * 1e-6
         extras["a2a_wire_us"] = round(w16 * 1e6, 1)
         extras["a2a_wire_fp8_us"] = round(w8 * 1e6, 1)
+        extras["a2a_wire_fit"] = {"bf16": fit16, "fp8": fit8}
         if not on_cpu() and n_dev == 1:
             # first-class DeepEP-comparison metric: model-extrapolated 8-
             # and 32-rank dispatch from the measured n=1 fp8 kernel (see
@@ -777,6 +945,16 @@ def main(a2a_primary: bool = False):
             }
 
     attempt("a2a_fp8", _fp8)
+
+    def _baselines():
+        # non-overlap rows (VERDICT r4 Missing #1): XLA ag+dot, bare
+        # Pallas matmul, comm-serialized ag_gemm — the overlap delta as a
+        # measurement instead of an assertion, at the HEADLINE's winning
+        # tile config so the delta isolates overlap, not tile choice
+        cfg = headline_cfg.get("cfg") or configs[-1]
+        extras.update(bench_baselines(ctx, n_dev, M, N, K, cfg, i1, i2))
+
+    attempt("baselines", _baselines)
 
     if artifact:
         # three impossible readings in a row: report, but flagged so no

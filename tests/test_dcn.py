@@ -1,0 +1,159 @@
+"""DCN-tier routing (VERDICT r4 Missing #2 / Next #6).
+
+On a real multi-slice mesh, ``pltpu.make_async_remote_copy`` cannot cross
+a slice boundary — the outer tier of every hierarchical op must ride XLA
+collectives (host-driven DCN) instead. ``ShmemContext.is_dcn_axis``
+detects slice crossings from ``device.slice_index``; the ``TDT_DCN_AXES``
+env var forces axes to DCN so this virtual topology can be tested (and
+AOT-compiled, test_aot_topology.py) without multi-slice hardware. The
+reference's analog is its genuinely-different inter-node transport
+(IBRC/IBGDA, allgather.py:291-375, ep_a2a.py:35-147).
+
+Every test here asserts the SAME goldens the ICI paths satisfy — the DCN
+re-route must be semantics-preserving — plus that an ICI-only mesh never
+takes the DCN path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.ops import all_gather, reduce_scatter
+from triton_dist_tpu.ops.all_to_all import (all_to_all_push, combine_2d,
+                                            create_all_to_all_context_2d,
+                                            dispatch_2d)
+from triton_dist_tpu.ops.allgather_gemm import ag_gemm
+from triton_dist_tpu.shmem.context import initialize_distributed
+from triton_dist_tpu.utils import assert_allclose
+
+
+@pytest.fixture(scope="module")
+def ctx2d():
+    return initialize_distributed(axis_names=("a", "b"), mesh_shape=(2, 3))
+
+
+@pytest.fixture()
+def dcn_major(monkeypatch):
+    """Force the major axis onto the DCN tier (2-slice virtual topology)."""
+    monkeypatch.setenv("TDT_DCN_AXES", "a")
+
+
+def test_ici_mesh_unchanged(ctx2d, monkeypatch):
+    monkeypatch.delenv("TDT_DCN_AXES", raising=False)
+    assert not ctx2d.is_dcn_axis("a")
+    assert not ctx2d.is_dcn_axis("b")
+
+
+def test_forced_detection(ctx2d, dcn_major):
+    assert ctx2d.is_dcn_axis("a")
+    assert not ctx2d.is_dcn_axis("b")
+
+
+def test_all_gather_dcn(ctx2d, dcn_major):
+    n = 6
+    x = jax.random.normal(jax.random.key(0), (n * 8, 128), jnp.float32)
+    xs = ctx2d.shard(x, P(("a", "b")))
+    y = jax.jit(lambda v: all_gather(ctx2d, v))(xs)
+    assert_allclose(np.asarray(y), np.asarray(x))
+    # single-axis spelling over the DCN axis
+    xs1 = ctx2d.shard(x, P("a"))
+    y1 = jax.jit(lambda v: all_gather(ctx2d, v, axis="a"))(xs1)
+    assert_allclose(np.asarray(y1), np.asarray(x))
+
+
+def test_reduce_scatter_dcn(ctx2d, dcn_major):
+    n, M = 6, 24
+    x = jnp.round(jax.random.normal(jax.random.key(0), (n * M, 128)) * 4)
+    xs = ctx2d.shard(x.astype(jnp.float32), P(("a", "b")))
+    y = jax.jit(lambda v: reduce_scatter(ctx2d, v))(xs)
+    golden = jax.jit(ctx2d.shard_map(
+        lambda s: jax.lax.psum_scatter(s, ("a", "b"), scatter_dimension=0,
+                                       tiled=True),
+        in_specs=P(("a", "b")), out_specs=P(("a", "b"))))(xs)
+    assert_allclose(np.asarray(y), np.asarray(golden))
+
+
+def test_a2a_push_dcn(ctx2d, dcn_major):
+    """The wire collective over a DCN axis: slot semantics preserved."""
+    na = 2
+    payload = jnp.arange(na * na * 8 * 128, dtype=jnp.float32).reshape(
+        na * na, 8, 128)
+    ps = ctx2d.shard(payload, P("a"))
+    (got,) = jax.jit(lambda v: all_to_all_push(ctx2d, v, axis="a"))(ps)
+    # golden: slot p of rank r ends up at slot r of rank p
+    want = np.asarray(payload).reshape(na, na, 8, 128).swapaxes(0, 1
+                                                                ).reshape(
+        na * na, 8, 128)
+    assert_allclose(np.asarray(got), want)
+
+
+def test_dispatch_combine_2d_dcn_roundtrip(ctx2d, dcn_major):
+    """The full hierarchical EP dispatch/combine with the OUTER tier on
+    DCN (XLA all_to_all) and the inner tier on the Pallas kernel — the
+    reference's inter-node + intra-node split, semantics unchanged."""
+    n, T, H, topk, E = 6, 8, 128, 2, 12
+    a2a = create_all_to_all_context_2d(ctx2d, max_tokens=T, hidden=H,
+                                       topk=topk, num_experts=E,
+                                       dtype=jnp.float32)
+    epr = E // n
+    tokens = jax.random.normal(jax.random.key(0), (n * T, H), jnp.float32)
+    ids = jax.random.randint(jax.random.key(1), (n * T, topk), 0, E)
+    w = jax.nn.softmax(jax.random.normal(jax.random.key(2), (n * T, topk)),
+                       -1)
+    scale = np.linspace(0.5, 2.0, E).astype(np.float32)
+    scale_j = jnp.asarray(scale)
+
+    def run(t, i, ww):
+        recv, recv_ids, layouts = dispatch_2d(a2a, t, i)
+
+        def process(r_shard, id_shard):
+            me0 = jax.lax.axis_index("a")
+            me1 = jax.lax.axis_index("b")
+            rank = me0 * a2a.n_minor + me1
+            gid = jnp.where(id_shard >= 0, rank * epr + id_shard, 0)
+            s = jnp.take(scale_j, gid)
+            s = jnp.where(id_shard >= 0, s, 0.0)
+            return r_shard * s[..., None]
+
+        both = P(("a", "b"))
+        proc = ctx2d.shard_map(process, in_specs=(both, both),
+                               out_specs=both)(recv, recv_ids)
+        return combine_2d(a2a, proc, layouts, ww)
+
+    out = jax.jit(run)(ctx2d.shard(tokens, P(("a", "b"))),
+                       ctx2d.shard(ids, P(("a", "b"))),
+                       ctx2d.shard(w, P(("a", "b"))))
+    t = np.asarray(tokens, np.float32)
+    idn, wn = np.asarray(ids), np.asarray(w, np.float32)
+    golden = np.zeros_like(t)
+    for i in range(t.shape[0]):
+        for j in range(idn.shape[1]):
+            golden[i] += wn[i, j] * (t[i] * scale[idn[i, j]])
+    assert_allclose(np.asarray(out, np.float32), golden, rtol=2e-2,
+                    atol=2e-2)
+
+
+def test_ag_gemm_2tier_dcn(ctx2d, dcn_major):
+    """2-tier AG-GEMM with the outer tier on DCN: XLA gather outer, Pallas
+    overlap inner, rows restored to P((a, b)) order."""
+    n = 6
+    M, K, N = n * 16, 128, n * 32
+    a = jax.random.normal(jax.random.key(0), (M, K), jnp.float32) * 0.3
+    b = jax.random.normal(jax.random.key(1), (K, N), jnp.float32) * 0.3
+    c = jax.jit(lambda x, y: ag_gemm(ctx2d, x, y, axis=("a", "b")))(
+        ctx2d.shard(a, P(("a", "b"))), ctx2d.shard(b, P(None, ("a", "b"))))
+    assert_allclose(np.asarray(c), np.asarray(a) @ np.asarray(b),
+                    atol=1e-3, rtol=1e-3)
+
+
+def test_ag_gemm_dcn_axis_order_enforced(ctx2d, monkeypatch):
+    """A DCN axis buried BEHIND an ICI axis must be rejected loudly."""
+    monkeypatch.setenv("TDT_DCN_AXES", "b")
+    n = 6
+    M, K, N = n * 16, 128, n * 32
+    a = jax.random.normal(jax.random.key(0), (M, K), jnp.float32)
+    b = jax.random.normal(jax.random.key(1), (K, N), jnp.float32)
+    with pytest.raises(ValueError, match="slow tier"):
+        ag_gemm(ctx2d, ctx2d.shard(a, P(("a", "b"))),
+                ctx2d.shard(b, P(None, ("a", "b"))), axis=("a", "b"))

@@ -9,7 +9,9 @@ from jax.sharding import PartitionSpec as P
 
 from conftest import TEST_WORLD
 from triton_dist_tpu.ops.group_gemm import (align_tokens_by_expert,
-                                            grouped_gemm, moe_ffn_local)
+                                            apply_grouped, grouped_gemm,
+                                            grouped_gemm_gated,
+                                            moe_ffn_local)
 from triton_dist_tpu.ops.moe import ag_moe_group_gemm, moe_reduce_rs
 from triton_dist_tpu.shmem.context import initialize_distributed
 from triton_dist_tpu.utils import assert_allclose
@@ -37,6 +39,125 @@ def test_grouped_gemm_dense_golden():
         rows = slice(blk * bm, (blk + 1) * bm)
         golden = np.asarray(x)[rows] @ np.asarray(weights)[be[blk]]
         assert_allclose(yn[rows], golden, atol=1e-3, rtol=1e-3)
+
+
+def test_grouped_gemm_gated_matches_unfused():
+    """The fused gate+up+act kernel == the two-launch composition it
+    replaces, on both the static and runtime-bounded paths."""
+    E, H, F, bm = 4, 64, 128, 16
+    T = 56
+    ids = jax.random.randint(jax.random.key(0), (T,), 0, E)
+    tokens = jax.random.normal(jax.random.key(1), (T, H), jnp.float32)
+    wg = jax.random.normal(jax.random.key(2), (E, H, F), jnp.float32) * 0.1
+    wu = jax.random.normal(jax.random.key(3), (E, H, F), jnp.float32) * 0.1
+    gi, rv, be, nb = align_tokens_by_expert(ids, E, bm, with_used_count=True)
+    x = tokens[np.asarray(gi)] * np.asarray(rv)[:, None]
+
+    def unfused(x, wg, wu, be, nb):
+        g = grouped_gemm(x, wg, be, block_m=bm, block_n=64,
+                         n_blocks_used=nb)
+        u = grouped_gemm(x, wu, be, block_m=bm, block_n=64,
+                         n_blocks_used=nb)
+        return jax.nn.silu(g) * u
+
+    want = jax.jit(unfused)(x, wg, wu, be, nb)
+    got_static = jax.jit(lambda *a: grouped_gemm_gated(
+        *a, block_m=bm, block_n=64))(x, wg, wu, be)
+    got_bounded = jax.jit(lambda *a, n=nb: grouped_gemm_gated(
+        *a, block_m=bm, block_n=64, n_blocks_used=n))(x, wg, wu, be)
+    valid = np.asarray(rv)[:, None]
+    assert_allclose(np.asarray(got_bounded), np.asarray(want),
+                    atol=1e-4, rtol=1e-4)
+    # static path computes every block (padding included) — compare on
+    # valid rows
+    assert_allclose(np.asarray(got_static) * valid,
+                    np.asarray(want) * valid, atol=1e-4, rtol=1e-4)
+
+
+def test_grouped_gemm_gated_row_scale():
+    """Quantized-wire rows: the per-row scale folded into both f32
+    accumulators equals dequantize-then-compute."""
+    E, H, F, bm = 2, 32, 64, 8
+    P_rows = 4 * bm
+    be = jnp.array([0, 1, 0, 1], jnp.int32)
+    q = jax.random.randint(jax.random.key(0), (P_rows, H), -64, 64
+                           ).astype(jnp.int8)
+    scale = jax.random.uniform(jax.random.key(1), (P_rows,), jnp.float32,
+                               0.01, 0.1)
+    wg = jax.random.normal(jax.random.key(2), (E, H, F), jnp.float32) * 0.1
+    wu = jax.random.normal(jax.random.key(3), (E, H, F), jnp.float32) * 0.1
+    got = jax.jit(lambda *a: grouped_gemm_gated(
+        *a[:4], block_m=bm, block_n=64, row_scale=a[4],
+        out_dtype=jnp.float32))(q, wg, wu, be, scale)
+    xf = np.asarray(q, np.float32) * np.asarray(scale)[:, None]
+    want = np.zeros((P_rows, F), np.float32)
+    for blk in range(4):
+        rows = slice(blk * bm, (blk + 1) * bm)
+        g = xf[rows] @ np.asarray(wg)[be[blk]]
+        u = xf[rows] @ np.asarray(wu)[be[blk]]
+        want[rows] = g / (1 + np.exp(-g)) * u
+    assert_allclose(np.asarray(got), want, atol=1e-3, rtol=1e-3)
+
+
+def test_grouped_gemm_ksplit_matches():
+    """block_k (K-split accumulation through the f32 VMEM scratch) matches
+    the full-K strip path on both ops, row_scale included."""
+    E, H, F, bm = 4, 128, 128, 16
+    T = 56
+    ids = jax.random.randint(jax.random.key(0), (T,), 0, E)
+    tokens = jax.random.normal(jax.random.key(1), (T, H), jnp.float32)
+    w = jax.random.normal(jax.random.key(2), (E, H, F), jnp.float32) * 0.1
+    wu = jax.random.normal(jax.random.key(3), (E, H, F), jnp.float32) * 0.1
+    gi, rv, be, nb = align_tokens_by_expert(ids, E, bm, with_used_count=True)
+    x = tokens[np.asarray(gi)] * np.asarray(rv)[:, None]
+    scale = jax.random.uniform(jax.random.key(4), (x.shape[0],),
+                               jnp.float32, 0.5, 1.5)
+
+    full = jax.jit(lambda *a: grouped_gemm(
+        *a[:3], block_m=bm, block_n=64, n_blocks_used=nb,
+        row_scale=a[3]))(x, w, be, scale)
+    split = jax.jit(lambda *a: grouped_gemm(
+        *a[:3], block_m=bm, block_n=64, n_blocks_used=nb,
+        row_scale=a[3], block_k=32))(x, w, be, scale)
+    assert_allclose(np.asarray(split), np.asarray(full), atol=1e-4,
+                    rtol=1e-4)
+
+    full_g = jax.jit(lambda *a: grouped_gemm_gated(
+        *a, block_m=bm, block_n=64, n_blocks_used=nb))(x, w, wu, be)
+    split_g = jax.jit(lambda *a: grouped_gemm_gated(
+        *a, block_m=bm, block_n=64, n_blocks_used=nb, block_k=32))(
+        x, w, wu, be)
+    assert_allclose(np.asarray(split_g), np.asarray(full_g), atol=1e-4,
+                    rtol=1e-4)
+
+
+def test_apply_grouped_unmasked_ffn():
+    """The masked=False fast path through apply_grouped (undefined rows
+    past the bound are dropped by scatter index) matches moe_ffn_local's
+    masked composition, invalid ids included."""
+    E, H, F, bm = 4, 64, 128, 16
+    T = 48
+    ids = jax.random.randint(jax.random.key(0), (T,), -1, E)
+    tokens = jax.random.normal(jax.random.key(1), (T, H), jnp.float32)
+    wg = jax.random.normal(jax.random.key(2), (E, H, F), jnp.float32) * 0.1
+    wd = jax.random.normal(jax.random.key(3), (E, F, H), jnp.float32) * 0.1
+
+    def ffn(x, be, nb):
+        h = grouped_gemm_gated(x, wg, wg, be, block_m=bm, block_n=64,
+                               n_blocks_used=nb, masked=False)
+        return grouped_gemm(h, wd, be, block_m=bm, n_blocks_used=nb,
+                            masked=False)
+
+    got = jax.jit(lambda t, i: apply_grouped(t, i, E, ffn, block_m=bm))(
+        tokens, ids)
+    t, idn = np.asarray(tokens), np.asarray(ids)
+    golden = np.zeros_like(t)
+    for r in range(T):
+        if idn[r] >= 0:
+            g = t[r] @ np.asarray(wg)[idn[r]]
+            h = g / (1 + np.exp(-g)) * g
+            golden[r] = h @ np.asarray(wd)[idn[r]]
+    assert_allclose(np.asarray(got), golden, atol=1e-3, rtol=1e-3)
 
 
 def test_moe_ffn_local_golden():

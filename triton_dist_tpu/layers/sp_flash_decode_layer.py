@@ -1,16 +1,22 @@
 """Sequence-parallel GQA flash-decode attention module (analog of reference
 layers/nvidia/sp_flash_decode_layer.py:43-184 ``SpGQAFlashDecodeAttention``).
 
-The reference module owns a growable AG staging buffer and toggles between
-JIT and AOT kernel paths (:111-132, :96-105). Here buffers are per-call and
-the AOT path is ``jax.jit(...).lower().compile()`` (see tools.aot), so the
-module reduces to configuration + the three-phase forward."""
+The reference module owns a growable AG staging buffer that it resizes as
+the serving batch changes (:111-132) and toggles between JIT and AOT kernel
+paths (:96-105). The TPU analog of "growable buffer, no re-setup": a
+``max_batch`` configured once — the KV cache is allocated at ``max_batch``
+(as a serving loop does anyway), incoming sub-batches are padded to it
+OUTSIDE the kernel, and ONE compiled kernel instance serves every batch
+size ≤ ``max_batch`` (padding rows attend to one token and are sliced
+away). Without ``max_batch`` each distinct batch size compiles once and is
+then cached (jit shape-keying) — steps never recompile either way."""
 
 from __future__ import annotations
 
 import dataclasses
 
 import jax
+import jax.numpy as jnp
 
 from triton_dist_tpu.ops.flash_decode import sp_gqa_flash_decode
 from triton_dist_tpu.shmem.context import ShmemContext
@@ -25,18 +31,45 @@ class SpGQAFlashDecodeAttention:
     axis: str | None = None
     block_s: int = 128
     ag_method: str = "fused"  # fused partial-AG + lse-merge latency path
+    max_batch: int | None = None  # serve any B <= max_batch, one compile
+
+    def __post_init__(self):
+        # one jitted forward per layer object: shape-keyed cache means a
+        # repeated (batch, seq) shape NEVER retraces; with ``max_batch``
+        # padding there is exactly one kernel shape, period
+        object.__setattr__(self, "_fwd", jax.jit(
+            lambda q, k, v, lens: sp_gqa_flash_decode(
+                self.ctx, q, k, v, lens, axis=self.axis,
+                block_s=self.block_s, ag_method=self.ag_method)))
 
     def __call__(self, q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                  global_kv_lens: jax.Array) -> jax.Array:
-        """q [B, Hq, D] replicated; k/v_cache [B, Hkv, S, D] sequence-sharded
+        """q [B, Hq, D] replicated; k/v_cache [B', Hkv, S, D] sequence-sharded
         P(None, None, axis); global_kv_lens [B]. Returns [B, Hq, D] replicated
-        (local split-KV decode → partial (out‖lse) allgather → lse-merge)."""
+        (local split-KV decode → partial (out‖lse) allgather → lse-merge).
+
+        With ``max_batch`` set, B' must be ``max_batch`` (the serving
+        loop's cache allocation) and any B ≤ ``max_batch`` is served by
+        the SAME compiled kernel: q/kv_lens are padded to ``max_batch``
+        (pad rows attend to 1 token of the allocated cache — real rows,
+        finite math) and the result is sliced back to B."""
         B, Hq, D = q.shape
         assert Hq == self.num_q_heads and D == self.head_dim
         assert k_cache.shape[1] == self.num_kv_heads, (
             f"cache has {k_cache.shape[1]} kv heads, "
             f"layer configured for {self.num_kv_heads}")
-        return sp_gqa_flash_decode(self.ctx, q, k_cache, v_cache,
-                                   global_kv_lens, axis=self.axis,
-                                   block_s=self.block_s,
-                                   ag_method=self.ag_method)
+        if self.max_batch is None or B == k_cache.shape[0] == self.max_batch:
+            return self._fwd(q, k_cache, v_cache, global_kv_lens)
+        mb = self.max_batch
+        assert B <= mb, f"batch {B} exceeds the layer's max_batch {mb}"
+        assert k_cache.shape[0] == mb, (
+            f"with max_batch={mb} the KV cache must be allocated at "
+            f"max_batch (got batch dim {k_cache.shape[0]}) — that is the "
+            "buffer the serving loop owns, reference "
+            "sp_flash_decode_layer.py:111-132")
+        q_pad = jnp.concatenate(
+            [q, jnp.zeros((mb - B, Hq, D), q.dtype)])
+        lens_pad = jnp.concatenate(
+            [global_kv_lens,
+             jnp.ones((mb - B,), global_kv_lens.dtype)])
+        return self._fwd(q_pad, k_cache, v_cache, lens_pad)[:B]

@@ -204,7 +204,9 @@ def moe_forward(params: dict, tokens: jax.Array, cfg: MoEConfig,
 def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
                        router_w: jax.Array, we_gate: jax.Array,
                        we_up: jax.Array, we_down: jax.Array,
-                       axis: str | None = None) -> jax.Array:
+                       axis: str | None = None, block_m: int = 128,
+                       block_n: int = 128, block_k: int | None = None,
+                       down_block_n: int | None = None) -> jax.Array:
     """The reference's EP MoE inference block (test_ep_moe_inference.py /
     tutorial 04) on the Pallas kernel stack: router → low-latency A2A
     dispatch → grouped expert FFN on each rank's local experts → A2A combine
@@ -219,7 +221,8 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
     from the layer; ``x2d`` is P((major, minor))-sharded.
     """
     from triton_dist_tpu.ops.all_to_all import QuantTokens
-    from triton_dist_tpu.ops.group_gemm import apply_grouped, grouped_gemm
+    from triton_dist_tpu.ops.group_gemm import (apply_grouped, grouped_gemm,
+                                                grouped_gemm_gated)
     from triton_dist_tpu.shmem import device as shd
 
     a2a = a2a_layer.a2a
@@ -255,22 +258,27 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
         wu_l = lax.dynamic_slice_in_dim(wu, me * e_local, e_local)
         wd_l = lax.dynamic_slice_in_dim(wd, me * e_local, e_local)
 
-        # gated FFN: silu(x@wg) * (x@wu) @ wd over local experts. On the
+        # gated FFN: silu(x@wg) * (x@wu) @ wd over local experts, as TWO
+        # fused kernels: gate+up+act in one (each x-tile read once,
+        # activation on the f32 accumulators in VMEM — no gate/up arrays
+        # or elementwise pass in HBM), then the down grouped GEMM. On the
         # expert-edge quantized wire, xs stays fp8/int8 and the per-row
-        # scale folds into the first two GEMMs' f32 accumulators —
-        # silu(s·(q@wg)) · s·(q@wu) == the dequantized math, row scaling
-        # commutes with the matmul
+        # scale folds into both accumulators — silu(s·(q@wg)) · s·(q@wu)
+        # == the dequantized math, row scaling commutes with the matmul.
+        # masked=False: apply_grouped's scatter drops invalid rows by
+        # index, so the zeroing pass over each output is skipped.
         def ffn(xs, be, nb, *ss):
-            kw = dict(block_m=128, n_blocks_used=nb)
+            kw = dict(block_m=block_m, block_n=block_n, n_blocks_used=nb,
+                      masked=False, block_k=block_k)
             if ss:
                 kw["row_scale"] = ss[0]
                 kw["out_dtype"] = a2a.dtype
-            g = grouped_gemm(xs, wg_l, be, **kw)
-            u = grouped_gemm(xs, wu_l, be, **kw)
-            hh = jax.nn.silu(g.astype(jnp.float32)).astype(u.dtype) * u
-            return grouped_gemm(hh, wd_l, be, block_m=128, n_blocks_used=nb)
+            hh = grouped_gemm_gated(xs, wg_l, wu_l, be, **kw)
+            return grouped_gemm(hh, wd_l, be, block_m=block_m,
+                                block_n=down_block_n or block_n,
+                                n_blocks_used=nb, masked=False)
 
-        out = apply_grouped(tflat, iflat, e_local, ffn, block_m=128,
+        out = apply_grouped(tflat, iflat, e_local, ffn, block_m=block_m,
                             row_scale=sflat)
         if is_2d:
             return out.reshape(tok.shape[:-1] + (-1,))

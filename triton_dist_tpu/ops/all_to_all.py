@@ -155,6 +155,30 @@ def all_to_all_push(ctx: ShmemContext, *arrays: jax.Array,
     mesh_axes = ctx.axis_names
     spec = spec if spec is not None else P(axis)
     n_arrays = len(arrays)
+    if ctx.is_dcn_axis(axis):
+        # DCN tier: remote DMA cannot cross a slice boundary — run this
+        # axis' exchange as an XLA ``lax.all_to_all`` (host-driven DCN
+        # transfers, XLA-scheduled). Identical slot semantics: local slot
+        # p of dim -3 goes to peer p / arrives from peer p. The
+        # hierarchical ops compose per-axis pushes, so marking the outer
+        # axis DCN re-routes exactly that tier (reference inter-node
+        # transport split, allgather.py:291-375).
+        def xla_tier(*shards):
+            # local view: every wire array is [n, ...] with dim 0 = peer
+            # slot; exchanging dim 0 IS the push semantics
+            return tuple(
+                lax.all_to_all(s, axis, split_axis=0, concat_axis=0,
+                               tiled=True)
+                for s in shards)
+
+        sm = ctx.shard_map(xla_tier, in_specs=tuple(spec for _ in arrays),
+                           out_specs=tuple(spec for _ in arrays))
+        out = sm(*arrays)
+        if dequant_to is not None:
+            cap = arrays[0].shape[-2]
+            scale = out[-1].reshape(out[-1].shape[0], -1)[:, :cap]
+            return (_dequant(out[0], scale, dequant_to),) + out[1:]
+        return out
     dequant = None
     cap = None
     if dequant_to is not None:
@@ -521,17 +545,44 @@ def _slot_src_map(dest_flat, slot_drop, src_rows, n_dst, cap, n_rows):
         dest_flat, slot_drop].set(src_rows, mode="drop")
 
 
+# Below this source-row count the slot gather runs as a one-hot matmul on
+# the MXU instead of an HBM take-gather. The matmul is EXACT (each one-hot
+# row has a single 1.0; 1.0·x in bf16 is x; the f32 accumulation sums one
+# nonzero), reads the R source rows once (VMEM-resident) instead of
+# streaming ~cap duplicated rows through the gather unit, and unfilled
+# slots (src >= R) compare to nothing -> all-zero one-hot row -> zeros, the
+# same zero-fill the take path wants. At the DeepSeek dispatch shape
+# (R = 128 tokens/rank, cap·n = 1024 slots, H = 7168) the FLOP cost is
+# ~1.9 GFLOP ≈ 10 µs on the MXU vs a ~30 µs bandwidth-bound gather — the
+# dispatch edge the reference builds outside its timed region
+# (test_all_to_all.py:313-329) but we count in ours. Past ~512 source rows
+# the R-wide contraction stops paying for itself.
+_MXU_GATHER_MAX_ROWS = 512
+
+
+def _slot_onehot(src, R):
+    """[*, R] one-hot of the slot->source-row map (unfilled rows all-zero)."""
+    return (src.reshape(-1)[:, None]
+            == jnp.arange(R, dtype=src.dtype)[None, :])
+
+
 def _slot_gather(rows, src, out_dtype):
     """Build a [n_dst, cap, H] send buffer by gathering ``rows`` [R, H]
     through the slot->source-row map ``src`` [n_dst, cap] (value R =
-    unfilled -> zeros). One gather instead of zero-init + scattering
-    pre-expanded rows — half the HBM traffic on the dispatch critical
-    path."""
+    unfilled -> zeros). Small-R path: gather-by-MXU (see
+    ``_MXU_GATHER_MAX_ROWS``). Large-R path: one take-gather instead of
+    zero-init + scattering pre-expanded rows — half the HBM traffic on the
+    dispatch critical path."""
     R = rows.shape[0]
+    out_shape = src.shape + rows.shape[1:]
+    if R <= _MXU_GATHER_MAX_ROWS and rows.ndim == 2:
+        onehot = _slot_onehot(src, R).astype(rows.dtype)
+        return jnp.dot(onehot, rows,
+                       preferred_element_type=jnp.float32
+                       ).astype(out_dtype).reshape(out_shape)
     filled = (src < R)[..., None]
     take = jnp.take(rows, jnp.minimum(src, R - 1).reshape(-1), axis=0)
-    return jnp.where(filled, take.reshape(src.shape + rows.shape[1:]),
-                     0).astype(out_dtype)
+    return jnp.where(filled, take.reshape(out_shape), 0).astype(out_dtype)
 
 
 def _qmax(wire_dtype) -> float:
@@ -568,12 +619,18 @@ def _slot_gather_quant(rows, src, wire_dtype):
     rule)."""
     R = rows.shape[0]
     H = rows.shape[-1]
-    filled = src < R
-    take = jnp.take(rows, jnp.minimum(src, R - 1).reshape(-1), axis=0)
-    take = take.reshape(src.shape + (H,)).astype(jnp.float32)
-    take = jnp.where(filled[..., None], take, 0.0)
+    if R <= _MXU_GATHER_MAX_ROWS and rows.ndim == 2:
+        # gather-by-MXU (see _MXU_GATHER_MAX_ROWS): the one-hot product IS
+        # the gathered f32 rows, and the quant chain fuses onto it
+        onehot = _slot_onehot(src, R).astype(rows.dtype)
+        take = jnp.dot(onehot, rows, preferred_element_type=jnp.float32)
+    else:
+        filled = src < R
+        take = jnp.take(rows, jnp.minimum(src, R - 1).reshape(-1), axis=0)
+        take = take.reshape(src.shape + (H,)).astype(jnp.float32)
+        take = jnp.where(filled[..., None], take, 0.0)
     q, scale = _quant(take.reshape(-1, H), wire_dtype)
-    return (q.reshape(take.shape).astype(wire_dtype),
+    return (q.reshape(src.shape + (H,)).astype(wire_dtype),
             scale.reshape(src.shape))
 
 

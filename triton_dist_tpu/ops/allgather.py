@@ -173,6 +173,16 @@ def all_gather(ctx: ShmemContext, x: jax.Array, axis: str | None = None,
     axis_names = ctx.axis_names
     if axis is None and len(axis_names) == 1:
         axis = axis_names[0]
+    involved = (tuple(axis) if isinstance(axis, tuple)
+                else axis_names if axis is None else (axis,))
+    if method == "xla" or any(ctx.is_dcn_axis(a) for a in involved):
+        # DCN tier: remote DMA cannot cross a slice boundary, so a gather
+        # group containing a DCN axis runs on XLA collectives end to end
+        # (XLA routes intra-slice hops over ICI and inter-slice over DCN
+        # itself — the host-driven transport the reference reaches with
+        # its inter-node IBRC tier, allgather.py:291-375). ICI-only meshes
+        # never take this path unless method="xla" is forced.
+        return _ag_xla(ctx, x, involved)
     if method == "auto":
         method = _auto_method(ctx, x, axis)
     if method in ("ring_2d", "push_2d"):
@@ -188,6 +198,22 @@ def all_gather(ctx: ShmemContext, x: jax.Array, axis: str | None = None,
             f"all_gather(method={method!r}) on a multi-axis mesh "
             f"{axis_names} requires an explicit axis=")
     return _ag_1d(ctx, x, axis, method)
+
+
+def _ag_xla(ctx: ShmemContext, x: jax.Array, involved: tuple):
+    """XLA-collective all-gather over ``involved`` axes, innermost first so
+    the replicated result keeps the P(involved) row order."""
+    from jax import lax
+
+    def f(shard):
+        y = shard
+        for ax in reversed(involved):
+            y = lax.all_gather(y, ax, axis=0, tiled=True)
+        return y
+
+    sm = ctx.shard_map(f, in_specs=P(involved),
+                       out_specs=P(*([None] * x.ndim)))
+    return sm(x)
 
 
 def _ag_push_2d(ctx: ShmemContext, x: jax.Array, axis=None):

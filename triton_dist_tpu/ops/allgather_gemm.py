@@ -263,6 +263,71 @@ def _pallas_ag_gemm(axis, mesh_axes, cfg, out_dtype, n, M, K, m_local,
     return c, ws_out
 
 
+def _dcn_prefix(ctx: ShmemContext, axis) -> tuple[tuple, tuple]:
+    """Partition a (possibly tuple) gather axis into (dcn_axes, ici_axes).
+    DCN axes must form a PREFIX of the tuple — the docstring's "slow tier
+    first" rule; a DCN axis behind an ICI axis would scramble the segment
+    order the hierarchical protocol produces."""
+    axes_t = axis if isinstance(axis, tuple) else (axis,)
+    dcn = tuple(a for a in axes_t if ctx.is_dcn_axis(a))
+    if dcn and dcn != axes_t[:len(dcn)]:
+        raise ValueError(
+            f"DCN (slice-crossing) axes {dcn} must come first in the "
+            f"hierarchical axis tuple {axes_t} — put the slow tier "
+            "outermost (cf. ag_gemm docstring)")
+    return dcn, axes_t[len(dcn):]
+
+
+def _ag_gemm_dcn(ctx, a, b, dcn, ici, cfg, out_dtype, ws=None):
+    """AG-GEMM with the outer tier crossing slice boundaries: the DCN
+    tier's gather runs as an XLA ``all_gather`` (remote DMA cannot cross
+    DCN), the ICI tier keeps the Pallas overlap kernel, and the output
+    rows are restored to the P((dcn…, ici…)) order with one local
+    block-transpose (each device holds full rows of its N-slice). The TPU
+    analog of the reference's inter-node tier swap — its inter-node AG is
+    a different transport stacked on the intra-node kernel
+    (allgather_gemm.py:938-975, allgather.py:291-375)."""
+    mesh_axes = ctx.axis_names
+    group = dcn + ici
+    n = ctx.axis_size(group)
+    n_dcn = ctx.axis_size(dcn)
+    n_ici = ctx.axis_size(ici) if ici else 1
+    M, K = a.shape
+    m_loc = M // n
+    ici_axis = None if not ici else (ici[0] if len(ici) == 1 else ici)
+
+    def f(a_shard, b_shard, *ws_shard):
+        a2 = a_shard
+        for ax in reversed(dcn):
+            a2 = lax.all_gather(a2, ax, axis=0, tiled=True)
+        # a2: [m_loc * n_dcn, K], rows (dcn…, m) for this device's ici index
+        if not ici:
+            # every tier crosses DCN: plain XLA GEMM on the gathered rows
+            c = jnp.dot(a2, b_shard, preferred_element_type=jnp.float32
+                        ).astype(out_dtype)
+            return (c,) + tuple(ws_shard)
+        ws2 = (ws_shard[0].reshape(n_ici, m_loc * n_dcn, K)
+               if ws_shard else None)
+        c, ws_out = _pallas_ag_gemm(ici_axis, mesh_axes, cfg, out_dtype,
+                                    n_ici, M, K, m_loc * n_dcn, a2, b_shard,
+                                    ws2)
+        # Pallas tier ordered rows (ici…, dcn…, m); restore (dcn…, ici…, m)
+        tail = c.shape[1:]
+        c = c.reshape((n_ici, n_dcn, m_loc) + tail)
+        c = jnp.swapaxes(c, 0, 1).reshape((M,) + tail)
+        if ws_shard:
+            return c, ws_out.reshape(ws_shard[0].shape)
+        return (c,)
+
+    ws_args = () if ws is None else (ws,)
+    sm = ctx.shard_map(
+        f,
+        in_specs=(P(group), P(None, group)) + (P(group),) * len(ws_args),
+        out_specs=(P(None, group),) + (P(group),) * len(ws_args))
+    out = sm(a, b, *ws_args)
+    return out[0] if ws is None else out
+
+
 def ag_gemm(ctx: ShmemContext, a: jax.Array, b: jax.Array,
             axis=None, cfg: GemmConfig | None = None,
             out_dtype=None) -> jax.Array:
@@ -287,6 +352,9 @@ def ag_gemm(ctx: ShmemContext, a: jax.Array, b: jax.Array,
     out_dtype = out_dtype or a.dtype
     mesh_axes = ctx.axis_names
     n, M, K, m_local = _validate(ctx, a, b, axis, cfg)
+    dcn, ici = _dcn_prefix(ctx, axis)
+    if dcn:
+        return _ag_gemm_dcn(ctx, a, b, dcn, ici, cfg, out_dtype)
 
     def f(a_shard, b_shard):
         c, _ = _pallas_ag_gemm(axis, mesh_axes, cfg, out_dtype, n, M, K,
@@ -317,6 +385,11 @@ def ag_gemm_ws(ctx: ShmemContext, a: jax.Array, b: jax.Array, ws: jax.Array,
         f"workspace {ws.shape}/{ws.dtype} does not match "
         f"({n}, {n}, {m_local}, {K})/{a.dtype} — create it with "
         f"create_ag_gemm_workspace(ctx, m_local={m_local}, k={K}, ...)")
+    dcn, ici = _dcn_prefix(ctx, axis)
+    if dcn:
+        # same symmetric buffer, re-viewed for the ICI-only Pallas tier
+        # (n·m_local rows = n_ici·(m_local·n_dcn) rows — bytes identical)
+        return _ag_gemm_dcn(ctx, a, b, dcn, ici, cfg, out_dtype, ws=ws)
 
     def f(a_shard, b_shard, ws_shard):
         c, ws_out = _pallas_ag_gemm(

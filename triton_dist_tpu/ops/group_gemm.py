@@ -125,9 +125,24 @@ def _gemm_block(t_blk, w_blk, sc_row, out_dtype):
     return acc.astype(out_dtype)
 
 
+def _gated_block(t_blk, wg_blk, wu_blk, sc_row, out_dtype, activation):
+    """Fused gate+up accumulator body: BOTH expert projections of one row
+    block against the SAME resident x-tile, activation applied in f32
+    before anything leaves VMEM — ``act(x@wg) * (x@wu)`` never stages the
+    two [bm, bn] halves in HBM (vs the reference's separate gate/up GEMM
+    launches + elementwise pass)."""
+    g = jnp.dot(t_blk[...], wg_blk[0], preferred_element_type=jnp.float32)
+    u = jnp.dot(t_blk[...], wu_blk[0], preferred_element_type=jnp.float32)
+    if sc_row is not None:
+        g = g * sc_row[:, None]
+        u = u * sc_row[:, None]
+    return (activation(g) * u).astype(out_dtype)
+
+
 def emit_grouped_gemm(t_ref, w_ref, o_ref, be_ref, base_blk,
                       block_m: int, block_n: int, out_dtype=None,
-                      n_blocks_used=None, sc_ref=None):
+                      n_blocks_used=None, sc_ref=None,
+                      block_k: int | None = None, acc_ref=None):
     """In-kernel pipelined grouped GEMM over HBM refs:
     ``o[i*bm:(i+1)*bm] = t[i*bm:(i+1)*bm] @ w[be_ref[base_blk + i]]``.
 
@@ -149,7 +164,15 @@ def emit_grouped_gemm(t_ref, w_ref, o_ref, be_ref, base_blk,
     unscrambles already do).
 
     ``sc_ref`` (optional [P // block_m, block_m] f32 ref) folds a per-row
-    dequant scale into the accumulator — see ``grouped_gemm.row_scale``."""
+    dequant scale into the accumulator — see ``grouped_gemm.row_scale``.
+
+    ``block_k`` splits the contraction: x strips become (block_m, block_k)
+    and weight tiles (block_k, block_n), with the k grid dimension
+    innermost accumulating into ``acc_ref`` (caller-allocated
+    [block_m, block_n] f32 VMEM scratch — f32 partials, one cast at the
+    end). This is what lets block_m/block_n grow past the full-K strip's
+    scoped-VMEM cliff (a (256, 7168) bf16 x strip alone double-buffers to
+    ~7 MB; measured OOM at 17.6 MB round 5)."""
     import math
 
     P, H = t_ref.shape
@@ -160,6 +183,49 @@ def emit_grouped_gemm(t_ref, w_ref, o_ref, be_ref, base_blk,
     out_dtype = out_dtype or o_ref.dtype
     m_steps = (P // block_m if n_blocks_used is None
                else jnp.minimum(n_blocks_used, P // block_m))
+    sc_specs3 = ([pl.BlockSpec((1, block_m), lambda i, j, k: (i, 0))]
+                 if sc_ref is not None else [])
+    sc_args = (sc_ref,) if sc_ref is not None else ()
+
+    if block_k is not None and block_k < H:
+        assert H % block_k == 0, (H, block_k)
+        assert acc_ref is not None, "block_k needs an f32 VMEM acc_ref"
+        nk = H // block_k
+
+        def body_acc(t_blk, w_blk, *rest):
+            o_blk = rest[-1]
+            sc_row = rest[0][0] if sc_ref is not None else None
+            k = pl.program_id(2)
+            part = jnp.dot(t_blk[...], w_blk[0],
+                           preferred_element_type=jnp.float32)
+
+            @pl.when(k == 0)
+            def _():
+                acc_ref[...] = part
+
+            @pl.when(k > 0)
+            def _():
+                acc_ref[...] = acc_ref[...] + part
+
+            @pl.when(k == nk - 1)
+            def _():
+                acc = acc_ref[...]
+                if sc_row is not None:
+                    acc = acc * sc_row[:, None]
+                o_blk[...] = acc.astype(out_dtype)
+
+        pltpu.emit_pipeline(
+            body_acc,
+            grid=(m_steps, N // block_n, nk),
+            in_specs=[
+                pl.BlockSpec((block_m, block_k), lambda i, j, k: (i, k)),
+                pl.BlockSpec((1, block_k, block_n),
+                             lambda i, j, k: (be_ref[base_blk + i], k, j)),
+            ] + sc_specs3,
+            out_specs=[pl.BlockSpec((block_m, block_n),
+                                    lambda i, j, k: (i, j))],
+        )(t_ref, w_ref, *sc_args, o_ref)
+        return
 
     def body(t_blk, w_blk, *rest):
         o_blk = rest[-1]
@@ -168,7 +234,6 @@ def emit_grouped_gemm(t_ref, w_ref, o_ref, be_ref, base_blk,
 
     sc_specs = ([pl.BlockSpec((1, block_m), lambda i, j: (i, 0))]
                 if sc_ref is not None else [])
-    sc_args = (sc_ref,) if sc_ref is not None else ()
     pltpu.emit_pipeline(
         body,
         grid=(m_steps, N // block_n),
@@ -185,7 +250,9 @@ def grouped_gemm(tokens: jax.Array, weights: jax.Array,
                  block_expert: jax.Array, block_m: int = 128,
                  block_n: int = 128, out_dtype=None,
                  n_blocks_used: jax.Array | None = None,
-                 row_scale: jax.Array | None = None) -> jax.Array:
+                 row_scale: jax.Array | None = None,
+                 masked: bool = True,
+                 block_k: int | None = None) -> jax.Array:
     """``out[i*bm:(i+1)*bm] = tokens[i*bm:(i+1)*bm] @ weights[block_expert[i]]``.
 
     tokens: [P, H] (expert-aligned rows), weights: [E, H, N],
@@ -198,7 +265,11 @@ def grouped_gemm(tokens: jax.Array, weights: jax.Array,
     truncates the row-block walk at runtime, skipping the up-to-``E`` blocks
     of pure per-expert padding in the aligned layout — rows past the bound
     are returned ZEROED (callers mask by row validity anyway; zero keeps the
-    op total-function for reuse in autodiff contexts).
+    op total-function for reuse in autodiff contexts). ``masked=False``
+    skips that zeroing pass (a full read+write of the output) and leaves
+    rows past the bound UNDEFINED — for callers whose scatter-back already
+    drops invalid rows by index (``apply_grouped``'s out-of-range ``src``
+    with ``mode="drop"`` never reads them).
 
     ``row_scale`` ([P] f32) folds a per-row dequantization scale into the
     f32 accumulator: ``out_row = scale · (q_row @ w)``. Per-row scaling
@@ -227,6 +298,10 @@ def grouped_gemm(tokens: jax.Array, weights: jax.Array,
     n_sc = 0 if sc2d is None else 1
 
     if n_blocks_used is None:
+        assert block_k is None or block_k >= H, (
+            "block_k (K-split) is implemented on the runtime-bounded path "
+            "only — pass n_blocks_used (the serving path always does)")
+
         def kernel(be_ref, *refs):
             o_ref = refs[-1]
             t_ref, w_ref = refs[:2]
@@ -260,15 +335,18 @@ def grouped_gemm(tokens: jax.Array, weights: jax.Array,
 
     # runtime-bounded path: zero-init the output, then emit_pipeline over a
     # dynamic grid — padding blocks cost neither DMA nor MXU work
+    # block_n was gcd-clamped above — safe for the scratch shape directly
     nb = jnp.asarray(n_blocks_used, jnp.int32).reshape(1)
+    ksplit = block_k is not None and block_k < H
 
     def kernel(be_ref, nb_ref, *refs):
-        o_ref = refs[-1]
+        o_ref = refs[-1] if not ksplit else refs[-2]
+        acc = refs[-1] if ksplit else None
         t_ref, w_ref = refs[:2]
         sc_ref = refs[2] if n_sc else None
         emit_grouped_gemm(t_ref, w_ref, o_ref, be_ref, 0, block_m, block_n,
                           out_dtype, n_blocks_used=nb_ref[0],
-                          sc_ref=sc_ref)
+                          sc_ref=sc_ref, block_k=block_k, acc_ref=acc)
 
     out = pl.pallas_call(
         kernel,
@@ -278,6 +356,8 @@ def grouped_gemm(tokens: jax.Array, weights: jax.Array,
                   pl.BlockSpec(memory_space=pl.ANY)]
         + [pl.BlockSpec(memory_space=pl.ANY)] * n_sc,
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=([pltpu.VMEM((block_m, block_n), jnp.float32)]
+                        if ksplit else []),
         out_shape=jax.ShapeDtypeStruct((P, N), out_dtype),
         cost_estimate=pl.CostEstimate(
             flops=2 * P * H * N,
@@ -287,8 +367,196 @@ def grouped_gemm(tokens: jax.Array, weights: jax.Array,
         interpret=default_interpret(),
     )(block_expert, nb, tokens, weights,
       *(() if sc2d is None else (sc2d,)))
+    if not masked:
+        return out
     # rows past the bound were never written; zero them so the result is a
     # total function of the inputs
+    row_blk = jnp.arange(P, dtype=jnp.int32) // block_m
+    return jnp.where((row_blk < nb[0])[:, None], out,
+                     jnp.zeros((), out_dtype))
+
+
+def grouped_gemm_gated(tokens: jax.Array, w_gate: jax.Array,
+                       w_up: jax.Array, block_expert: jax.Array,
+                       block_m: int = 128, block_n: int = 128,
+                       out_dtype=None,
+                       n_blocks_used: jax.Array | None = None,
+                       row_scale: jax.Array | None = None,
+                       activation=jax.nn.silu,
+                       masked: bool = True,
+                       block_k: int | None = None) -> jax.Array:
+    """Fused gated grouped GEMM: ``out = act(x @ wg[e]) * (x @ wu[e])`` per
+    expert-aligned row block — the gate and up projections of the MoE FFN in
+    ONE kernel. Each x-tile is read from HBM once and contracted against
+    both experts' weight tiles while resident in VMEM; the activation and
+    elementwise product happen on the f32 accumulators before the result is
+    cast — no intermediate gate/up arrays in HBM, no separate activation
+    pass, one kernel launch instead of two (the reference runs gate and up
+    as separate grouped GEMM launches plus an elementwise kernel,
+    test_ep_moe_inference.py FFN; this fusion is the TPU-shaped cut).
+
+    Signature follows ``grouped_gemm``: w_gate/w_up [E, H, F]; ``row_scale``
+    folds a per-row wire-dequant scale into BOTH accumulators (scaling
+    commutes with each matmul, and ``act(s·g)·(s·u)`` IS the dequantized
+    math); ``n_blocks_used`` bounds the row-block walk at runtime;
+    ``masked=False`` leaves rows past the bound undefined (see
+    ``grouped_gemm``)."""
+    import math
+
+    P, H = tokens.shape
+    E, H2, F = w_gate.shape
+    assert w_up.shape == (E, H2, F), (w_up.shape, w_gate.shape)
+    assert H == H2, (H, H2)
+    block_n = math.gcd(min(block_n, F), F)
+    assert P % block_m == 0, (P, block_m)
+    out_dtype = out_dtype or (tokens.dtype if row_scale is None
+                              else w_gate.dtype)
+    sc2d = (None if row_scale is None
+            else row_scale.astype(jnp.float32).reshape(P // block_m,
+                                                       block_m))
+    n_sc = 0 if sc2d is None else 1
+    cost = pl.CostEstimate(
+        flops=4 * P * H * F,
+        bytes_accessed=(P * H + 2 * E * H * F + P * F)
+        * jnp.dtype(tokens.dtype).itemsize,
+        transcendentals=P * F)
+
+    if n_blocks_used is None:
+        assert block_k is None or block_k >= H, (
+            "block_k (K-split) is implemented on the runtime-bounded path "
+            "only — pass n_blocks_used (the serving path always does)")
+        def kernel(be_ref, *refs):
+            o_ref = refs[-1]
+            t_ref, wg_ref, wu_ref = refs[:3]
+            sc_row = refs[3][0] if n_sc else None
+            o_ref[...] = _gated_block(t_ref, wg_ref, wu_ref, sc_row,
+                                      out_dtype, activation)
+
+        sc_specs = ([pl.BlockSpec((1, block_m), lambda i, j, be: (i, 0))]
+                    if n_sc else [])
+        return pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(P // block_m, F // block_n),
+                in_specs=[
+                    pl.BlockSpec((block_m, H), lambda i, j, be: (i, 0)),
+                    pl.BlockSpec((1, H, block_n),
+                                 lambda i, j, be: (be[i], 0, j)),
+                    pl.BlockSpec((1, H, block_n),
+                                 lambda i, j, be: (be[i], 0, j)),
+                ] + sc_specs,
+                out_specs=pl.BlockSpec((block_m, block_n),
+                                       lambda i, j, be: (i, j)),
+            ),
+            out_shape=jax.ShapeDtypeStruct((P, F), out_dtype),
+            cost_estimate=cost,
+            interpret=default_interpret(),
+        )(block_expert, tokens, w_gate, w_up,
+          *(() if sc2d is None else (sc2d,)))
+
+    nb = jnp.asarray(n_blocks_used, jnp.int32).reshape(1)
+    ksplit = block_k is not None and block_k < H
+    if ksplit:
+        assert H % block_k == 0, (H, block_k)
+
+    def kernel(be_ref, nb_ref, *refs):
+        if ksplit:
+            o_ref, acc_g, acc_u = refs[-3], refs[-2], refs[-1]
+        else:
+            o_ref = refs[-1]
+        t_ref, wg_ref, wu_ref = refs[:3]
+        sc_ref = refs[3] if n_sc else None
+        m_steps = jnp.minimum(nb_ref[0], P // block_m)
+        sc_args = (sc_ref,) if sc_ref is not None else ()
+
+        if ksplit:
+            nk = H // block_k
+
+            def body_acc(t_blk, wg_blk, wu_blk, *rest):
+                o_blk = rest[-1]
+                sc_row = rest[0][0] if sc_ref is not None else None
+                k = pl.program_id(2)
+                g = jnp.dot(t_blk[...], wg_blk[0],
+                            preferred_element_type=jnp.float32)
+                u = jnp.dot(t_blk[...], wu_blk[0],
+                            preferred_element_type=jnp.float32)
+
+                @pl.when(k == 0)
+                def _():
+                    acc_g[...] = g
+                    acc_u[...] = u
+
+                @pl.when(k > 0)
+                def _():
+                    acc_g[...] = acc_g[...] + g
+                    acc_u[...] = acc_u[...] + u
+
+                @pl.when(k == nk - 1)
+                def _():
+                    gt, ut = acc_g[...], acc_u[...]
+                    if sc_row is not None:
+                        gt = gt * sc_row[:, None]
+                        ut = ut * sc_row[:, None]
+                    o_blk[...] = (activation(gt) * ut).astype(out_dtype)
+
+            sc_specs = ([pl.BlockSpec((1, block_m),
+                                      lambda i, j, k: (i, 0))]
+                        if sc_ref is not None else [])
+            pltpu.emit_pipeline(
+                body_acc,
+                grid=(m_steps, F // block_n, nk),
+                in_specs=[
+                    pl.BlockSpec((block_m, block_k),
+                                 lambda i, j, k: (i, k)),
+                    pl.BlockSpec((1, block_k, block_n),
+                                 lambda i, j, k: (be_ref[i], k, j)),
+                    pl.BlockSpec((1, block_k, block_n),
+                                 lambda i, j, k: (be_ref[i], k, j)),
+                ] + sc_specs,
+                out_specs=[pl.BlockSpec((block_m, block_n),
+                                        lambda i, j, k: (i, j))],
+            )(t_ref, wg_ref, wu_ref, *sc_args, o_ref)
+            return
+
+        def body(t_blk, wg_blk, wu_blk, *rest):
+            o_blk = rest[-1]
+            sc_row = rest[0][0] if sc_ref is not None else None
+            o_blk[...] = _gated_block(t_blk, wg_blk, wu_blk, sc_row,
+                                      out_dtype, activation)
+
+        sc_specs = ([pl.BlockSpec((1, block_m), lambda i, j: (i, 0))]
+                    if sc_ref is not None else [])
+        pltpu.emit_pipeline(
+            body,
+            grid=(m_steps, F // block_n),
+            in_specs=[
+                pl.BlockSpec((block_m, H), lambda i, j: (i, 0)),
+                pl.BlockSpec((1, H, block_n), lambda i, j: (be_ref[i], 0, j)),
+                pl.BlockSpec((1, H, block_n), lambda i, j: (be_ref[i], 0, j)),
+            ] + sc_specs,
+            out_specs=[pl.BlockSpec((block_m, block_n),
+                                    lambda i, j: (i, j))],
+        )(t_ref, wg_ref, wu_ref, *sc_args, o_ref)
+
+    out = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)]
+        + [pl.BlockSpec(memory_space=pl.ANY)] * n_sc,
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=([pltpu.VMEM((block_m, block_n), jnp.float32)] * 2
+                        if ksplit else []),
+        out_shape=jax.ShapeDtypeStruct((P, F), out_dtype),
+        cost_estimate=cost,
+        interpret=default_interpret(),
+    )(block_expert, nb, tokens, w_gate, w_up,
+      *(() if sc2d is None else (sc2d,)))
+    if not masked:
+        return out
     row_blk = jnp.arange(P, dtype=jnp.int32) // block_m
     return jnp.where((row_blk < nb[0])[:, None], out,
                      jnp.zeros((), out_dtype))
@@ -312,6 +580,7 @@ def apply_grouped(tokens: jax.Array, ids: jax.Array, num_experts: int, fn,
     T = tokens.shape[0]
     gather_idx, row_valid, block_expert, nb = align_tokens_by_expert(
         ids, num_experts, block_m, with_used_count=True)
+    P_rows = gather_idx.shape[0]
     vmask = row_valid[:, None]
     x = jnp.where(vmask, tokens[gather_idx], 0).astype(tokens.dtype)
     if row_scale is not None:
@@ -320,10 +589,16 @@ def apply_grouped(tokens: jax.Array, ids: jax.Array, num_experts: int, fn,
         y = fn(x, block_expert, nb, s)
     else:
         y = fn(x, block_expert, nb)
-    out = jnp.zeros((T, y.shape[-1]), y.dtype)
-    src = jnp.where(row_valid, gather_idx, T)
-    return out.at[src].add(y * row_valid[:, None].astype(y.dtype),
-                           mode="drop")
+    # Scatter-back is a GATHER by the inverse permutation: each source row
+    # lands in at most one aligned slot, so ``out[t] = y[dest_row[t]]``
+    # with out-of-range fill for unrouted rows. The scatter-add spelling
+    # (`out.at[src].add`) measured 1.5 ms at the DeepSeek serving shape —
+    # TPU scatter serializes; the inverse gather is a plain take. The
+    # tiny int scatter building dest_row ([P] int32) is noise.
+    dest_row = jnp.full((T,), P_rows, jnp.int32).at[
+        jnp.where(row_valid, gather_idx, T)].set(
+        jnp.arange(P_rows, dtype=jnp.int32), mode="drop")
+    return jnp.take(y, dest_row, axis=0, mode="fill", fill_value=0)
 
 
 def moe_ffn_local(tokens: jax.Array, ids: jax.Array, w_up: jax.Array,
@@ -346,4 +621,5 @@ def moe_ffn_local(tokens: jax.Array, ids: jax.Array, w_up: jax.Array,
 
 
 __all__ = ["align_tokens_by_expert", "used_block_count", "emit_grouped_gemm",
-           "grouped_gemm", "apply_grouped", "moe_ffn_local"]
+           "grouped_gemm", "grouped_gemm_gated", "apply_grouped",
+           "moe_ffn_local"]

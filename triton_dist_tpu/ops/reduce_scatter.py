@@ -124,6 +124,13 @@ def reduce_scatter(ctx: ShmemContext, x: jax.Array, axis: str | None = None,
                 f"multi-axis reduce_scatter spans ALL mesh axes "
                 f"{ctx.axis_names}; got subset/reorder {tuple(axis)!r}")
         axis = None
+    involved = tuple(ctx.axis_names) if axis is None else (axis,)
+    if method == "xla" or any(ctx.is_dcn_axis(a) for a in involved):
+        # DCN tier: a scatter group containing a slice-crossing axis runs
+        # on XLA ``psum_scatter`` end to end (remote DMA cannot cross DCN;
+        # XLA's collectives route each hop over the right transport —
+        # the reference's inter-node tier analog, reduce_scatter.py:430-785)
+        return _rs_xla(ctx, x, involved)
     if method == "auto":
         method = "ring_2d" if (axis is None and len(ctx.axis_names) > 1) \
             else "ring"
@@ -146,6 +153,22 @@ def reduce_scatter(ctx: ShmemContext, x: jax.Array, axis: str | None = None,
     mesh_axes = ctx.axis_names
     f = lambda shard: _rs_call(axis, mesh_axes, n, shard)
     sm = ctx.shard_map(f, in_specs=P(axis), out_specs=P(axis))
+    return sm(x)
+
+
+def _rs_xla(ctx: ShmemContext, x: jax.Array, involved: tuple):
+    """XLA-collective reduce-scatter over ``involved`` axes, outermost
+    first so device (o, …, i) ends up owning the row-major P(involved)
+    segment — the order the ring paths also produce."""
+    from jax import lax
+
+    def f(shard):
+        out = shard
+        for ax in involved:
+            out = lax.psum_scatter(out, ax, scatter_dimension=0, tiled=True)
+        return out
+
+    sm = ctx.shard_map(f, in_specs=P(involved), out_specs=P(involved))
     return sm(x)
 
 

@@ -70,6 +70,33 @@ def _tile_off(zigzag, c, lo, hi, start):
     return jnp.where(start < c, lo + start, hi + (start - c))
 
 
+def _diag_sub(bq: int, bk: int, causal: bool,
+              default: int = 256) -> int | None:
+    """Row-band height for the diagonal-tile split, or None when the split
+    does not apply (non-causal; non-square tiles, whose diagonal crossing
+    is not a single aligned tile; tiles too small to sub-divide). 256 rows
+    = 2×128 MXU passes per band — small enough that the skipped upper
+    triangle dominates the extra per-band state updates, large enough
+    that each dot still fills the MXU (round-5 on-chip A/B at
+    (1024, 1024): see docs/benchmarks.md). Override with TDT_DIAG_SUB
+    (0 disables the split)."""
+    import os
+    env = os.environ.get("TDT_DIAG_SUB")
+    if env is not None:
+        v = int(env)
+        if v <= 0:
+            return None
+        default = v
+    if not causal or bq != bk:
+        return None
+    sub = min(default, bq)
+    if bq % sub or sub % 128:
+        return None
+    if bq // sub < 2:
+        return None
+    return sub
+
+
 def _causal_tile_dispatch(q_t, kv_t, bq, bk, compute):
     """Route one causal tile to the cheapest body: skip fully-masked
     tiles, run interior tiles mask-free, pay the iota+where mask only on
@@ -158,41 +185,92 @@ def _attn_step_pipeline(step_init, step_final, causal, zigzag, D, bq, bk,
         q_t = _tile_off(zigzag, c, q_lo, q_hi, qi * bq)
         kv_t = _tile_off(zigzag, c, kv_lo, kv_hi, kvi * bk)
 
-        def compute(masked: bool):
-            # matmul operands stay in the INPUT dtype (f32 accumulate):
-            # upcasting bf16 q/k to f32 first would run the MXU at its
-            # ~4x-slower f32 rate — the round-2 42%-MFU bottleneck.
-            # q is prescaled (sm_scale·log2e folded in), so s_ij is
-            # ready for the base-2 running softmax as-is.
-            s_ij = lax.dot_general(q_blk[0], k_blk[0],
+        def update_rows(r0, rows, q_rows, k_cols, v_cols, keep):
+            """Online-softmax update of scr rows [r0, r0+rows) against the
+            key/value column slice. ``keep`` (None = mask-free) masks the
+            scores before the running max and the probabilities after.
+            Matmul operands stay in the INPUT dtype (f32 accumulate):
+            upcasting bf16 q/k to f32 first would run the MXU at its
+            ~4x-slower f32 rate — the round-2 42%-MFU bottleneck. q is
+            prescaled (sm_scale·log2e folded in), so s_ij feeds the
+            base-2 running softmax as-is."""
+            s_ij = lax.dot_general(q_rows, k_cols,
                                    (((1,), (1,)), ((), ())),
                                    preferred_element_type=jnp.float32)
-            if masked:
-                qpos = q_t + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-                kpos = kv_t + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-                keep = kpos <= qpos
+            if keep is not None:
                 s_ij = jnp.where(keep, s_ij, _NEG)
 
-            acc_p = scr[:, :D]
-            m_p = jnp.max(scr[:, D:D + 128], axis=-1, keepdims=True)
-            l_p = jnp.max(scr[:, D + 128:], axis=-1, keepdims=True)
+            acc_p = scr[r0:r0 + rows, :D]
+            m_p = jnp.max(scr[r0:r0 + rows, D:D + 128], axis=-1,
+                          keepdims=True)
+            l_p = jnp.max(scr[r0:r0 + rows, D + 128:], axis=-1,
+                          keepdims=True)
 
             m_c = jnp.maximum(jnp.max(s_ij, axis=-1, keepdims=True), m_p)
             p = jnp.exp2(s_ij - m_c)
-            if masked:
+            if keep is not None:
                 # exp2(-1e30 - (-1e30)) == 1 on fully-masked rows; re-mask
                 p = jnp.where(keep, p, 0.0)
             alpha = jnp.exp2(m_p - m_c)
             l_c = l_p * alpha + jnp.sum(p, axis=-1, keepdims=True)
             acc_c = acc_p * alpha + lax.dot_general(
-                p.astype(v_blk.dtype), v_blk[0], (((1,), (0,)), ((), ())),
+                p.astype(v_cols.dtype), v_cols, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
-            scr[:, :D] = acc_c
-            scr[:, D:D + 128] = jnp.broadcast_to(m_c, (bq, 128))
-            scr[:, D + 128:] = jnp.broadcast_to(l_c, (bq, 128))
+            scr[r0:r0 + rows, :D] = acc_c
+            scr[r0:r0 + rows, D:D + 128] = jnp.broadcast_to(m_c, (rows, 128))
+            scr[r0:r0 + rows, D + 128:] = jnp.broadcast_to(l_c, (rows, 128))
 
-        if causal:
+        def compute(masked: bool):
+            if masked:
+                qpos = q_t + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+                kpos = kv_t + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+                keep = kpos <= qpos
+            else:
+                keep = None
+            update_rows(0, bq, q_blk[0], k_blk[0], v_blk[0], keep)
+
+        diag_sub = _diag_sub(bq, bk, causal)
+
+        def compute_diag():
+            # exactly-diagonal square tile (q_t == kv_t): walk row bands
+            # of ``diag_sub`` rows. Band i multiplies against columns
+            # [0, i·sub) mask-free (everything there is strictly below the
+            # diagonal) plus a (sub, sub) masked band on the diagonal
+            # itself — skipping the upper triangle's MXU work entirely and
+            # paying the iota+where mask on sub²/bq·bk of the tile (1/16
+            # at sub=256, bq=bk=1024). This is the "masked sub-band +
+            # interior remainder" split the round-4 roofline named as the
+            # remaining causal lever (docs/benchmarks.md).
+            band_keep = (lax.broadcasted_iota(jnp.int32,
+                                              (diag_sub, diag_sub), 1)
+                         <= lax.broadcasted_iota(jnp.int32,
+                                                 (diag_sub, diag_sub), 0))
+            for i in range(bq // diag_sub):
+                r0 = i * diag_sub
+                q_rows = q_blk[0][r0:r0 + diag_sub, :]
+                if r0 > 0:
+                    update_rows(r0, diag_sub, q_rows, k_blk[0][:r0, :],
+                                v_blk[0][:r0, :], None)
+                update_rows(r0, diag_sub, q_rows,
+                            k_blk[0][r0:r0 + diag_sub, :],
+                            v_blk[0][r0:r0 + diag_sub, :], band_keep)
+
+        if causal and diag_sub is not None:
+            # three-way tile routing with the diagonal split: interior
+            # mask-free, exact-diagonal banded, any other straddle (tiles
+            # not aligned to the diagonal, e.g. unaligned layout offsets)
+            # whole-tile masked
+            has_work = kv_t <= q_t + (bq - 1)
+            interior = kv_t + (bk - 1) <= q_t
+            straddle = jnp.logical_and(has_work, jnp.logical_not(interior))
+            on_diag = q_t == kv_t
+            pl.when(jnp.logical_and(has_work, interior))(
+                lambda: compute(False))
+            pl.when(jnp.logical_and(straddle, on_diag))(compute_diag)
+            pl.when(jnp.logical_and(straddle, jnp.logical_not(on_diag)))(
+                lambda: compute(True))
+        elif causal:
             # (under ``flat`` every enumerated tile has work; the dispatch
             # still routes interior tiles to the mask-free body)
             _causal_tile_dispatch(q_t, kv_t, bq, bk, compute)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import functools
 from functools import partial
 from typing import Any, Callable, Sequence
 
@@ -145,6 +146,21 @@ def get_default_context() -> "ShmemContext":
     return _DEFAULT_CONTEXT
 
 
+@functools.lru_cache(maxsize=None)
+def _mesh_axis_crosses_slices(mesh: Mesh, axis: str) -> bool:
+    """Constant for a given (mesh, axis) — cached so the per-collective
+    ``is_dcn_axis`` check costs a dict lookup, not a device scan (only the
+    TDT_DCN_AXES env override stays dynamic)."""
+    idx = mesh.axis_names.index(axis)
+    devs = np.moveaxis(mesh.devices, idx, 0)
+    # any column along the axis whose devices span >1 slice_index
+    cols = devs.reshape(devs.shape[0], -1)
+    for j in range(cols.shape[1]):
+        if len({getattr(d, "slice_index", 0) for d in cols[:, j]}) > 1:
+            return True
+    return False
+
+
 @dataclasses.dataclass(frozen=True)
 class ShmemContext:
     """Mesh + symmetric-buffer factory. Frozen so it can live in closures of
@@ -171,6 +187,26 @@ class ShmemContext:
                 n *= self.mesh.shape[a]
             return n
         return self.mesh.shape[axis]
+
+    def is_dcn_axis(self, axis: str) -> bool:
+        """True when neighbouring devices along ``axis`` live on different
+        TPU slices — their link is DCN (data-center network), not ICI, and
+        ``pltpu.make_async_remote_copy`` cannot cross it. Hierarchical ops
+        route such an axis' tier through XLA collectives (host-driven DCN
+        transfers) instead of remote DMA; an ICI-only mesh is unchanged.
+        This is the TPU analog of the reference's intra/inter-node split
+        (its inter-node tier is a different transport — IBRC/IBGDA,
+        reference allgather.py:291-375, ep_a2a.py:35-147).
+
+        Detection: ``device.slice_index`` varies along the axis. The
+        ``TDT_DCN_AXES`` env var (comma-separated axis names) forces axes
+        to DCN for testing/virtual topologies — the AOT topology gate
+        compiles the DCN variants this way on hosts with no multi-slice
+        hardware."""
+        forced = os.environ.get("TDT_DCN_AXES")
+        if forced and axis in [a.strip() for a in forced.split(",")]:
+            return True
+        return _mesh_axis_crosses_slices(self.mesh, axis)
 
     # -- symmetric heap -----------------------------------------------------
 
