@@ -194,7 +194,15 @@ def bench_a2a(ctx, tokens_per_rank: int, hidden: int, topk: int,
         eps = (jnp.sum(rq.astype(jnp.float32)) * 1e-20).astype(t.dtype)
         return t + eps
 
-    dispatch_s = _per_iter(make_chain_timer(disp_step, tokens, ids), i1, i2)
+    disp_timer = make_chain_timer(disp_step, tokens, ids)
+    dispatch_s = _per_iter(disp_timer, i1, i2)
+    # the MXU-gather dispatch is ~25 µs: i2=1610 puts only ~40 ms of
+    # differenced signal against the tunnel's ~50 ms jitter, which can
+    # return a noise-floor artifact (0.2 µs observed). Re-measure with a
+    # 4x chain when the reading is implausibly low (< 5 µs covers kernel
+    # launch + the wire copy alone).
+    if dispatch_s < 5e-6 and i2 > i1 + 100:
+        dispatch_s = _per_iter(disp_timer, i1, (i2 - i1) * 4 + i1)
 
     # dispatch→combine roundtrip self-chains ([T,H] → [T,H]), so it can be
     # timed as a data-dependent scan — immune to host-dispatch noise
@@ -451,6 +459,72 @@ def bench_ep_block(ctx, i1: int, i2: int, T: int = 128, D: int = 7168,
         step, jnp.zeros((), jnp.float32), (rw, wg, wu, wd, x)), i1, i2)
 
 
+def bench_small_ag(ctx, i1: int, i2: int) -> dict:
+    """Small-message AG latency rows (VERDICT r4 Missing #3 / Next #9):
+    XLA ``all_gather`` vs the Pallas ``push`` AG vs the barrier-free LL AG
+    at 4/16/64 KB per-rank payloads (f32, 128 lanes). At n=1 the wire
+    degenerates and the rows measure per-call overhead (launch + barrier
+    vs launch only) — the regime where the LL design pays; real
+    multi-chip runs measure the full story."""
+    from triton_dist_tpu.ops import (all_gather, all_gather_ll,
+                                     create_ag_ll_workspace)
+
+    axis = ctx.axis_names[0]
+    n = ctx.axis_size(axis)
+    out = {}
+    for kb in (4, 16, 64):
+        rows = max(8, kb * 1024 // (128 * 4))
+        x = ctx.shard(jax.random.normal(jax.random.key(kb),
+                                        (n * rows, 128), jnp.float32),
+                      P(axis))
+
+        sm = ctx.shard_map(
+            lambda s: lax.all_gather(s, axis, axis=0, tiled=True),
+            in_specs=P(axis), out_specs=P(None, None))
+
+        def xla_step(v, _):
+            y = sm(v)
+            return v + (jnp.sum(y.astype(jnp.float32))[None, None]
+                        * 1e-20).astype(v.dtype)
+
+        out[f"ag_xla_{kb}kb_us"] = round(_per_iter(make_chain_timer(
+            xla_step, x, None), i1, i2) * 1e6, 1)
+
+        def push_step(v, _):
+            y = all_gather(ctx, v, axis=axis, method="push")
+            return v + (jnp.sum(y.astype(jnp.float32))[None, None]
+                        * 1e-20).astype(v.dtype)
+
+        out[f"ag_push_{kb}kb_us"] = round(_per_iter(make_chain_timer(
+            push_step, x, None), i1, i2) * 1e6, 1)
+
+        # LL: ws-threaded custom chain (phase alternates per iteration)
+        ws0 = create_ag_ll_workspace(ctx, rows, (128,), jnp.float32,
+                                     axis=axis)
+        cache = {}
+
+        def ll_timer(iters, x=x, ws0=ws0):
+            if iters not in cache:
+                def chain(v, ws):
+                    def body(c, k):
+                        vv, w = c
+                        y, w = all_gather_ll(ctx, vv, w,
+                                             (k % 2)[None].astype(jnp.int32),
+                                             axis=axis)
+                        eps = (jnp.sum(y.astype(jnp.float32)) * 1e-20
+                               ).astype(vv.dtype)
+                        return (vv + eps, w), None
+                    (vv, _), _ = lax.scan(body, (v, ws),
+                                          jnp.arange(iters))
+                    return jnp.sum(vv.astype(jnp.float32))
+                cache[iters] = jax.jit(chain)
+            return float(cache[iters](x, ws0))
+
+        out[f"ag_ll_{kb}kb_us"] = round(
+            _per_iter(ll_timer, i1, i2) * 1e6, 1)
+    return out
+
+
 def bench_baselines(ctx, n_dev: int, M: int, N: int, K: int, cfg,
                     i1: int, i2: int) -> dict:
     """Non-overlap baselines at the headline shape (VERDICT r4 Missing #1 —
@@ -503,8 +577,16 @@ def bench_baselines(ctx, n_dev: int, M: int, N: int, K: int, cfg,
         # pure XLA and needs every output live)
         return x + (jnp.sum(y.astype(jnp.float32)) * 1e-30).astype(x.dtype)
 
-    out["xla_ag_dot_tflops"] = tflops(
-        _per_iter(make_chain_timer(xla_step, a_s, b_s), i1, i2))
+    # same plausibility guard as the headline: a baseline row above 95%
+    # of dense peak is an interference artifact, and an inflated
+    # non-overlap row would understate the overlap delta this bench
+    # exists to measure
+    v, artifact = _plausible(lambda: tflops(
+        _per_iter(make_chain_timer(xla_step, a_s, b_s), i1, i2)),
+        frac=0.95)
+    out["xla_ag_dot_tflops"] = v
+    if artifact:
+        out["xla_ag_dot_artifact"] = True
 
     # 2. bare Pallas GEMM, same tile config as the overlap kernel
     if n_dev == 1:
@@ -955,6 +1037,15 @@ def main(a2a_primary: bool = False):
         extras.update(bench_baselines(ctx, n_dev, M, N, K, cfg, i1, i2))
 
     attempt("baselines", _baselines)
+
+    def _small_ag():
+        # small-message AG latency family (LL vs push vs XLA); chip only —
+        # interpret-mode kernels inside the scan chain deadlock the
+        # simulator (see the scan+interpret note in tests/conftest.py)
+        if not on_cpu():
+            extras.update(bench_small_ag(ctx, i1=10, i2=1610))
+
+    attempt("small_ag", _small_ag)
 
     if artifact:
         # three impossible readings in a row: report, but flagged so no

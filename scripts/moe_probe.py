@@ -23,12 +23,14 @@ One JSON line per stage. Timing = the bench differenced scan-chain
 from __future__ import annotations
 
 import json
+import os
 import sys
 
 import jax
 import jax.numpy as jnp
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
 
 from bench import _per_iter, make_chain_timer  # noqa: E402
 
@@ -171,8 +173,8 @@ def main():
         return make_chain_timer(step, jnp.zeros((), jnp.float32),
                                 (wg, wu, wd, tokens))
 
-    for cfg in [(128, 128, None, 128), (128, 512, 3584, 1024),
-                (256, 256, 3584, 1024), (256, 512, 1792, 1024)]:
+    for cfg in [(128, 128, None, 512), (256, 512, 1792, 512),
+                (256, 256, 1792, 512), (128, 128, None, 128)]:
         guard(f"ffn_{'_'.join(str(c) for c in cfg)}",
               lambda c=cfg: emit(f"ffn_{'_'.join(str(x) for x in c)}",
                                  _per_iter(ffn_timer(c), i1, i2)))

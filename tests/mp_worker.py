@@ -90,13 +90,20 @@ def main() -> None:
             y2 = jax.jit(lambda v: all_gather(ctx, v, axis="x",
                                               method="push"))(x)
             got = np.asarray(jax.device_get(y2))
-            np.testing.assert_allclose(
-                got, np.arange(4 * 8 * 128,
-                               dtype=np.float32).reshape(4 * 8, 128))
-            print("MP_AG_OK", flush=True)
         except Exception as e:
             print(f"MP_AG_UNSUPPORTED {type(e).__name__}: {str(e)[:160]}",
                   flush=True)
+            return
+        try:
+            np.testing.assert_allclose(
+                got, np.arange(4 * 8 * 128,
+                               dtype=np.float32).reshape(4 * 8, 128))
+        except AssertionError as e:
+            # ran but produced WRONG data — a distinct (worst) outcome
+            # that must fail the test, never read as "unsupported"
+            print(f"MP_AG_WRONG_RESULT {str(e)[:160]}", flush=True)
+            return
+        print("MP_AG_OK", flush=True)
 
     t = threading.Thread(target=attempt, daemon=True)
     t.start()

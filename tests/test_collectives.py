@@ -27,6 +27,37 @@ def ctx2d():
     return initialize_distributed(axis_names=("a", "b"), mesh_shape=(2, 3))
 
 
+def test_all_gather_ll_repeated(ctx):
+    """The barrier-free LL AG (phase-keyed double-buffered workspace,
+    reference low_latency_allgather.py parity): five consecutive calls
+    through ONE context with fresh data each call — the parity scheme's
+    cross-call reuse is exactly what this exercises."""
+    from triton_dist_tpu.ops import AgLLContext
+
+    n = ctx.num_ranks
+    m = 16
+    ag = AgLLContext(ctx, m_local=m, trailing=(128,), dtype=jnp.float32)
+    for it in range(5):
+        x = jax.random.normal(jax.random.key(it), (n * m, 128), jnp.float32)
+        y = ag(ctx.shard(x, P("x")))
+        assert_allclose(np.asarray(y), np.asarray(x))
+
+
+def test_all_gather_ll_functional(ctx):
+    """Functional ws-threading form under jit (donate-style usage)."""
+    from triton_dist_tpu.ops import all_gather_ll, create_ag_ll_workspace
+
+    n = ctx.num_ranks
+    m = 8
+    ws = create_ag_ll_workspace(ctx, m, (128,), jnp.float32)
+    f = jax.jit(lambda ph, v, w: all_gather_ll(ctx, v, w, ph, axis="x"))
+    for it in range(3):
+        x = jax.random.normal(jax.random.key(10 + it), (n * m, 128))
+        phase = jnp.asarray([it % 2], jnp.int32)
+        y, ws = f(phase, ctx.shard(x, P("x")), ws)
+        assert_allclose(np.asarray(y), np.asarray(x))
+
+
 @pytest.mark.parametrize("method", ["push", "ring"])
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
 def test_all_gather_1d(ctx, method, dtype):

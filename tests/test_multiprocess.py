@@ -58,7 +58,9 @@ def test_two_process_cluster(via_launch_sh):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            # generous: the worker ends with a 45 s overlap-kernel
+            # watchdog, and a fully loaded CI box stretches everything
+            out, _ = p.communicate(timeout=420)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
@@ -73,7 +75,9 @@ def test_two_process_cluster(via_launch_sh):
         # mesh (MP_AG_OK: output matched the golden) or the runtime
         # rejects it loudly (MP_AG_UNSUPPORTED + the error signature;
         # the in-process interpreter cannot back cross-process
-        # DMA/semaphore state — the upstream limitation this pins).
+        # DMA/semaphore state — measured outcome: DEADLOCK, caught by
+        # the worker's watchdog). MP_AG_WRONG_RESULT (ran, corrupt
+        # data) matches neither token and fails here — as it must.
         assert ("MP_AG_OK" in out) or ("MP_AG_UNSUPPORTED" in out), out
     # regex-extract: concurrent C++ (Gloo) log lines can interleave into the
     # same stdout line as the python print
@@ -101,7 +105,9 @@ def test_two_process_merged_profile(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            # generous: the worker ends with a 45 s overlap-kernel
+            # watchdog, and a fully loaded CI box stretches everything
+            out, _ = p.communicate(timeout=420)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:

@@ -274,8 +274,12 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
                 kw["row_scale"] = ss[0]
                 kw["out_dtype"] = a2a.dtype
             hh = grouped_gemm_gated(xs, wg_l, wu_l, be, **kw)
+            # down default bn=512: measured best on-chip at the DeepSeek
+            # serving shape (432.7 µs at bn=128 -> 199.8 at bn=512 — the
+            # (F, 128) weight tiles were DMA-overhead-bound; 1024/1792
+            # overshoot: 336/357 µs; scripts/moe_probe.py round 5)
             return grouped_gemm(hh, wd_l, be, block_m=block_m,
-                                block_n=down_block_n or block_n,
+                                block_n=down_block_n or 512,
                                 n_blocks_used=nb, masked=False)
 
         out = apply_grouped(tflat, iflat, e_local, ffn, block_m=block_m,

@@ -3,7 +3,9 @@ python/triton_dist/kernels/nvidia/*, re-exported the same way its
 kernels/nvidia/__init__.py:25-89 does)."""
 
 from triton_dist_tpu.ops.common import collective_id_for, barrier_all_op  # noqa: F401
-from triton_dist_tpu.ops.allgather import all_gather, broadcast  # noqa: F401
+from triton_dist_tpu.ops.allgather import (all_gather, all_gather_ll,  # noqa: F401
+                                           AgLLContext,
+                                           create_ag_ll_workspace, broadcast)
 from triton_dist_tpu.ops.reduce_scatter import reduce_scatter  # noqa: F401
 from triton_dist_tpu.ops.allgather_gemm import (  # noqa: F401
     ag_gemm, ag_gemm_ws, create_ag_gemm_context, create_ag_gemm_workspace)

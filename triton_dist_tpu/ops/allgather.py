@@ -96,6 +96,141 @@ def _ag_ring_kernel(axis, mesh_axes, in_ref, out_ref, send_sem, recv_sems):
         rdma.wait_send()
 
 
+def _ag_ll_kernel(axis, mesh_axes, phase_ref, in_ref, ws_ref, out_ref,
+                  ws_out, send_sems, recv_sems):
+    """Barrier-free low-latency push AG (the reference's LL flag-parity
+    family, low_latency_allgather.py, re-thought for TPU): arrivals land
+    in a PERSISTENT double-buffered symmetric workspace ``ws[2, n, m, …]``
+    keyed by call parity, delivery is the DMA receive semaphore — no
+    entry barrier, no flag words.
+
+    Why parity alone is safe: a peer's call k+1 cannot complete its waits
+    without MY call-k+1 put, so no peer is ever more than ONE call ahead.
+    While I am in call k the only in-flight signals/writes are calls k
+    (phase p) and k+1 (phase 1-p): the phase-keyed semaphore array and
+    buffer slot disambiguate both. Call k+2 (phase p again) cannot start
+    anywhere before I finish k — my own ws[p] is already drained.
+    The write target must be the persistent ws, NOT the per-call output
+    (XLA may alias a not-yet-entered call's output buffer to live data —
+    an early peer put would corrupt it); the local unpack ws→out is one
+    VMEM-speed copy of a latency-sized payload."""
+    me = shd.my_pe(axis)
+    n = shd.n_pes(axis)
+    m = in_ref.shape[0]
+    p = phase_ref[0]
+
+    # own slot goes straight to the output (never through ws)
+    local = pltpu.make_async_copy(in_ref, out_ref.at[pl.ds(me * m, m)],
+                                  recv_sems.at[p, me])
+    local.start()
+
+    rdmas = []
+    for k in range(1, n):
+        dst = lax.rem(me + k, n)
+        pid = shd.pe_at(mesh_axes, axis, dst)
+        rdmas.append(shd.putmem_nbi(ws_ref.at[p, me], in_ref,
+                                    send_sems.at[p, dst],
+                                    recv_sems.at[p, me], pid))
+
+    local.wait()
+    for k in range(1, n):
+        src = lax.rem(me + k, n)
+        shd.wait_recv(ws_ref.at[p, src], recv_sems.at[p, src])
+        unpack = pltpu.make_async_copy(ws_ref.at[p, src],
+                                       out_ref.at[pl.ds(src * m, m)],
+                                       recv_sems.at[p, src])
+        unpack.start()
+        unpack.wait()
+    shd.quiet(*rdmas)
+    # alias ws through so the caller's buffer stays live & donated
+    del ws_out
+
+
+def all_gather_ll(ctx: ShmemContext, x: jax.Array, ws: jax.Array,
+                  phase: jax.Array, axis: str | None = None):
+    """Low-latency AG for small (≲64 KB/rank) payloads: one barrier-free
+    kernel, phase-keyed double-buffered workspace (see ``_ag_ll_kernel``).
+
+    ``ws``: symmetric [n, 2, n, m, …] from ``create_ag_ll_workspace``,
+    aliased in place and returned (thread it like PRNG keys / the AG-GEMM
+    workspace). ``phase``: int32 [1], the call count modulo 2 — the caller
+    alternates it every call (``AgLLContext`` does the bookkeeping).
+    Returns (gathered [n·m, …] replicated, ws)."""
+    axis = axis or ctx.axis_names[0]
+    n = ctx.axis_size(axis)
+    mesh_axes = ctx.axis_names
+
+    def f(phase_l, shard, ws_shard):
+        # drop the leading symmetric dim (local size 1): the kernel
+        # addresses ws as [2, n, m, …] (cf. ag_gemm_ws's reshape)
+        ws_local = ws_shard.reshape(ws_shard.shape[1:])
+        out_shape = (jax.ShapeDtypeStruct((n * shard.shape[0],)
+                                          + shard.shape[1:], shard.dtype),
+                     jax.ShapeDtypeStruct(ws_local.shape, ws_local.dtype))
+        kernel = lambda ph, i, w, o, wo, ss, rs: _ag_ll_kernel(
+            axis, mesh_axes, ph, i, w, o, wo, ss, rs)
+        out, ws_out = pl.pallas_call(
+            kernel,
+            out_shape=out_shape,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                      pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 2,
+            input_output_aliases={2: 1},
+            scratch_shapes=[pltpu.SemaphoreType.DMA((2, n)),
+                            pltpu.SemaphoreType.DMA((2, n))],
+            # NO collective_id: the whole point is no barrier — and Mosaic
+            # rejects a collective_id on kernels that never call
+            # get_barrier_semaphore (real-TPU rule, see the verify skill)
+            compiler_params=pltpu.CompilerParams(has_side_effects=True),
+            interpret=default_interpret(),
+        )(phase_l, shard, ws_local)
+        return out, ws_out.reshape(ws_shard.shape)
+
+    sm = ctx.shard_map(
+        f, in_specs=(P(), P(axis), P(axis)),
+        out_specs=(P(*([None] * x.ndim)), P(axis)))
+    return sm(phase, x, ws)
+
+
+def create_ag_ll_workspace(ctx: ShmemContext, m_local: int, trailing: tuple,
+                           dtype, axis: str | None = None) -> jax.Array:
+    """Symmetric LL-AG workspace: per-PE [2, n, m_local, *trailing]
+    (double-buffered arrival slots), global [n, 2, n, m, …] P(axis)."""
+    axis = axis or ctx.axis_names[0]
+    n = ctx.axis_size(axis)
+    return ctx.create_symm_tensor((2, n, m_local) + tuple(trailing), dtype,
+                                  axis=axis)
+
+
+class AgLLContext:
+    """Stateful sugar over ``all_gather_ll``: owns the workspace and the
+    call-parity counter (the reference's LL contexts track the same
+    call-count parity, low_latency_allgather.py). Eager-mode only — inside
+    jit/scan use ``all_gather_ll`` and thread (ws, phase) yourself."""
+
+    def __init__(self, ctx: ShmemContext, m_local: int, trailing: tuple,
+                 dtype, axis: str | None = None):
+        from triton_dist_tpu.ops.common import require_eager
+        self._require_eager = require_eager
+        self.ctx = ctx
+        self.axis = axis or ctx.axis_names[0]
+        self.ws = create_ag_ll_workspace(ctx, m_local, trailing, dtype,
+                                         self.axis)
+        self.calls = 0
+        self._jit = jax.jit(
+            lambda ph, x, ws: all_gather_ll(ctx, x, ws, ph, axis=self.axis),
+            donate_argnums=(2,))
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        self._require_eager("AgLLContext", "all_gather_ll")
+        import jax.numpy as jnp
+        phase = jnp.asarray([self.calls % 2], jnp.int32)
+        out, self.ws = self._jit(phase, x, self.ws)
+        self.calls += 1
+        return out
+
+
 def _ag_call(axis: str, mesh_axes, n: int, method: str, shard):
     """Build + invoke the AG pallas_call on a local shard (inside shard_map)."""
     m = shard.shape[0]
@@ -353,4 +488,5 @@ def _ag_ring_2d(ctx: ShmemContext, x: jax.Array):
     return sm(x)
 
 
-__all__ = ["all_gather", "broadcast"]
+__all__ = ["all_gather", "all_gather_ll", "AgLLContext",
+           "create_ag_ll_workspace", "broadcast"]

@@ -167,10 +167,12 @@ def _ffn_run(tokens, ids, w_gate, w_up, w_down, bm, bn):
     def f(x, be, nb):
         h = grouped_gemm_gated(x, w_gate, w_up, be, block_m=bm, block_n=bn,
                                n_blocks_used=nb, masked=False)
-        # down gemm at the SAME bn the winner deploys with
-        # (moe_mlp_ep_overlap's down_block_n defaults to block_n) — the
-        # autotuner must measure the configuration it selects
-        return grouped_gemm(h, w_down, be, block_m=bm, block_n=bn,
+        # down gemm at the SAME bn the winner deploys with: 512,
+        # moe_mlp_ep_overlap's down_block_n default (measured best — see
+        # docs/benchmarks.md tile sweep). The autotuner must measure the
+        # configuration it selects, so the candidate's bn applies only to
+        # the gated kernel, exactly as deployment does.
+        return grouped_gemm(h, w_down, be, block_m=bm, block_n=512,
                             n_blocks_used=nb, masked=False)
 
     return apply_grouped(tokens, ids, w_gate.shape[0], f, block_m=bm)

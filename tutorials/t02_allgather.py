@@ -61,6 +61,61 @@ def correctness_2d():
               "== golden")
 
 
+@register_case("correctness_ll")
+def correctness_ll():
+    """Barrier-free low-latency AG (reference low_latency_allgather.py
+    family): phase-keyed double-buffered symmetric workspace, repeated
+    calls through one context."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from triton_dist_tpu.ops import AgLLContext
+    ctx = world_context()
+    ag = AgLLContext(ctx, m_local=16, trailing=(256,), dtype=jnp.float32)
+    n = ctx.num_ranks
+    for it in range(4):
+        x = jax.random.normal(jax.random.key(it), (n * 16, 256),
+                              jnp.float32)
+        y = ag(ctx.shard(x, P("x")))
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-6)
+    print("all_gather_ll x4 calls (parity reuse) == golden")
+
+
+@register_case("correctness_dcn")
+def correctness_dcn():
+    """DCN-tier routing: with TDT_DCN_AXES forcing the major axis onto the
+    slice-crossing transport, the gather group runs on XLA collectives —
+    same result, different transport (cf. the reference's inter-node
+    IBRC tier, allgather.py:291-375)."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from tutorials.common import world_size
+    from triton_dist_tpu.ops import all_gather
+    n_dev = world_size()
+    if n_dev < 4 or n_dev % 2:
+        raise SystemExit(f"need an even device count >= 4, have {n_dev}")
+    ctx = world_context(axis_names=("a", "b"), mesh_shape=(2, n_dev // 2))
+    os.environ["TDT_DCN_AXES"] = "a"
+    try:
+        assert ctx.is_dcn_axis("a") and not ctx.is_dcn_axis("b")
+        x = jnp.arange(n_dev * 8 * 128, dtype=jnp.float32
+                       ).reshape(n_dev * 8, 128)
+        y = jax.jit(lambda v: all_gather(ctx, v))(
+            ctx.shard(x, P(("a", "b"))))
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+        print("DCN-routed all_gather (major axis on XLA collectives) "
+              "== golden")
+    finally:
+        del os.environ["TDT_DCN_AXES"]
+
+
 @register_case("correctness_broadcast")
 def correctness_broadcast():
     import jax
