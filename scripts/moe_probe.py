@@ -180,7 +180,8 @@ def main():
                                  _per_iter(ffn_timer(c), i1, i2)))
 
     # --- full serving block + dispatch (shared ctx) -------------------------
-    if want("block") or want("disp"):
+    if (want("block") or want("disp") or want("block_fp8_post")
+            or want("block_fp8_expert")):
         from bench import bench_a2a, bench_ep_block
         from triton_dist_tpu.shmem.context import initialize_distributed
         ctx = initialize_distributed(axis_names=("x",),
@@ -196,6 +197,19 @@ def main():
         if want("block"):
             guard("block", lambda: emit("block", bench_ep_block(
                 ctx, i1=10, i2=60 if quick else 210)))
+        if want("block_fp8_post") or want("block_fp8_expert"):
+            # the expert-edge QuantTokens protocol (reference
+            # architecture) vs post-dequant, with the convert-once
+            # x-scratch in the gated kernel (ADVICE r4 #3)
+            guard("block_fp8_post", lambda: emit(
+                "block_fp8_post", bench_ep_block(
+                    ctx, i1=10, i2=60 if quick else 210,
+                    wire_dtype=jnp.float8_e4m3fn, dequant_edge="post")))
+            guard("block_fp8_expert", lambda: emit(
+                "block_fp8_expert", bench_ep_block(
+                    ctx, i1=10, i2=60 if quick else 210,
+                    wire_dtype=jnp.float8_e4m3fn,
+                    dequant_edge="expert")))
 
 
 if __name__ == "__main__":

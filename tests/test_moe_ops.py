@@ -131,6 +131,38 @@ def test_grouped_gemm_ksplit_matches():
                     rtol=1e-4)
 
 
+def test_gated_quantized_convert_once():
+    """Quantized-wire rows through the BOUNDED gated kernel with multiple
+    n-steps (and with K-split): the per-m-step x-conversion scratch path
+    must match the per-tile-convert unbounded path bit-for-bit-ish."""
+    E, H, F, bm = 2, 64, 128, 8
+    P_rows = 4 * bm
+    be = jnp.array([0, 1, 0, 1], jnp.int32)
+    nb = jnp.int32(4)
+    q = jax.random.randint(jax.random.key(0), (P_rows, H), -64, 64
+                           ).astype(jnp.int8)
+    scale = jax.random.uniform(jax.random.key(1), (P_rows,), jnp.float32,
+                               0.01, 0.1)
+    wg = (jax.random.normal(jax.random.key(2), (E, H, F)) * 0.1
+          ).astype(jnp.float32)
+    wu = (jax.random.normal(jax.random.key(3), (E, H, F)) * 0.1
+          ).astype(jnp.float32)
+    want = jax.jit(lambda *a: grouped_gemm_gated(
+        *a[:4], block_m=bm, block_n=32, row_scale=a[4],
+        out_dtype=jnp.float32))(q, wg, wu, be, scale)
+    got = jax.jit(lambda *a: grouped_gemm_gated(
+        *a[:4], block_m=bm, block_n=32, row_scale=a[4],
+        out_dtype=jnp.float32, n_blocks_used=nb))(q, wg, wu, be, scale)
+    assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4,
+                    rtol=1e-4)
+    got_ks = jax.jit(lambda *a: grouped_gemm_gated(
+        *a[:4], block_m=bm, block_n=32, row_scale=a[4],
+        out_dtype=jnp.float32, n_blocks_used=nb, block_k=32))(
+        q, wg, wu, be, scale)
+    assert_allclose(np.asarray(got_ks), np.asarray(want), atol=1e-4,
+                    rtol=1e-4)
+
+
 def test_apply_grouped_unmasked_ffn():
     """The masked=False fast path through apply_grouped (undefined rows
     past the bound are dropped by scatter index) matches moe_ffn_local's

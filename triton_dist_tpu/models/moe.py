@@ -282,8 +282,16 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
                                 block_n=down_block_n or 512,
                                 n_blocks_used=nb, masked=False)
 
+        # fp8 wire rows are cast to the compute dtype inside the gather
+        # pass (Mosaic rejects fp8 x-strips in the grouped pipelines on
+        # the current toolchain — measured round 5; int8 rows feed the
+        # kernels directly and use the convert-once scratch). The scale
+        # keeps riding the accumulators either way.
+        gdt = (a2a.dtype if (quant and jnp.issubdtype(tflat.dtype,
+                                                      jnp.floating))
+               else None)
         out = apply_grouped(tflat, iflat, e_local, ffn, block_m=block_m,
-                            row_scale=sflat)
+                            row_scale=sflat, gather_dtype=gdt)
         if is_2d:
             return out.reshape(tok.shape[:-1] + (-1,))
         return out.reshape(n, tok.shape[-2], -1)

@@ -459,12 +459,26 @@ def grouped_gemm_gated(tokens: jax.Array, w_gate: jax.Array,
     ksplit = block_k is not None and block_k < H
     if ksplit:
         assert H % block_k == 0, (H, block_k)
+    # quantized-wire x (fp8/int8 vs bf16 weights): Mosaic re-converts the
+    # x tile before the MXU once per (m, n[, k]) step, re-paying the VPU
+    # convert F/block_n times per strip (the measured cost that cancelled
+    # the halved read bytes, docs/benchmarks.md expert-edge table).
+    # Convert ONCE per m-step into a compute-dtype VMEM scratch at the
+    # first n-step and feed the MXU from it.
+    convert_once = (n_sc == 1
+                    and jnp.dtype(tokens.dtype).itemsize
+                    < jnp.dtype(w_gate.dtype).itemsize
+                    and F // block_n > 1)
+    cdtype = w_gate.dtype
 
     def kernel(be_ref, nb_ref, *refs):
-        if ksplit:
-            o_ref, acc_g, acc_u = refs[-3], refs[-2], refs[-1]
-        else:
-            o_ref = refs[-1]
+        n_scr = (1 if convert_once else 0) + (2 if ksplit else 0)
+        scratch = refs[len(refs) - n_scr:] if n_scr else ()
+        refs = refs[:len(refs) - n_scr]
+        xcv = scratch[0] if convert_once else None
+        acc_g, acc_u = (scratch[-2], scratch[-1]) if ksplit else (None,
+                                                                  None)
+        o_ref = refs[-1]
         t_ref, wg_ref, wu_ref = refs[:3]
         sc_ref = refs[3] if n_sc else None
         m_steps = jnp.minimum(nb_ref[0], P // block_m)
@@ -477,9 +491,19 @@ def grouped_gemm_gated(tokens: jax.Array, w_gate: jax.Array,
                 o_blk = rest[-1]
                 sc_row = rest[0][0] if sc_ref is not None else None
                 k = pl.program_id(2)
-                g = jnp.dot(t_blk[...], wg_blk[0],
+                if convert_once:
+                    j = pl.program_id(1)
+
+                    @pl.when(j == 0)
+                    def _():
+                        xcv[k, :, :] = t_blk[...].astype(cdtype)
+
+                    x_use = xcv[k, :, :]
+                else:
+                    x_use = t_blk[...]
+                g = jnp.dot(x_use, wg_blk[0],
                             preferred_element_type=jnp.float32)
-                u = jnp.dot(t_blk[...], wu_blk[0],
+                u = jnp.dot(x_use, wu_blk[0],
                             preferred_element_type=jnp.float32)
 
                 @pl.when(k == 0)
@@ -522,7 +546,17 @@ def grouped_gemm_gated(tokens: jax.Array, w_gate: jax.Array,
         def body(t_blk, wg_blk, wu_blk, *rest):
             o_blk = rest[-1]
             sc_row = rest[0][0] if sc_ref is not None else None
-            o_blk[...] = _gated_block(t_blk, wg_blk, wu_blk, sc_row,
+            if convert_once:
+                j = pl.program_id(1)
+
+                @pl.when(j == 0)
+                def _():
+                    xcv[...] = t_blk[...].astype(cdtype)
+
+                x_use = xcv[...]
+            else:
+                x_use = t_blk
+            o_blk[...] = _gated_block(x_use, wg_blk, wu_blk, sc_row,
                                       out_dtype, activation)
 
         sc_specs = ([pl.BlockSpec((1, block_m), lambda i, j: (i, 0))]
@@ -548,8 +582,12 @@ def grouped_gemm_gated(tokens: jax.Array, w_gate: jax.Array,
                   pl.BlockSpec(memory_space=pl.ANY)]
         + [pl.BlockSpec(memory_space=pl.ANY)] * n_sc,
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        scratch_shapes=([pltpu.VMEM((block_m, block_n), jnp.float32)] * 2
-                        if ksplit else []),
+        scratch_shapes=(
+            ([pltpu.VMEM(((H // block_k, block_m, block_k) if ksplit
+                          else (block_m, H)), cdtype)]
+             if convert_once else [])
+            + ([pltpu.VMEM((block_m, block_n), jnp.float32)] * 2
+               if ksplit else [])),
         out_shape=jax.ShapeDtypeStruct((P, F), out_dtype),
         cost_estimate=cost,
         interpret=default_interpret(),
@@ -564,7 +602,8 @@ def grouped_gemm_gated(tokens: jax.Array, w_gate: jax.Array,
 
 def apply_grouped(tokens: jax.Array, ids: jax.Array, num_experts: int, fn,
                   block_m: int = 128,
-                  row_scale: jax.Array | None = None) -> jax.Array:
+                  row_scale: jax.Array | None = None,
+                  gather_dtype=None) -> jax.Array:
     """The shared align→gather→mask→compute→scatter-back sequence every MoE
     op needs: align rows by expert, call ``fn(x_aligned, block_expert,
     n_blocks_used) -> y_aligned`` (one or more grouped GEMMs sharing the
@@ -576,13 +615,20 @@ def apply_grouped(tokens: jax.Array, ids: jax.Array, num_experts: int, fn,
     alignment and passed to ``fn(x, block_expert, nb, scale_aligned)`` so
     the grouped GEMMs can fold the dequant into their accumulators
     (``grouped_gemm.row_scale``); ``tokens`` then stay in the wire dtype
-    end to end."""
+    end to end.
+
+    ``gather_dtype``: cast the gathered rows inside the (fused) gather
+    pass — the free place to leave a wire dtype the downstream kernels
+    cannot consume (measured round 5: Mosaic rejects fp8 x-strips in the
+    grouped pipelines on this toolchain; int8 compiles). The scale
+    contract is unchanged — dequant still rides the accumulators."""
     T = tokens.shape[0]
     gather_idx, row_valid, block_expert, nb = align_tokens_by_expert(
         ids, num_experts, block_m, with_used_count=True)
     P_rows = gather_idx.shape[0]
     vmask = row_valid[:, None]
-    x = jnp.where(vmask, tokens[gather_idx], 0).astype(tokens.dtype)
+    x = jnp.where(vmask, tokens[gather_idx], 0).astype(gather_dtype
+                                                       or tokens.dtype)
     if row_scale is not None:
         s = jnp.where(row_valid, row_scale.astype(jnp.float32)[gather_idx],
                       1.0)

@@ -109,6 +109,37 @@ def _causal_tile_dispatch(q_t, kv_t, bq, bk, compute):
         lambda: compute(True))
 
 
+def _band_keep(sub: int):
+    """Local (sub, sub) lower-triangular keep mask for an
+    exactly-diagonal band (position-independent: q_t == kv_t)."""
+    return (lax.broadcasted_iota(jnp.int32, (sub, sub), 1)
+            <= lax.broadcasted_iota(jnp.int32, (sub, sub), 0))
+
+
+def _dispatch_with_diag(causal, diag_sub, q_t, kv_t, bq, bk, compute,
+                        compute_diag):
+    """Four-way causal tile routing shared by the forward and both
+    backward pipelines: skip / interior mask-free / EXACT diagonal via
+    the row-band split (``compute_diag``) / other straddles (tiles not
+    aligned to the diagonal, e.g. unaligned layout offsets) whole-tile
+    masked. Falls back to the three-way dispatch when the split does not
+    apply (non-causal, non-square tiles — see ``_diag_sub``)."""
+    if not causal:
+        compute(False)
+        return
+    if diag_sub is None:
+        _causal_tile_dispatch(q_t, kv_t, bq, bk, compute)
+        return
+    has_work = kv_t <= q_t + (bq - 1)
+    interior = kv_t + (bk - 1) <= q_t
+    straddle = jnp.logical_and(has_work, jnp.logical_not(interior))
+    on_diag = q_t == kv_t
+    pl.when(jnp.logical_and(has_work, interior))(lambda: compute(False))
+    pl.when(jnp.logical_and(straddle, on_diag))(compute_diag)
+    pl.when(jnp.logical_and(straddle, jnp.logical_not(on_diag)))(
+        lambda: compute(True))
+
+
 def _attn_step_pipeline(step_init, step_final, causal, zigzag, D, bq, bk,
                         offs, BH, Hq, Hkv, S, scr,
                         q_ref, k_src, v_src, st_in, st_out,
@@ -242,10 +273,7 @@ def _attn_step_pipeline(step_init, step_final, causal, zigzag, D, bq, bk,
             # at sub=256, bq=bk=1024). This is the "masked sub-band +
             # interior remainder" split the round-4 roofline named as the
             # remaining causal lever (docs/benchmarks.md).
-            band_keep = (lax.broadcasted_iota(jnp.int32,
-                                              (diag_sub, diag_sub), 1)
-                         <= lax.broadcasted_iota(jnp.int32,
-                                                 (diag_sub, diag_sub), 0))
+            band_keep = _band_keep(diag_sub)
             for i in range(bq // diag_sub):
                 r0 = i * diag_sub
                 q_rows = q_blk[0][r0:r0 + diag_sub, :]
@@ -256,26 +284,10 @@ def _attn_step_pipeline(step_init, step_final, causal, zigzag, D, bq, bk,
                             k_blk[0][r0:r0 + diag_sub, :],
                             v_blk[0][r0:r0 + diag_sub, :], band_keep)
 
-        if causal and diag_sub is not None:
-            # three-way tile routing with the diagonal split: interior
-            # mask-free, exact-diagonal banded, any other straddle (tiles
-            # not aligned to the diagonal, e.g. unaligned layout offsets)
-            # whole-tile masked
-            has_work = kv_t <= q_t + (bq - 1)
-            interior = kv_t + (bk - 1) <= q_t
-            straddle = jnp.logical_and(has_work, jnp.logical_not(interior))
-            on_diag = q_t == kv_t
-            pl.when(jnp.logical_and(has_work, interior))(
-                lambda: compute(False))
-            pl.when(jnp.logical_and(straddle, on_diag))(compute_diag)
-            pl.when(jnp.logical_and(straddle, jnp.logical_not(on_diag)))(
-                lambda: compute(True))
-        elif causal:
-            # (under ``flat`` every enumerated tile has work; the dispatch
-            # still routes interior tiles to the mask-free body)
-            _causal_tile_dispatch(q_t, kv_t, bq, bk, compute)
-        else:
-            compute(False)
+        # (under ``flat`` every enumerated tile has work; the dispatch
+        # still routes interior tiles to the mask-free body)
+        _dispatch_with_diag(causal, diag_sub, q_t, kv_t, bq, bk, compute,
+                            compute_diag)
 
         @pl.when(last_of_q)
         def _():
@@ -687,10 +699,37 @@ def _bwd_dq_pipeline(step_init, causal, zigzag, scale, D, bq, bk, offs,
                 dS.astype(k_blk.dtype), k_blk[0], (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
 
-        if causal:
-            _causal_tile_dispatch(q_t, kv_t, bq, bk, compute)
-        else:
-            compute(False)
+        diag_sub = _diag_sub(bq, bk, causal)
+
+        def compute_diag():
+            # exactly-diagonal square tile: row bands skip the upper
+            # triangle's MXU work and shrink the mask to (sub, sub) per
+            # band — the forward's split (see _attn_step_pipeline),
+            # applied to the dq accumulation with sliced += updates
+            band_keep = _band_keep(diag_sub)
+            for i in range(bq // diag_sub):
+                r0 = i * diag_sub
+                q_r = q_blk[0][r0:r0 + diag_sub, :]
+                do_r = do_blk[0][r0:r0 + diag_sub, :]
+                lse_r = lse_blk[0].T[r0:r0 + diag_sub]
+                dl_r = dl_blk[0].T[r0:r0 + diag_sub]
+                if r0 > 0:
+                    _, dS = _p_ds_core(q_r, k_blk[0][:r0, :], do_r,
+                                       v_blk[0][:r0, :], lse_r, dl_r, None)
+                    dq_o[0, r0:r0 + diag_sub] += lax.dot_general(
+                        dS.astype(k_blk.dtype), k_blk[0][:r0, :],
+                        (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+                _, dS = _p_ds_core(q_r, k_blk[0][r0:r0 + diag_sub, :],
+                                   do_r, v_blk[0][r0:r0 + diag_sub, :],
+                                   lse_r, dl_r, band_keep)
+                dq_o[0, r0:r0 + diag_sub] += lax.dot_general(
+                    dS.astype(k_blk.dtype), k_blk[0][r0:r0 + diag_sub, :],
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
+
+        _dispatch_with_diag(causal, diag_sub, q_t, kv_t, bq, bk, compute,
+                            compute_diag)
 
     in_specs = [
         pl.BlockSpec((1, bq, D), lambda bh, qi, kvi: (bh, qi, 0)),
@@ -759,10 +798,42 @@ def _bwd_dkv_pipeline(step_init, causal, zigzag, scale, D, bq, bk, offs,
                 p.astype(do_blk.dtype), do_blk[0], (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
-        if causal:
-            _causal_tile_dispatch(q_t, kv_t, bq, bk, compute)
-        else:
-            compute(False)
+        diag_sub = _diag_sub(bq, bk, causal)
+
+        def compute_diag():
+            # diagonal split over q row bands: band i touches kv rows
+            # [0, r0+sub) only — the rect part accumulates into g_o rows
+            # [0, r0) mask-free, the (sub, sub) band masked
+            band_keep = _band_keep(diag_sub)
+            for i in range(bq // diag_sub):
+                r0 = i * diag_sub
+                q_r = q_blk[0][r0:r0 + diag_sub, :]
+                do_r = do_blk[0][r0:r0 + diag_sub, :]
+                lse_r = lse_blk[0].T[r0:r0 + diag_sub]
+                dl_r = dl_blk[0].T[r0:r0 + diag_sub]
+                if r0 > 0:
+                    p, dS = _p_ds_core(q_r, k_blk[0][:r0, :], do_r,
+                                       v_blk[0][:r0, :], lse_r, dl_r, None)
+                    g_o[0, :r0, :D] += lax.dot_general(
+                        dS.astype(q_blk.dtype), q_r,
+                        (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32) * _LN2
+                    g_o[0, :r0, D:] += lax.dot_general(
+                        p.astype(do_blk.dtype), do_r,
+                        (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                p, dS = _p_ds_core(q_r, k_blk[0][r0:r0 + diag_sub, :],
+                                   do_r, v_blk[0][r0:r0 + diag_sub, :],
+                                   lse_r, dl_r, band_keep)
+                g_o[0, r0:r0 + diag_sub, :D] += lax.dot_general(
+                    dS.astype(q_blk.dtype), q_r, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32) * _LN2
+                g_o[0, r0:r0 + diag_sub, D:] += lax.dot_general(
+                    p.astype(do_blk.dtype), do_r, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+
+        _dispatch_with_diag(causal, diag_sub, q_t, kv_t, bq, bk, compute,
+                            compute_diag)
 
     in_specs = [
         pl.BlockSpec((1, bq, D),
@@ -788,6 +859,23 @@ def _bwd_dkv_pipeline(step_init, causal, zigzag, scale, D, bq, bk, offs,
     )(*args, g_out)
 
 
+def _p_ds_core(q_rows, k_cols, do_rows, v_cols, lse_rows, dl_rows, keep):
+    """Array-form backward-tile math on (possibly sliced) operands:
+    recompute p from (q, k, lse), then dS = p * (do @ v^T - delta).
+    ``keep`` (None = mask-free) zeroes masked probabilities. Shared by
+    the whole-tile path (`_recompute_p_ds`) and the diagonal row-band
+    split in the bwd pipelines."""
+    s_ij = lax.dot_general(q_rows, k_cols, (((1,), (1,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+    p = jnp.exp2(s_ij - lse_rows * _LOG2E)
+    if keep is not None:
+        p = jnp.where(keep, p, 0.0)
+    dp = lax.dot_general(do_rows, v_cols, (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    dS = p * (dp - dl_rows)
+    return p, dS
+
+
 def _recompute_p_ds(masked, bq, bk, q_pos0, kv_pos0,
                     q_blk, do_blk, lse_blk, dl_blk, k_blk, v_blk):
     """Shared backward-tile math: recompute p from (q, k, lse), then
@@ -799,20 +887,13 @@ def _recompute_p_ds(masked, bq, bk, q_pos0, kv_pos0,
     transcendental; the lse conversion is one (bq, 1) multiply per tile.
     ``masked`` is python-static: True only for diagonal causal tiles
     (``_causal_tile_dispatch``); interior tiles run the mask-free body."""
-    s_ij = lax.dot_general(q_blk[0], k_blk[0], (((1,), (1,)), ((), ())),
-                           preferred_element_type=jnp.float32)
-    lse_row = lse_blk[0].T          # [bq, 1], ln-domain
-    delta_row = dl_blk[0].T         # [bq, 1]
-    p = jnp.exp2(s_ij - lse_row * _LOG2E)
     keep = None
     if masked:
         qpos = q_pos0 + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         kpos = kv_pos0 + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         keep = kpos <= qpos
-        p = jnp.where(keep, p, 0.0)
-    dp = lax.dot_general(do_blk[0], v_blk[0], (((1,), (1,)), ((), ())),
-                         preferred_element_type=jnp.float32)
-    dS = p * (dp - delta_row)
+    p, dS = _p_ds_core(q_blk[0], k_blk[0], do_blk[0], v_blk[0],
+                       lse_blk[0].T, dl_blk[0].T, keep)
     return p, dS, keep
 
 
