@@ -332,7 +332,7 @@ def _wire_bytes(n: int, tokens_per_rank: int, hidden: int, topk: int,
 def bench_a2a_wire_fit(ctx, tokens_per_rank: int, hidden: int, topk: int,
                        num_experts: int, i1: int, i2: int,
                        wire_dtype=None,
-                       multipliers=(1, 4, 8)) -> dict:
+                       multipliers=(1, 2, 4, 8)) -> dict:
     """Wire seed WITHOUT the noise-floor clamp (VERDICT r4 #5): measure the
     marginal push at 1×/4×/8× payload (the larger points resolve real
     traffic — the 56 MiB scaling run showed cost scales with bytes), fit
