@@ -413,13 +413,32 @@ def bench_moe(ctx, i1: int, i2: int, tokens_rows: int = 1024,
             out[f"moe_ag_gg_{name}_us"] = None
             continue
 
-        def step(t, i, _name=name):
-            y = ag_moe_group_gemm(ctx, t, i, w)
-            eps = (jnp.sum(y.astype(jnp.float32)) * 1e-20).astype(t.dtype)
-            return t + eps
+        # block_m sweep over the autotuned entry's candidate list (ONE
+        # source of truth — the bench must not diverge from what the
+        # shipped op would pick), best-of like the headline's config loop
+        from triton_dist_tpu.ops.autotuned import _MOE_BLOCK_CANDIDATES
+        best = float("inf")
+        first_err = None
+        for bm in _MOE_BLOCK_CANDIDATES:
+            def step(t, i, _bm=bm):
+                y = ag_moe_group_gemm(ctx, t, i, w, block_m=_bm)
+                eps = (jnp.sum(y.astype(jnp.float32)) * 1e-20
+                       ).astype(t.dtype)
+                return t + eps
 
-        s = _per_iter(make_chain_timer(step, toks, ids_sh), i1, i2)
-        out[f"moe_ag_gg_{name}_us"] = round(s * 1e6, 1)
+            try:
+                best = min(best, _per_iter(
+                    make_chain_timer(step, toks, ids_sh), i1, i2))
+            except Exception as e:
+                first_err = first_err or f"{type(e).__name__}: {e}"[:120]
+                continue
+        if best == float("inf"):
+            # every candidate failed: fail LOUDLY (a silent Infinity
+            # would corrupt the JSON line and hide the regression)
+            raise RuntimeError(
+                f"moe_ag_gg: every block_m candidate failed; first error: "
+                f"{first_err}")
+        out[f"moe_ag_gg_{name}_us"] = round(best * 1e6, 1)
     return out
 
 

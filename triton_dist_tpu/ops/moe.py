@@ -60,6 +60,17 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _default_bn(k_contract: int, n_avail: int, dtype) -> int:
+    """Weight-tile width for the fused grouped GEMMs: sub-256 KB tiles are
+    DMA-overhead-bound (the down grouped GEMM measured 433→200 µs going
+    128→512, docs/benchmarks.md tile sweep), but the (k_contract, bn) tile
+    must stay inside a ~4 MB double-buffered budget at large contraction
+    dims. One definition — both fused MoE ops share it."""
+    itemsize = jnp.dtype(dtype).itemsize
+    cap = max(128, (4 * 2**20) // (2 * k_contract * itemsize) // 128 * 128)
+    return min(512, n_avail, cap)
+
+
 def _gather_ids(ctx: ShmemContext, ids: jax.Array, axis, t_local: int):
     """AllGather routing ids as a lane-aligned int32 wire block; returns the
     [n, t_local] gathered id matrix (replicated). ``axis`` may be a tuple
@@ -114,7 +125,8 @@ def _ag_moe_kernel(axis, mesh_axes, bm, bn, out_dtype, n_blocks,
 
 def ag_moe_group_gemm(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
                       weights: jax.Array, axis: str | None = None,
-                      block_m: int = 128) -> jax.Array:
+                      block_m: int = 128,
+                      block_n: int | None = None) -> jax.Array:
     """tokens [T, H] sharded P(axis); ids [T] int32 expert per row (-1 pad);
     weights [E, H, N] sharded P(None, None, axis) (N column-parallel).
     Returns all ranks' tokens processed by their experts against the local
@@ -148,8 +160,10 @@ def ag_moe_group_gemm(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
         x = tok_shard[gi_me] * rv_me[:, None].astype(tok_shard.dtype)
 
         n_local = w_shard.shape[-1]
+        # emit_grouped_gemm gcd-clamps when n_local is narrower
+        bn = block_n or _default_bn(H, n_local, w_shard.dtype)
         kernel = lambda *refs: _ag_moe_kernel(axis, mesh_axes, bm,
-                                              min(128, n_local), out_dtype,
+                                              bn, out_dtype,
                                               n_blocks, *refs)
         y, _ws = pl.pallas_call(
             kernel,
@@ -284,7 +298,7 @@ def moe_reduce_rs(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
         x = (tok_shard[rows]
              * rv_full.reshape(-1)[:, None].astype(tok_shard.dtype))
 
-        bn = min(128, N)
+        bn = _default_bn(tok_shard.shape[-1], N, w_shard.dtype)
         hier = isinstance(axis, tuple)
         if hier:
             ni = ctx.axis_size(tuple(axis[1:]))
