@@ -127,6 +127,7 @@ def bench_ag_gemm(ctx, n_dev: int, M: int, N: int, K: int, configs,
                                    axis="x")
 
     best_s, best_cfg = float("inf"), None
+    first_err = [None]
     for cfg in configs:
         if (M // n_dev) % cfg.block_m or (N // n_dev) % cfg.block_n:
             continue
@@ -158,8 +159,16 @@ def bench_ag_gemm(ctx, n_dev: int, M: int, N: int, K: int, configs,
             s = _per_iter(timer, i1, i2)
             if s < best_s:
                 best_s, best_cfg = s, cfg
-        except Exception:
+        except Exception as e:
+            # keep the FIRST error so an all-configs failure (e.g. a
+            # transient remote-compile outage) is diagnosable — a bare
+            # best_s=inf assert hides the cause entirely
+            first_err[0] = first_err[0] or f"{type(e).__name__}: {e}"[:200]
             continue
+    if best_s == float("inf") and first_err[0]:
+        raise RuntimeError(
+            f"bench_ag_gemm: every config failed; first error: "
+            f"{first_err[0]}")
     return best_s, best_cfg
 
 
