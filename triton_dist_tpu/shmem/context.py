@@ -22,7 +22,6 @@ from __future__ import annotations
 import dataclasses
 import os
 import functools
-from functools import partial
 from typing import Any, Callable, Sequence
 
 import jax
