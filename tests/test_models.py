@@ -100,6 +100,16 @@ def test_moe_ep_overlap_matches_dense(ctx):
     assert_allclose(np.asarray(got, jnp.float32), np.asarray(golden),
                     atol=8e-2, rtol=8e-2)
 
+    # packed serving layout (pack_gated_weights → we_gate_up_packed):
+    # bit-identical path semantics, one double-width weight stream
+    from triton_dist_tpu.ops.group_gemm import pack_gated_weights
+    wgu = pack_gated_weights(wg, wu, block_n=64)
+    got_p = jax.jit(lambda x: moe_mlp_ep_overlap(
+        ctx, layer, x, router_w, wg, wu, wd, axis="x", block_n=64,
+        we_gate_up_packed=wgu))(xs)
+    assert_allclose(np.asarray(got_p, jnp.float32),
+                    np.asarray(got, jnp.float32), atol=1e-2, rtol=1e-2)
+
 
 def test_moe_tp_overlap_matches_dense(ctx):
     """TP-MoE block on the FUSED overlap kernels (AG+GroupGEMM up-proj →

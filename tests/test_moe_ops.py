@@ -131,6 +131,38 @@ def test_grouped_gemm_ksplit_matches():
                     rtol=1e-4)
 
 
+def test_gated_packed_matches():
+    """packed=True (interleaved [g_j|u_j] single weight stream) matches
+    the two-stream bounded path, with and without K-split/row_scale."""
+    from triton_dist_tpu.ops.group_gemm import pack_gated_weights
+
+    E, H, F, bm, bn = 4, 64, 128, 16, 32
+    T = 56
+    ids = jax.random.randint(jax.random.key(0), (T,), 0, E)
+    tokens = jax.random.normal(jax.random.key(1), (T, H), jnp.float32)
+    wg = jax.random.normal(jax.random.key(2), (E, H, F), jnp.float32) * 0.1
+    wu = jax.random.normal(jax.random.key(3), (E, H, F), jnp.float32) * 0.1
+    gi, rv, be, nb = align_tokens_by_expert(ids, E, bm, with_used_count=True)
+    x = tokens[np.asarray(gi)] * np.asarray(rv)[:, None]
+    scale = jax.random.uniform(jax.random.key(4), (x.shape[0],),
+                               jnp.float32, 0.5, 1.5)
+    wgu = pack_gated_weights(wg, wu, block_n=bn)
+
+    want = jax.jit(lambda *a: grouped_gemm_gated(
+        *a[:4], block_m=bm, block_n=bn, n_blocks_used=nb,
+        row_scale=a[4]))(x, wg, wu, be, scale)
+    got = jax.jit(lambda *a: grouped_gemm_gated(
+        a[0], a[1], None, a[2], block_m=bm, block_n=bn, n_blocks_used=nb,
+        row_scale=a[3], packed=True))(x, wgu, be, scale)
+    assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4,
+                    rtol=1e-4)
+    got_ks = jax.jit(lambda *a: grouped_gemm_gated(
+        a[0], a[1], None, a[2], block_m=bm, block_n=bn, n_blocks_used=nb,
+        row_scale=a[3], packed=True, block_k=32))(x, wgu, be, scale)
+    assert_allclose(np.asarray(got_ks), np.asarray(want), atol=1e-4,
+                    rtol=1e-4)
+
+
 def test_gated_quantized_convert_once():
     """Quantized-wire rows through the BOUNDED gated kernel with multiple
     n-steps (and with K-split): the per-m-step x-conversion scratch path
