@@ -33,14 +33,15 @@ from triton_dist_tpu.utils import on_cpu  # noqa: E402
 
 
 def bench_wiring(ctx, quant_edge, dequant_edge, i1, i2, shape,
-                 wire_dtype=jnp.float8_e4m3fn):
+                 wire_dtype=jnp.float8_e4m3fn, expert_major=False):
     """Dispatch latency for one wiring; ``wire_dtype=None`` is the bf16
     reference point (quant/dequant edges absent, same chain otherwise)."""
     kw = ({} if wire_dtype is None
           else dict(wire_dtype=wire_dtype, quant_edge=quant_edge,
                     dequant_edge=dequant_edge))
     a2a = create_all_to_all_context(ctx, axis=ctx.axis_names[0],
-                                    **kw, **shape)
+                                    expert_major=expert_major, **kw,
+                                    **shape)
     n = a2a.n_ranks
     T = n * shape["max_tokens"]
     H = shape["hidden"]
@@ -75,17 +76,26 @@ def main() -> int:
     print(json.dumps({"wiring": "bf16_reference",
                       "dispatch_us": round(s * 1e6, 1)}), flush=True)
 
-    for qe in ("pre", "fused"):
-        for de in ("post", "kernel"):
-            try:
-                s = bench_wiring(ctx, qe, de, i1, i2, shape)
-                print(json.dumps({"wiring": f"{qe}+{de}",
-                                  "dispatch_us": round(s * 1e6, 1)}),
-                      flush=True)
-            except Exception as e:
-                print(json.dumps({"wiring": f"{qe}+{de}",
-                                  "error": f"{type(e).__name__}: {e}"[:160]}),
-                      flush=True)
+    # "kernel" quantizes tile-by-tile INSIDE the collective (no standalone
+    # qpack pass on the send edge — the fused-send wiring); --expert-major
+    # repeats the sweep on the per-expert-slot capacity layout
+    em_opts = ((False, True) if "--expert-major" in sys.argv
+               else (False,))
+    for em in em_opts:
+        for qe in ("pre", "fused", "kernel"):
+            for de in ("post", "kernel"):
+                tag = f"{qe}+{de}" + ("+em" if em else "")
+                try:
+                    s = bench_wiring(ctx, qe, de, i1, i2, shape,
+                                     expert_major=em)
+                    print(json.dumps({"wiring": tag,
+                                      "dispatch_us": round(s * 1e6, 1)}),
+                          flush=True)
+                except Exception as e:
+                    print(json.dumps(
+                        {"wiring": tag,
+                         "error": f"{type(e).__name__}: {e}"[:160]}),
+                        flush=True)
     return 0
 
 

@@ -11,6 +11,8 @@ roofline in docs/benchmarks.md is built from numbers, not guesses:
   ffn_fused    gated + down through apply_grouped (the new serving path)
   ffn_unfused  3-launch gate/up/act/down composition (the round-4 path)
   block        full moe_mlp_ep_overlap (router+dispatch+ffn+combine)
+  block_em     same block on the expert-major capacity layout (align
+               gather/scatter elided: static block→expert map)
 
 Run on the real chip:
   cd /tmp && PYTHONPATH=/root/repo:/root/.axon_site \
@@ -181,7 +183,7 @@ def main():
 
     # --- full serving block + dispatch (shared ctx) -------------------------
     if (want("block") or want("disp") or want("block_fp8_post")
-            or want("block_fp8_expert")):
+            or want("block_fp8_expert") or want("block_em")):
         from bench import bench_a2a, bench_ep_block
         from triton_dist_tpu.shmem.context import initialize_distributed
         ctx = initialize_distributed(axis_names=("x",),
@@ -197,6 +199,11 @@ def main():
         if want("block"):
             guard("block", lambda: emit("block", bench_ep_block(
                 ctx, i1=10, i2=60 if quick else 210)))
+        if want("block_em"):
+            # expert-major capacity layout: align gather/scatter elided
+            # in the serving FFN (static block→expert map)
+            guard("block_em", lambda: emit("block_em", bench_ep_block(
+                ctx, i1=10, i2=60 if quick else 210, expert_major=True)))
         if want("block_fp8_post") or want("block_fp8_expert"):
             # the expert-edge QuantTokens protocol (reference
             # architecture) vs post-dequant, with the convert-once

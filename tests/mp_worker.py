@@ -34,6 +34,22 @@ def main() -> None:
     ctx = initialize_distributed(axis_names=("x",), mesh_shape=(4,))
     assert jax.process_count() == 2, jax.process_count()
     me = jax.process_index()
+    sharding = NamedSharding(ctx.mesh, P("x"))
+
+    # Backend capability probe FIRST: on the jax 0.4.x line the jaxlib CPU
+    # client refuses ANY computation spanning processes ("Multiprocess
+    # computations aren't implemented on the CPU backend") — the bootstrap
+    # above succeeds, the first spanning jit raises. Probe it with a tiny
+    # array so that version's pinned outcome is one explicit token the
+    # test keys on, not a traceback halfway through the real work.
+    try:
+        jax.block_until_ready(
+            jax.jit(lambda: jnp.zeros((4, 1), jnp.float32),
+                    out_shardings=sharding)())
+    except Exception as e:  # noqa: BLE001 — the token carries the type
+        print(f"MP_BACKEND_NO_MULTIPROC {type(e).__name__}: "
+              f"{str(e)[:160]}", flush=True)
+        os._exit(0)
 
     # pure-XLA collective across both processes' devices, traced into a
     # merged per-host-track profile when the harness asks for one
@@ -42,7 +58,6 @@ def main() -> None:
     prof_dir = os.environ.get("TDT_PROF_DIR")
     with group_profile("mp", do_prof=prof_dir is not None,
                        out_dir=prof_dir or "prof"):
-        sharding = NamedSharding(ctx.mesh, P("x"))
         ones = jax.jit(lambda: jnp.ones((8, 128), jnp.float32),
                        out_shardings=sharding)()
         total = jax.jit(

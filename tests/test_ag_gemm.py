@@ -36,6 +36,7 @@ def test_matmul_local():
     assert_allclose(c, np.asarray(a) @ np.asarray(b), atol=1e-2, rtol=1e-2)
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
 def test_ag_gemm(ctx, dtype):
     n = ctx.num_ranks

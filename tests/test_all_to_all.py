@@ -61,6 +61,7 @@ def _moe_golden(tokens, topk_ids, topk_w, expert_scale):
     return out
 
 
+@pytest.mark.quick
 def test_dispatch_combine_roundtrip(ctx):
     """Full EP MoE round trip with a linear 'expert' (scale per expert):
     dispatch → per-rank processing of received tokens → combine. Matches the
@@ -102,6 +103,7 @@ def test_dispatch_combine_roundtrip(ctx):
     assert_allclose(np.asarray(out), golden, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("wire", [jnp.float8_e4m3fn, jnp.int8])
 def test_dispatch_combine_quantized_wire(ctx, wire):
     """fp8/int8 wire with per-token scale side-channel (reference
@@ -283,3 +285,225 @@ def test_expected_capacity_sizing(ctx):
         lambda t, i: dispatch(a2a, t, i))(
         ctx.shard(tokens, P("x")), ctx.shard(ids.astype(jnp.int32), P("x")))
     assert bool(jnp.all(valid)), "balanced routing must not drop at 2x headroom"
+
+
+# ---------------------------------------------------------------------------
+# fused send-edge quantization (quant_edge="kernel" / all_to_all_push
+# quant_from) and the expert-major capacity layout
+# ---------------------------------------------------------------------------
+
+@pytest.mark.quick
+def test_push_fused_send_quant_matches_unfused(ctx):
+    """``all_to_all_push(quant_from=...)`` with the in-collective send-edge
+    quantization must produce the SAME wire bytes and scales as the
+    ``fuse_quant=False`` standalone-qpack fallback — bit-for-bit (both run
+    the ``_quant`` row math; the fused path just runs it per departure
+    slot inside the collective)."""
+    n = ctx.num_ranks
+    cap, H = 128, 256
+    x = jax.random.normal(jax.random.key(0), (n * n, cap, H), jnp.float32)
+    xs = ctx.shard(x, P("x"))
+    for wq in (jnp.float8_e4m3fn, jnp.int8):
+        q1, s1 = jax.jit(lambda v: all_to_all_push(ctx, v, quant_from=wq))(xs)
+        q0, s0 = jax.jit(lambda v: all_to_all_push(
+            ctx, v, quant_from=wq, fuse_quant=False))(xs)
+        assert q1.dtype == jnp.dtype(wq) and q1.shape == q0.shape
+        np.testing.assert_array_equal(np.asarray(q1).view(np.uint8),
+                                      np.asarray(q0).view(np.uint8))
+        np.testing.assert_array_equal(np.asarray(s1), np.asarray(s0))
+
+    # fused quant + fused dequant roundtrip == unfused both edges
+    y1, _ = jax.jit(lambda v: all_to_all_push(
+        ctx, v, quant_from=jnp.float8_e4m3fn, dequant_to=jnp.float32))(xs)
+    y0, _ = jax.jit(lambda v: all_to_all_push(
+        ctx, v, quant_from=jnp.float8_e4m3fn, dequant_to=jnp.float32,
+        fuse_quant=False, fuse_dequant=False))(xs)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y0))
+
+
+def test_quant_tile_pipelines_match_xla_golden():
+    """The kernel's per-slot quant/dequant emit_pipelines — the exact tile
+    programs ``_a2a_kernel`` runs at the send and receive edges — are
+    bit-identical to the jitted XLA ``_quant``/``_dequant`` reference on a
+    single device. This is the piece of the fused-edge contract the
+    simulator CAN check directly (the collective around it falls back to
+    XLA on backends without a remote-DMA interpreter)."""
+    from jax.experimental import pallas as pl
+    from triton_dist_tpu.ops.all_to_all import (
+        _dequant, _dequant_slot_pipeline, _quant, _quant_slot_pipeline)
+    from triton_dist_tpu.utils import default_interpret
+
+    cap, H = 256, 384
+    x = jax.random.normal(jax.random.key(3), (cap, H), jnp.float32)
+    x = x.at[7].set(0.0)  # zero row -> scale-1 rule
+    for wq in (jnp.float8_e4m3fn, jnp.int8):
+        def qk(xr, qr, sr):
+            _quant_slot_pipeline(xr, qr, sr, jnp.dtype(wq), cap, H)
+
+        q, s = pl.pallas_call(
+            qk,
+            out_shape=(jax.ShapeDtypeStruct((cap, H), wq),
+                       jax.ShapeDtypeStruct((cap // 128, 128), jnp.float32)),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 2,
+            interpret=default_interpret(),
+        )(x)
+        q0, s0 = jax.jit(lambda v: _quant(v, jnp.dtype(wq)))(x)
+        np.testing.assert_array_equal(np.asarray(q).view(np.uint8),
+                                      np.asarray(q0).view(np.uint8))
+        np.testing.assert_array_equal(np.asarray(s).reshape(-1),
+                                      np.asarray(s0))
+
+        import math
+        bn = math.gcd(512, H)
+
+        def dk(qr, sr, orf):
+            _dequant_slot_pipeline(qr, sr, orf, jnp.bfloat16, cap, H, bn)
+
+        y = pl.pallas_call(
+            dk,
+            out_shape=jax.ShapeDtypeStruct((cap, H), jnp.bfloat16),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            interpret=default_interpret(),
+        )(q, s)
+        y0 = jax.jit(lambda a, b: _dequant(a, b, jnp.bfloat16))(q0, s0)
+        np.testing.assert_array_equal(np.asarray(y).view(np.uint16),
+                                      np.asarray(y0).view(np.uint16))
+
+
+def test_quant_edge_kernel_strategy(ctx):
+    """quant_edge="kernel" (send-edge quantization inside the collective)
+    composes with both dequant edges and stays within quantization error of
+    the identity roundtrip — and its routing metadata matches the "fused"
+    gather edge exactly."""
+    n = ctx.num_ranks
+    T, H, topk = n * 8, 256, 2
+    tokens = jax.random.normal(jax.random.key(11), (T, H), jnp.float32
+                               ).astype(jnp.bfloat16)
+    ids = jax.random.randint(jax.random.key(12), (T, topk), 0, 2 * n)
+    w = jnp.ones((T, topk), jnp.float32) / topk
+    args = (ctx.shard(tokens, P("x")), ctx.shard(ids, P("x")),
+            ctx.shard(w, P("x")))
+
+    def roundtrip(c, t, i, ww):
+        recv, rids, layout = dispatch(c, t, i)
+        return combine(c, recv, layout, ww), rids
+
+    outs = {}
+    for qe in ("kernel", "fused"):
+        a2a = create_all_to_all_context(
+            ctx, max_tokens=T // n, hidden=H, topk=topk, num_experts=2 * n,
+            axis="x", capacity=128, dtype=jnp.bfloat16,
+            wire_dtype=jnp.float8_e4m3fn, quant_edge=qe)
+        outs[qe], rids = jax.jit(lambda *a, c=a2a: roundtrip(c, *a))(*args)
+    assert_allclose(np.asarray(outs["kernel"], np.float32),
+                    np.asarray(tokens, np.float32), rtol=0.15, atol=0.15)
+    assert_allclose(np.asarray(outs["kernel"], np.float32),
+                    np.asarray(outs["fused"], np.float32),
+                    rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.quick
+def test_expert_major_layout_and_roundtrip(ctx):
+    """expert_major=True: every (src, dst) capacity block arrives
+    expert-segmented — rows [e*cap_e, (e+1)*cap_e) hold local expert e —
+    and the full dispatch→expert-scale→combine roundtrip matches both the
+    rank-major layout and the dense golden (ample capacity: no drops)."""
+    n = ctx.num_ranks
+    T, H, k, E = 32, 256, 2, 2 * n
+    tokens = jax.random.normal(jax.random.key(0), (n * T, H), jnp.float32)
+    ids = jax.random.randint(jax.random.key(1), (n * T, k), 0, E)
+    w = jax.nn.softmax(jax.random.normal(jax.random.key(2), (n * T, k)), -1)
+    args = (ctx.shard(tokens, P("x")), ctx.shard(ids, P("x")),
+            ctx.shard(w, P("x")))
+
+    def roundtrip(a2a, t, i, ww):
+        recv, rids, layout = dispatch(a2a, t, i)
+        epr = a2a.experts_per_rank
+
+        def proc(r, il):
+            gid = il + jax.lax.axis_index("x") * epr
+            f = jnp.where(il >= 0, (gid + 1).astype(jnp.float32), 0.0)
+            return (r.astype(jnp.float32) * f[..., None]).astype(r.dtype)
+
+        pr = ctx.shard_map(proc, in_specs=(P("x"), P("x")),
+                           out_specs=P("x"))(
+            recv.reshape(n * n, a2a.capacity, H), rids)
+        return combine(a2a, pr, layout, ww), rids
+
+    outs = {}
+    for em in (False, True):
+        a2a = create_all_to_all_context(ctx, max_tokens=T, hidden=H, topk=k,
+                                        num_experts=E, capacity=T * k,
+                                        dtype=jnp.float32, expert_major=em)
+        if em:
+            cap_e, epr = a2a.capacity_per_expert, a2a.experts_per_rank
+            assert a2a.capacity == cap_e * epr
+            # routing: slots stay inside their expert's segment
+            dest, slot, valid = route_tokens(a2a, ids[:T])
+            s, v = np.asarray(slot).reshape(-1), np.asarray(valid).reshape(-1)
+            le = np.asarray(ids[:T]).reshape(-1) % epr
+            assert np.all((s[v] // cap_e) == le[v])
+        outs[em], rids = jax.jit(lambda *a, c=a2a: roundtrip(c, *a))(*args)
+        if em:
+            # receive blocks are expert-segmented (or -1 padding)
+            ri = np.asarray(rids).reshape(n, n, a2a.capacity)
+            seg = np.arange(a2a.capacity) // cap_e
+            assert (((ri < 0) | (ri == seg[None, None, :]))).all()
+
+    golden = _moe_golden(tokens, ids, w,
+                         np.arange(1.0, E + 1.0, dtype=np.float32))
+    for em in (False, True):
+        assert_allclose(np.asarray(outs[em]), golden, atol=2e-4, rtol=2e-4)
+
+
+def test_expert_major_per_expert_drop_semantics(ctx):
+    """Under expert_major the budget is per (src, dst, EXPERT): skewing all
+    tokens onto one expert drops past cap_e (not past the whole per-rank
+    capacity), while the same skew on the rank-major layout survives up to
+    ``capacity``. That is the documented trade-off for capping multinomial
+    spill at the source."""
+    n = ctx.num_ranks
+    T, H, k = n * 16, 128, 1
+    ids = jnp.zeros((T, k), jnp.int32)       # everything -> global expert 0
+    caps = {}
+    for em in (False, True):
+        a2a = create_all_to_all_context(ctx, max_tokens=T // n, hidden=H,
+                                        topk=k, num_experts=2 * n,
+                                        capacity=16, axis="x",
+                                        dtype=jnp.float32, expert_major=em)
+        sm = ctx.shard_map(lambda i: route_tokens(a2a, i)[2],
+                           in_specs=P("x"), out_specs=P("x"))
+        valid = np.asarray(jax.jit(sm)(ctx.shard(ids, P("x"))))
+        caps[em] = int(valid.reshape(n, -1).sum(axis=1)[0])
+        budget = (a2a.capacity_per_expert if em else a2a.capacity)
+        assert caps[em] == min(T // n, budget), (em, caps[em], budget)
+    assert caps[True] < caps[False], caps  # finer budget drops sooner
+
+
+def test_slot_gather_nonfinite_containment():
+    """S2 contract: a non-finite source row is clamped (NaN→0, ±Inf→±max)
+    BEFORE the slot gather, so it cannot poison other slots through the
+    MXU one-hot contraction (0.0·Inf = NaN would hit EVERY slot), and the
+    MXU and take-gather twins stay bit-comparable."""
+    from triton_dist_tpu.ops.all_to_all import (_MXU_GATHER_MAX_ROWS,
+                                                _slot_gather,
+                                                _slot_gather_quant)
+    R, H, n_dst, cap = 16, 128, 2, 8
+    rows = jax.random.normal(jax.random.key(0), (R, H), jnp.float32)
+    rows = rows.at[3, 5].set(jnp.nan).at[4, 7].set(jnp.inf)
+    src = jnp.arange(n_dst * cap, dtype=jnp.int32).reshape(n_dst, cap) % R
+    assert R <= _MXU_GATHER_MAX_ROWS     # MXU one-hot path
+
+    out = np.asarray(jax.jit(
+        lambda r, s: _slot_gather(r, s, jnp.float32))(rows, src))
+    assert np.isfinite(out).all()
+    # clean rows arrive exactly; the poisoned rows arrive clamped
+    ref = np.asarray(jnp.nan_to_num(rows))
+    np.testing.assert_array_equal(out.reshape(-1, H), ref[np.asarray(src).reshape(-1)])
+
+    q, s = jax.jit(
+        lambda r, m: _slot_gather_quant(r, m, jnp.float8_e4m3fn))(rows, src)
+    assert np.isfinite(np.asarray(s)).all()
+    assert np.isfinite(np.asarray(q, np.float32)).all()

@@ -43,6 +43,7 @@ def test_all_gather_ll_repeated(ctx):
         assert_allclose(np.asarray(y), np.asarray(x))
 
 
+@pytest.mark.quick
 def test_all_gather_ll_functional(ctx):
     """Functional ws-threading form under jit (donate-style usage)."""
     from triton_dist_tpu.ops import all_gather_ll, create_ag_ll_workspace
@@ -58,6 +59,7 @@ def test_all_gather_ll_functional(ctx):
         assert_allclose(np.asarray(y), np.asarray(x))
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("method", ["push", "ring"])
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
 def test_all_gather_1d(ctx, method, dtype):
@@ -78,6 +80,7 @@ def test_all_gather_2d(ctx2d):
     assert_allclose(np.asarray(y), np.asarray(x))
 
 
+@pytest.mark.quick
 def test_reduce_scatter_ring(ctx):
     n = ctx.num_ranks
     M = 32  # per-device contribution rows
@@ -99,6 +102,7 @@ def test_barrier_all_op(ctx):
     assert np.all(np.asarray(out) == 1)
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("root", [0, 2])
 def test_broadcast(ctx, root):
     """One-to-all broadcast (device-API parity: the reference's raw

@@ -8,6 +8,7 @@ import math
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from conftest import TEST_WORLD  # noqa: F401
 from triton_dist_tpu.models.llama import (LlamaConfig, decode_step, forward,
@@ -95,6 +96,7 @@ def test_generate_greedy_consistent():
                                   np.asarray(jnp.argmax(full[:, -1], -1)))
 
 
+@pytest.mark.quick
 def test_sp_decode_step_matches_single():
     """decode_step_sp over a 4-way KV-sharded cache == single-device
     decode_step (the model-level SP serving loop; reference

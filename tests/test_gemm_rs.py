@@ -28,6 +28,7 @@ def _golden(ctx, a, b):
     return jax.jit(sm)(a, b)
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
 def test_gemm_rs(ctx, dtype):
     n = ctx.num_ranks

@@ -64,6 +64,7 @@ def _dense_moe_golden(tokens, ids, w, scale):
     return out
 
 
+@pytest.mark.quick
 def test_dispatch_combine_2d_roundtrip(ctx2d):
     """Full 2-tier dispatch → per-expert scaling → combine vs dense golden."""
     n, T, H, topk = 6, 8, 128, 2

@@ -66,6 +66,7 @@ def test_moe_forward_shapes():
     assert bool(jnp.all(jnp.isfinite(logits)))
 
 
+@pytest.mark.quick
 def test_moe_ep_overlap_matches_dense(ctx):
     """EP dispatch → grouped FFN → combine on the Pallas kernels vs a dense
     per-expert golden (uncapped capacity, so no token drops)."""

@@ -131,6 +131,7 @@ def test_grouped_gemm_ksplit_matches():
                     rtol=1e-4)
 
 
+@pytest.mark.quick
 def test_gated_packed_matches():
     """packed=True (interleaved [g_j|u_j] single weight stream) matches
     the two-stream bounded path, with and without K-split/row_scale."""
@@ -290,3 +291,120 @@ def test_moe_reduce_rs(ctx):
     rows = np.stack([t[r] @ wn[idn[r]] for r in range(T * topk)])
     golden = (rows.reshape(T, topk, N) * twn[..., None]).sum(axis=1)
     assert_allclose(np.asarray(out), golden, atol=1e-3, rtol=1e-3)
+
+
+def test_gated_packed_prefetch_depths():
+    """The deep weight-stream DMA ring (prefetch_depth >= 2) must be
+    bit-identical to the emit_pipeline weight stream it replaces
+    (prefetch_depth=1 falls back) at every depth, with and without
+    K-split — the ring only changes WHEN weight tiles are fetched, never
+    what is computed."""
+    from triton_dist_tpu.ops.group_gemm import pack_gated_weights
+
+    E, H, F, bm, bn = 4, 64, 128, 16, 32
+    ids = jax.random.randint(jax.random.key(0), (56,), 0, E)
+    tokens = jax.random.normal(jax.random.key(1), (56, H), jnp.float32)
+    wg = jax.random.normal(jax.random.key(2), (E, H, F), jnp.float32) * 0.1
+    wu = jax.random.normal(jax.random.key(3), (E, H, F), jnp.float32) * 0.1
+    gi, rv, be, nb = align_tokens_by_expert(ids, E, bm, with_used_count=True)
+    x = tokens[np.asarray(gi)] * np.asarray(rv)[:, None]
+    wgu = pack_gated_weights(wg, wu, block_n=bn)
+
+    ref = np.asarray(jax.jit(lambda *a: grouped_gemm_gated(
+        a[0], a[1], None, a[2], block_m=bm, block_n=bn, n_blocks_used=nb,
+        packed=True, prefetch_depth=1))(x, wgu, be))
+    for depth in (2, 3):
+        got = np.asarray(jax.jit(lambda *a, d=depth: grouped_gemm_gated(
+            a[0], a[1], None, a[2], block_m=bm, block_n=bn,
+            n_blocks_used=nb, packed=True, prefetch_depth=d))(x, wgu, be))
+        np.testing.assert_array_equal(got, ref)
+        got_ks = np.asarray(jax.jit(lambda *a, d=depth: grouped_gemm_gated(
+            a[0], a[1], None, a[2], block_m=bm, block_n=bn,
+            n_blocks_used=nb, packed=True, prefetch_depth=d,
+            block_k=32))(x, wgu, be))
+        ref_ks = np.asarray(jax.jit(lambda *a: grouped_gemm_gated(
+            a[0], a[1], None, a[2], block_m=bm, block_n=bn,
+            n_blocks_used=nb, packed=True, prefetch_depth=1,
+            block_k=32))(x, wgu, be))
+        np.testing.assert_array_equal(got_ks, ref_ks)
+
+
+def test_packed_gated_weights_wrapper_contract():
+    """PackedGatedWeights carries the pack width in the type: the kernel
+    accepts a matching wrapper and REJECTS a mismatched one (a bare array
+    only gets the divisibility check — the reason the wrapper exists)."""
+    from triton_dist_tpu.ops.group_gemm import (PackedGatedWeights,
+                                                pack_gated_weights)
+
+    E, H, F, bm, bn = 2, 64, 128, 16, 32
+    x = jax.random.normal(jax.random.key(0), (2 * bm, H), jnp.float32)
+    wg = jax.random.normal(jax.random.key(1), (E, H, F), jnp.float32) * 0.1
+    wu = jax.random.normal(jax.random.key(2), (E, H, F), jnp.float32) * 0.1
+    be = jnp.zeros((2,), jnp.int32)
+    nb = jnp.int32(2)
+    wgu = pack_gated_weights(wg, wu, block_n=bn)
+    assert isinstance(wgu, PackedGatedWeights) and wgu.block_n == bn
+    # pytree roundtrip keeps the pack width (static aux data under jit)
+    leaves, tree = jax.tree_util.tree_flatten(wgu)
+    assert jax.tree_util.tree_unflatten(tree, leaves).block_n == bn
+
+    ok = grouped_gemm_gated(x, wgu, None, be, block_m=bm, block_n=bn,
+                            n_blocks_used=nb, packed=True)
+    assert ok.shape == (2 * bm, F)
+    with pytest.raises(AssertionError, match="block_n"):
+        grouped_gemm_gated(x, wgu, None, be, block_m=bm, block_n=64,
+                           n_blocks_used=nb, packed=True)
+
+
+def test_moe_ep_overlap_expert_major(ctx):
+    """The expert-major serving block: recv blocks arrive expert-segmented,
+    so moe_mlp_ep_overlap takes the static block→expert fast path (no
+    align gather / inverse scatter) — and must match the rank-major
+    align path, with the packed weight stream and on the int8 wire."""
+    from triton_dist_tpu.layers import EPAll2AllLayer
+    from triton_dist_tpu.models.moe import moe_mlp_ep_overlap
+    from triton_dist_tpu.ops.group_gemm import pack_gated_weights
+
+    n = ctx.num_ranks
+    T_local, D, F, E, k = 16, 128, 128, 2 * n, 2
+    T = n * T_local
+    x = (jax.random.normal(jax.random.key(0), (T, D), jnp.float32) * 0.3
+         ).astype(jnp.bfloat16)
+    router_w = jax.random.normal(jax.random.key(1), (D, E), jnp.float32) * 0.3
+    wg = (jax.random.normal(jax.random.key(2), (E, D, F)) * 0.1
+          ).astype(jnp.bfloat16)
+    wu = (jax.random.normal(jax.random.key(3), (E, D, F)) * 0.1
+          ).astype(jnp.bfloat16)
+    wd = (jax.random.normal(jax.random.key(4), (E, F, D)) * 0.1
+          ).astype(jnp.bfloat16)
+    xs = ctx.shard(x, P("x"))
+
+    outs = {}
+    for em in (False, True):
+        layer = EPAll2AllLayer.create(ctx, max_tokens=T_local, hidden=D,
+                                      topk=k, num_experts=E, axis="x",
+                                      expert_major=em)
+        outs[em] = np.asarray(jax.jit(lambda v, l=layer: moe_mlp_ep_overlap(
+            ctx, l, v, router_w, wg, wu, wd, axis="x", block_m=16))(xs),
+            np.float32)
+    assert_allclose(outs[True], outs[False], atol=1e-5, rtol=1e-5)
+
+    # packed double-width weight stream on the fast path
+    layer = EPAll2AllLayer.create(ctx, max_tokens=T_local, hidden=D, topk=k,
+                                  num_experts=E, axis="x", expert_major=True)
+    wgu = pack_gated_weights(wg, wu, block_n=64)
+    got_p = np.asarray(jax.jit(lambda v: moe_mlp_ep_overlap(
+        ctx, layer, v, router_w, wg, wu, wd, axis="x", block_m=16,
+        block_n=64, we_gate_up_packed=wgu))(xs), np.float32)
+    assert_allclose(got_p, outs[True], atol=2e-2, rtol=2e-2)
+
+    # int8 wire, both dequant edges, still on the fast path
+    for de in ("expert", "post"):
+        layer = EPAll2AllLayer.create(ctx, max_tokens=T_local, hidden=D,
+                                      topk=k, num_experts=E, axis="x",
+                                      wire_dtype=jnp.int8, dequant_edge=de,
+                                      expert_major=True)
+        o = np.asarray(jax.jit(lambda v, l=layer: moe_mlp_ep_overlap(
+            ctx, l, v, router_w, wg, wu, wd, axis="x", block_m=16))(xs),
+            np.float32)
+        assert_allclose(o, outs[True], atol=6e-2, rtol=6e-2)

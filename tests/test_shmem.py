@@ -25,6 +25,7 @@ def ctx():
     return initialize_distributed(axis_names=("x",), mesh_shape=(TEST_WORLD,))
 
 
+@pytest.mark.quick
 def test_ring_put(ctx):
     """Each PE puts its shard to its right neighbor; receiver waits the DMA
     recv semaphore (= notify/wait of tutorial 01)."""
@@ -116,6 +117,7 @@ def test_notify_wait_pingpong(ctx):
     assert_allclose(y, want)
 
 
+@pytest.mark.quick
 def test_barrier_all(ctx):
     """barrier_all: late PEs' pre-barrier writes must be visible to a remote
     read issued after the barrier (here: everyone puts before barrier, reads

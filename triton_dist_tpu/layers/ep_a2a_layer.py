@@ -27,7 +27,8 @@ class EPAll2AllLayer:
     def create(cls, ctx: ShmemContext, max_tokens: int, hidden: int,
                topk: int, num_experts: int, capacity: int | None = None,
                axis=None, dtype=jnp.bfloat16, wire_dtype=None,
-               quant_edge: str = "fused", dequant_edge: str = "post"):
+               quant_edge: str = "fused", dequant_edge: str = "post",
+               expert_major: bool = False):
         """``wire_dtype=jnp.float8_e4m3fn`` enables the quantized wire with
         the f32 scale side-channel (the reference's fp8 showcase protocol,
         low_latency_all_to_all.py:60-88).
@@ -37,11 +38,19 @@ class EPAll2AllLayer:
         expert scatter; the reference layer's inter-node path,
         ep_a2a_layer.py:187-240 over ep_a2a.py:35-147), including the
         quantized wire: tokens are quantized once at the edge and the
-        scale side-channel rides both tiers."""
+        scale side-channel rides both tiers.
+
+        ``expert_major=True`` (1d only) lays each (src, dst) capacity block
+        out expert-major with a per-expert slot budget — receive blocks
+        arrive expert-segmented, so the serving FFN skips its align
+        gather/scatter entirely (see ``EpAllToAllContext.expert_major``)."""
         if axis is not None and not isinstance(axis, str):
             axes = tuple(axis)
             assert len(axes) == 2, (
                 f"2-tier A2A takes exactly (major, minor) axes, got {axes}")
+            assert not expert_major, (
+                "expert_major is a 1d-context layout (the tier-2 re-slot "
+                "would have to re-group arrivals per expert)")
             return cls(a2a_ops.create_all_to_all_context_2d(
                 ctx, max_tokens, hidden, topk, num_experts, axes=axes,
                 cap1=capacity, dtype=dtype, wire_dtype=wire_dtype,
@@ -50,7 +59,7 @@ class EPAll2AllLayer:
             ctx, max_tokens, hidden, topk, num_experts,
             capacity=capacity, axis=axis, dtype=dtype,
             wire_dtype=wire_dtype, quant_edge=quant_edge,
-            dequant_edge=dequant_edge))
+            dequant_edge=dequant_edge, expert_major=expert_major))
 
     @property
     def is_2d(self) -> bool:

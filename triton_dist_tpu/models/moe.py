@@ -223,7 +223,8 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
     from the layer; ``x2d`` is P((major, minor))-sharded.
     """
     from triton_dist_tpu.ops.all_to_all import QuantTokens
-    from triton_dist_tpu.ops.group_gemm import (apply_grouped, grouped_gemm,
+    from triton_dist_tpu.ops.group_gemm import (PackedGatedWeights,
+                                                apply_grouped, grouped_gemm,
                                                 grouped_gemm_gated)
     from triton_dist_tpu.shmem import device as shd
 
@@ -237,6 +238,26 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
         shard_spec = P(group)
     E, k = a2a.num_experts, a2a.topk
     e_local = a2a.experts_per_rank
+
+    if isinstance(we_gate_up_packed, PackedGatedWeights):
+        # layer-level contract check of the serving weight layout: the
+        # interleave is invisible in the array's shape, so mismatches are
+        # only catchable while the pack width still rides the type
+        assert we_gate_up_packed.block_n == block_n, (
+            f"we_gate_up_packed was packed with "
+            f"block_n={we_gate_up_packed.block_n} but the layer runs "
+            f"block_n={block_n} — repack with pack_gated_weights(..., "
+            f"block_n={block_n})")
+        we_gate_up_packed = we_gate_up_packed.w
+
+    # expert-major recv layout (1d contexts): rows [e*cap_e, (e+1)*cap_e) of
+    # every src block belong to local expert e by construction, so the
+    # block→expert table is a static constant and the align gather/scatter
+    # passes are skipped entirely (the roofline attributed ~25 % extra
+    # weight traffic to their ragged block padding)
+    expert_major = (not is_2d) and getattr(a2a, "expert_major", False)
+    cap_e = a2a.capacity_per_expert if expert_major else None
+    em_fast = expert_major and cap_e % block_m == 0
 
     logits = x2d.astype(jnp.float32) @ router_w
     gate_vals, gate_ids = lax.top_k(jax.nn.softmax(logits, -1), k)
@@ -266,6 +287,11 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
         wu_l = (None if packed
                 else lax.dynamic_slice_in_dim(wu, me * e_local, e_local))
         wd_l = lax.dynamic_slice_in_dim(wd, me * e_local, e_local)
+        if packed:
+            # re-carry the pack width on the per-rank slice so the kernel
+            # re-validates it (the layer-level check above ran on the full
+            # table; the slice is a fresh bare array)
+            wg_l = PackedGatedWeights(wg_l, block_n)
 
         # gated FFN: silu(x@wg) * (x@wu) @ wd over local experts, as TWO
         # fused kernels: gate+up+act in one (each x-tile read once,
@@ -299,8 +325,26 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
         gdt = (a2a.dtype if (quant and jnp.issubdtype(tflat.dtype,
                                                       jnp.floating))
                else None)
-        out = apply_grouped(tflat, iflat, e_local, ffn, block_m=block_m,
-                            row_scale=sflat, gather_dtype=gdt)
+        if em_fast:
+            # expert-major fast path: the recv buffer IS expert-aligned.
+            # Block b sits at row offset (b·bm) mod cap of its src block,
+            # whose expert segment is that offset // cap_e — a static
+            # constant (cap_e % block_m == 0 means no block straddles a
+            # segment). No align gather, no inverse scatter: the slots are
+            # already the combine order, and unfilled slots are zero rows
+            # whose FFN output is zero (scale 1 on the quantized wire).
+            # ALL row blocks run (the per-expert budget makes that the
+            # roofline count — vs the ragged-padding blocks the align
+            # pass added on the rank-major layout).
+            cap = a2a.capacity
+            be = jnp.asarray([(b * block_m % cap) // cap_e
+                              for b in range(rows // block_m)], jnp.int32)
+            xs = tflat if gdt is None else tflat.astype(gdt)
+            out = (ffn(xs, be, rows // block_m, sflat)
+                   if sflat is not None else ffn(xs, be, rows // block_m))
+        else:
+            out = apply_grouped(tflat, iflat, e_local, ffn, block_m=block_m,
+                                row_scale=sflat, gather_dtype=gdt)
         if is_2d:
             return out.reshape(tok.shape[:-1] + (-1,))
         return out.reshape(n, tok.shape[-2], -1)

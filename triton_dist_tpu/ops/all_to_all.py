@@ -39,45 +39,152 @@ from jax.sharding import PartitionSpec as P
 from triton_dist_tpu.ops.common import collective_id_for
 from triton_dist_tpu.shmem import device as shd
 from triton_dist_tpu.shmem.context import ShmemContext
-from triton_dist_tpu.utils import default_interpret
+from triton_dist_tpu.utils import default_interpret, on_cpu
+
+
+def _xla_wire(ctx: ShmemContext, axis: str) -> bool:
+    """True when this axis' wire exchange must run as plain XLA collectives
+    instead of the Pallas remote-DMA kernel: the host-driven DCN tier
+    (remote DMA cannot cross a slice boundary), or the CPU simulator on jax
+    builds whose interpreter has no cross-device semaphore/DMA model (the
+    0.4.x line — ``get_barrier_semaphore`` and remote copies only lower on
+    Mosaic there). ``TDT_FORCE_COMPILED=1`` still traces the kernel path
+    for the AOT topology gate."""
+    import os
+    if ctx.is_dcn_axis(axis):
+        return True
+    if os.environ.get("TDT_FORCE_COMPILED") == "1":
+        return False
+    return on_cpu() and not _interp_supports_remote_dma()
+
+
+def _interp_supports_remote_dma() -> bool:
+    """Whether Pallas interpret mode on this jax can execute the remote-DMA
+    collective kernel (TPU interpret mode with shared-memory simulation).
+    The 0.4.x generic interpreter cannot — it has no lowering for
+    ``get_barrier_semaphore`` / cross-device ``make_async_remote_copy``."""
+    return (getattr(pltpu, "InterpretParams", None) is not None
+            or getattr(pltpu, "TPUInterpretParams", None) is not None)
 
 
 # ---------------------------------------------------------------------------
 # wire collective
 # ---------------------------------------------------------------------------
 
-def _a2a_kernel(axis, mesh_axes, n_arrays, dequant, refs):
-    """refs = [in_0..in_{A-1}, (deq_out,)? out_0..out_{A-1}, send_sems,
-    recv_sems]. Each array is [n, ...]: in slot p is the payload for peer p;
-    out slot p is the payload received from peer p.
+def _quant_slot_pipeline(x_at_p, q_at_p, s_at_p, wire_q, cap, H):
+    """Quantize one destination slot's [cap, H] rows into the wire staging
+    refs, (128, H) row tiles at a time — the send-edge mirror of
+    ``_dequant_slot_pipeline``. Row math is bit-identical to ``_quant``
+    (same f32 amax / divide chain; zero rows quantize to zeros with scale
+    1). Module-level so the single-device golden test can drive the exact
+    kernel tile math without the collective around it."""
+    qmax = _qmax(wire_q)
+    is_float = jnp.issubdtype(wire_q, jnp.floating)
+
+    def body(x_blk, q_blk, s_blk):
+        xf = x_blk[...].astype(jnp.float32)
+        amax = jnp.max(jnp.abs(xf), axis=-1)              # [128]
+        scale = jnp.where(amax > 0, amax / qmax, 1.0)
+        q = xf / scale[:, None]
+        if not is_float:
+            q = jnp.round(q)
+        q_blk[...] = q.astype(wire_q)
+        # scale run [i*128, (i+1)*128) of the flattened wire is row i
+        # of the [cap//128, 128] side-channel (same layout the dequant
+        # pipeline reads back on the receive edge)
+        s_blk[...] = scale.reshape(1, -1)
+
+    # whole-(128, H) row tiles: the per-row amax needs the full row in
+    # one block, which is why the fused path requires H lane-aligned
+    pltpu.emit_pipeline(
+        body,
+        grid=(cap // 128,),
+        in_specs=[pl.BlockSpec((128, H), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((128, H), lambda i: (i, 0)),
+                   pl.BlockSpec((1, 128), lambda i: (i, 0))],
+    )(x_at_p, q_at_p, s_at_p)
+
+
+def _dequant_slot_pipeline(q_at_p, s_at_p, o_at_p, out_dtype, cap, H, bn):
+    """Dequantize one arrived slot's [cap, H] wire rows into ``o_at_p``,
+    (128, bn) tiles at a time (receive edge of the quantized wire)."""
+
+    def body(q_blk, sc_blk, o_blk):
+        sc = sc_blk[0]                                    # [128] lanes
+        o_blk[...] = (q_blk[...].astype(jnp.float32)
+                      * sc[:, None]).astype(out_dtype)
+
+    pltpu.emit_pipeline(
+        body,
+        grid=(cap // 128, H // bn),
+        in_specs=[
+            pl.BlockSpec((128, bn), lambda i, j: (i, j)),
+            # scale run [i*128, (i+1)*128) of the flattened wire is
+            # exactly row i of the [rows, 128] side-channel (the fused
+            # path requires cap % 128 == 0 — Mosaic rejects sub-128
+            # lane slices)
+            pl.BlockSpec((1, 128), lambda i, j: (i, 0)),
+        ],
+        out_specs=[pl.BlockSpec((128, bn), lambda i, j: (i, j))],
+    )(q_at_p, s_at_p, o_at_p)
+
+
+def _a2a_kernel(axis, mesh_axes, n_arrays, dequant, quant, refs):
+    """refs = [in_0..in_{A-1}, (qsend, qsc,)? (deq_out,)?
+    out_0..out_{W-1}, send_sems, recv_sems] with W = A wire arrays (A+1
+    under ``quant``: the f32 scale wire is appended LAST). Each array is
+    [n, ...]: in slot p is the payload for peer p; out slot p is the
+    payload received from peer p.
 
     ``dequant`` (None or ``(out_dtype, cap, H, bn)``; cap % 128 == 0) fuses
-    the
-    receive-edge dequantization INTO the collective: array 0 is then the
+    the receive-edge dequantization INTO the collective: array 0 is then the
     quantized [n, cap, H] payload, the LAST array its f32 scale wire
     [n, cap_cols//128, 128], and each peer's slot is dequantized into
     ``deq_out`` as soon as it arrives — early arrivals' dequant overlaps the
     wait for later peers, so only the LAST slot's dequant rides the critical
     path (vs a full extra pass after the kernel). The reference's fp8 wire
     does the same: scales ride the kernel and apply in place
-    (low_latency_all_to_all.py:60-88)."""
+    (low_latency_all_to_all.py:60-88).
+
+    ``quant`` (None or ``(wire_dtype, cap, H)``; cap % 128 == 0) is the
+    SEND-side mirror: in_0 is a [n, cap, H] compute-dtype payload that is
+    quantized per-row into the ``qsend``/``qsc`` staging buffers — slot p
+    tile-by-tile, IMMEDIATELY before slot p's put is issued — so peer p's
+    wire bytes leave as soon as its slot is quantized instead of after a
+    whole-buffer pass, and no standalone qpack pass exists outside the
+    collective. Row math is bit-identical to ``_quant`` (same f32 amax /
+    divide chain, zero rows quantize to zeros with scale 1)."""
     ins = refs[:n_arrays]
-    if dequant is None:
-        deq = None
-        outs = refs[n_arrays:2 * n_arrays]
-        send_sems, recv_sems = refs[2 * n_arrays:]
-    else:
-        deq = refs[n_arrays]
-        outs = refs[n_arrays + 1:2 * n_arrays + 1]
-        send_sems, recv_sems = refs[2 * n_arrays + 1:]
+    off = n_arrays
+    if quant is not None:
+        qsend, qsc = refs[off], refs[off + 1]
+        off += 2
+    deq = None
+    if dequant is not None:
+        deq = refs[off]
+        off += 1
+    n_wire = n_arrays + (1 if quant is not None else 0)
+    outs = refs[off:off + n_wire]
+    send_sems, recv_sems = refs[off + n_wire:]
     me = shd.my_pe(axis)
     n = shd.n_pes(axis)
 
+    # send sources: under quant, the staged wire payload replaces in_0 and
+    # the staged scales ride as the extra LAST wire array
+    srcs = ((qsend,) + tuple(ins[1:]) + (qsc,)) if quant is not None else ins
+
     shd.barrier_all((axis,), mesh_axes=mesh_axes)
 
+    def quant_slot(p):
+        wire_q, cap, H = quant
+        _quant_slot_pipeline(ins[0].at[p], qsend.at[p], qsc.at[p],
+                             wire_q, cap, H)
+
+    if quant is not None:
+        quant_slot(me)
     local_copies = []
-    for a in range(n_arrays):
-        c = pltpu.make_async_copy(ins[a].at[me], outs[a].at[me],
+    for a in range(n_wire):
+        c = pltpu.make_async_copy(srcs[a].at[me], outs[a].at[me],
                                   recv_sems.at[a, me])
         c.start()
         local_copies.append(c)
@@ -85,32 +192,17 @@ def _a2a_kernel(axis, mesh_axes, n_arrays, dequant, refs):
     for p in range(1, n):
         dst = lax.rem(me + p, n)
         pid = shd.pe_at(mesh_axes, axis, dst)
-        for a in range(n_arrays):
-            rdmas.append(shd.putmem_nbi(outs[a].at[me], ins[a].at[dst],
+        if quant is not None:
+            quant_slot(dst)   # slot dst's wire bytes exist just in time
+        for a in range(n_wire):
+            rdmas.append(shd.putmem_nbi(outs[a].at[me], srcs[a].at[dst],
                                         send_sems.at[a, dst],
                                         recv_sems.at[a, me], pid))
 
     def dequant_slot(p):
         out_dtype, cap, H, bn = dequant
-
-        def body(q_blk, sc_blk, o_blk):
-            sc = sc_blk[0]                                    # [128] lanes
-            o_blk[...] = (q_blk[...].astype(jnp.float32)
-                          * sc[:, None]).astype(out_dtype)
-
-        pltpu.emit_pipeline(
-            body,
-            grid=(cap // 128, H // bn),
-            in_specs=[
-                pl.BlockSpec((128, bn), lambda i, j: (i, j)),
-                # scale run [i*128, (i+1)*128) of the flattened wire is
-                # exactly row i of the [rows, 128] side-channel (the fused
-                # path requires cap % 128 == 0 — Mosaic rejects sub-128
-                # lane slices)
-                pl.BlockSpec((1, 128), lambda i, j: (i, 0)),
-            ],
-            out_specs=[pl.BlockSpec((128, bn), lambda i, j: (i, j))],
-        )(outs[0].at[p], outs[-1].at[p], deq.at[p])
+        _dequant_slot_pipeline(outs[0].at[p], outs[-1].at[p], deq.at[p],
+                               out_dtype, cap, H, bn)
 
     for c in local_copies:
         c.wait()
@@ -129,7 +221,9 @@ def all_to_all_push(ctx: ShmemContext, *arrays: jax.Array,
                     axis: str | None = None,
                     spec: P | None = None,
                     dequant_to=None,
-                    fuse_dequant: bool = True) -> tuple[jax.Array, ...]:
+                    fuse_dequant: bool = True,
+                    quant_from=None,
+                    fuse_quant: bool = True) -> tuple[jax.Array, ...]:
     """Generic low-latency All-to-All: each input is locally ``[n, ...]``
     where slot p is the payload destined for peer p along ``axis``. Returns
     same-shaped arrays where local slot p holds the payload *received from*
@@ -149,15 +243,52 @@ def all_to_all_push(ctx: ShmemContext, *arrays: jax.Array,
     as it arrived, overlapping the waits for later peers.
     ``fuse_dequant=False`` keeps the dequant as one post-kernel XLA pass
     instead (cheaper at n=1 where there are no later-peer waits to hide the
-    in-kernel pipeline behind; see docs/benchmarks.md fp8-edge table)."""
+    in-kernel pipeline behind; see docs/benchmarks.md fp8-edge table).
+
+    ``quant_from=<wire dtype>`` is the send-side mirror: ``arrays[0]`` is a
+    compute-dtype [n, cap, H] payload that the KERNEL quantizes per
+    destination slot, tile-by-tile, immediately before that slot's put —
+    no standalone qpack pass precedes the collective, and peer p's bytes
+    leave as soon as slot p is quantized. The f32 scale wire is created
+    internally and returned as the LAST output (so returns have
+    ``len(arrays) + 1`` entries: quantized payload (or its dequantized form
+    under ``dequant_to``), pass-through arrays, scale). Sub-128 caps, DCN
+    tiers and ``fuse_quant=False`` fall back to one XLA quantize pass in
+    front of the plain wire push — same outputs, bit-identical rows."""
     axis = axis or ctx.axis_names[0]
     n = ctx.axis_size(axis)
     mesh_axes = ctx.axis_names
     spec = spec if spec is not None else P(axis)
     n_arrays = len(arrays)
-    if ctx.is_dcn_axis(axis):
-        # DCN tier: remote DMA cannot cross a slice boundary — run this
-        # axis' exchange as an XLA ``lax.all_to_all`` (host-driven DCN
+    quant = None
+    if quant_from is not None:
+        wire_q = jnp.dtype(quant_from)
+        cap_q, H_q = arrays[0].shape[-2:]
+        q_aligned = cap_q % 128 == 0 and H_q % 128 == 0
+        if _xla_wire(ctx, axis) or not (fuse_quant and q_aligned):
+            # send-edge fallback (host-driven DCN tier / CPU simulator,
+            # sub-128 caps that can't take the in-kernel (128, H) row
+            # tiles, or an explicit fuse_quant=False): one XLA quantize
+            # pass, then the plain quantized-wire push below
+            cols = _id_cols(cap_q)
+
+            def _qpack(x):
+                nl = x.shape[0]
+                q, s = _quant(x.reshape(nl * cap_q, H_q), wire_q)
+                sc = jnp.ones((nl, cols), jnp.float32).at[:, :cap_q].set(
+                    s.reshape(nl, cap_q))
+                return q.reshape(x.shape), sc.reshape(nl, -1, 128)
+
+            pq, psc = ctx.shard_map(_qpack, in_specs=spec,
+                                    out_specs=(spec, spec))(arrays[0])
+            return all_to_all_push(ctx, pq, *arrays[1:], psc, axis=axis,
+                                   spec=spec, dequant_to=dequant_to,
+                                   fuse_dequant=fuse_dequant)
+        quant = (wire_q, cap_q, H_q)
+    if _xla_wire(ctx, axis):
+        # DCN tier (or CPU simulator without a remote-DMA interpreter):
+        # remote DMA cannot cross a slice boundary — run this axis'
+        # exchange as an XLA ``lax.all_to_all`` (host-driven DCN
         # transfers, XLA-scheduled). Identical slot semantics: local slot
         # p of dim -3 goes to peer p / arrives from peer p. The
         # hierarchical ops compose per-axis pushes, so marking the outer
@@ -183,7 +314,8 @@ def all_to_all_push(ctx: ShmemContext, *arrays: jax.Array,
     cap = None
     if dequant_to is not None:
         import math
-        assert n_arrays >= 2, "quantized wire needs payload + scale arrays"
+        if quant is None:
+            assert n_arrays >= 2, "quantized wire needs payload + scale arrays"
         _, cap, H = arrays[0].shape[-3:]
         if fuse_dequant and cap % 128 == 0 and H % 128 == 0:
             # in-kernel per-arrival dequant (sub-128 caps or hidden dims
@@ -194,20 +326,33 @@ def all_to_all_push(ctx: ShmemContext, *arrays: jax.Array,
 
     def f(*shards):
         kernel = lambda *refs: _a2a_kernel(axis, mesh_axes, n_arrays,
-                                           dequant, refs)
+                                           dequant, quant, refs)
+        n_loc = shards[0].shape[0]
+        pre = ()
+        if quant is not None:
+            q_sds = jax.ShapeDtypeStruct(shards[0].shape, wire_q)
+            sc_sds = jax.ShapeDtypeStruct((n_loc, cap_q // 128, 128),
+                                          jnp.float32)
+            pre = (q_sds, sc_sds)       # send-side staging (wire + scales)
+            wire_outs = (q_sds,) + tuple(
+                jax.ShapeDtypeStruct(s.shape, s.dtype)
+                for s in shards[1:]) + (sc_sds,)
+        else:
+            wire_outs = tuple(
+                jax.ShapeDtypeStruct(s.shape, s.dtype) for s in shards)
         deq_shape = ()
         if dequant is not None:
             deq_shape = (jax.ShapeDtypeStruct(shards[0].shape, dequant[0]),)
+        n_wire = len(wire_outs)
         out = pl.pallas_call(
             kernel,
-            out_shape=deq_shape + tuple(
-                jax.ShapeDtypeStruct(s.shape, s.dtype) for s in shards),
+            out_shape=pre + deq_shape + wire_outs,
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * n_arrays,
             out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * (
-                n_arrays + len(deq_shape)),
+                len(pre) + len(deq_shape) + n_wire),
             scratch_shapes=[
-                pltpu.SemaphoreType.DMA((n_arrays, n)),
-                pltpu.SemaphoreType.DMA((n_arrays, n)),
+                pltpu.SemaphoreType.DMA((n_wire, n)),
+                pltpu.SemaphoreType.DMA((n_wire, n)),
             ],
             compiler_params=pltpu.CompilerParams(
                 has_side_effects=True,
@@ -218,6 +363,7 @@ def all_to_all_push(ctx: ShmemContext, *arrays: jax.Array,
                 collective_id=collective_id_for(f"all_to_all_{axis}")),
             interpret=default_interpret(),
         )(*shards)
+        out = out[len(pre):]            # drop the send-side staging
         if dequant is not None:
             # visible outs = (dequantized, raw wire ws, rest...): swap the
             # raw payload ws for the dequantized buffer, keep the rest
@@ -229,8 +375,9 @@ def all_to_all_push(ctx: ShmemContext, *arrays: jax.Array,
             return (_dequant(out[0], scale, dequant_to),) + out[1:]
         return out if isinstance(out, tuple) else (out,)
 
+    n_out = n_arrays + (1 if quant is not None else 0)
     sm = ctx.shard_map(f, in_specs=tuple(spec for _ in arrays),
-                       out_specs=tuple(spec for _ in arrays))
+                       out_specs=tuple(spec for _ in range(n_out)))
     return sm(*arrays)
 
 
@@ -266,13 +413,17 @@ class EpAllToAllContext:
     (low_latency_all_to_all.py:60-88, README.md:55). Dequantization happens
     at the receiving edge; expert compute stays in ``dtype``.
 
-    The two wire-edge strategies (swept on-chip at the DeepSeek-infer
+    The wire-edge strategies (swept on-chip at the DeepSeek-infer
     shape, round 4 — docs/benchmarks.md fp8-edge table):
     - ``quant_edge``: "fused" (default, measured 93.5 µs dispatch) gathers
       rows and quantizes per slot in one fused XLA pass; "pre" (131.9 µs)
       quantizes the T source rows once and gathers the 1-byte wire rows —
       slower on TPU: sub-word row gathers don't vectorize as well as the
-      fused f32 gather+quant chain.
+      fused f32 gather+quant chain. "kernel" gathers rows in the compute
+      dtype and quantizes INSIDE the collective, per destination slot,
+      immediately before that slot's put (``all_to_all_push(quant_from=)``)
+      — peer p's wire bytes leave as soon as slot p is quantized, the
+      multi-chip mirror of the per-arrival dequant.
     - ``dequant_edge``: "post" (default) = one XLA pass after the
       collective; "kernel" = per-arrival in-kernel ``emit_pipeline``
       dequant. Measured +106-125 µs at n=1 — the pipeline's fine-grained
@@ -284,7 +435,22 @@ class EpAllToAllContext:
       (``grouped_gemm(row_scale=...)``) — no dequant pass anywhere, and
       the expert reads half the token bytes. This is the reference's
       architecture (scales ride into the expert GEMM; its post_process
-      never applies them)."""
+      never applies them).
+
+    ``expert_major``: lay each (src, dst) capacity block out EXPERT-major —
+    slots are grouped per (dst rank, local expert) with a per-expert budget
+    ``capacity_per_expert = capacity // experts_per_rank``, so multinomial
+    routing spill past one expert's budget is capped AT THE SOURCE instead
+    of raggedly padding the receiver's block alignment (the roofline
+    attributes ~25 % extra weight traffic to that padding: ≈20-of-16 used
+    blocks at the DeepSeek serving shape). Rows
+    ``[e*cap_e, (e+1)*cap_e)`` of every received src block belong to local
+    expert ``e`` by construction, which makes the consumer's block→expert
+    table a static constant and deletes the align gather/scatter passes
+    entirely when ``cap_e`` is a block_m multiple
+    (``moe_mlp_ep_overlap``). Trade-off: drops are per (src, dst, expert)
+    rather than per (src, dst) — heavier skew toward one expert drops
+    sooner; size ``capacity`` accordingly."""
     ctx: ShmemContext
     axis: str
     max_tokens: int      # tokens per rank entering dispatch
@@ -294,8 +460,9 @@ class EpAllToAllContext:
     capacity: int        # slots per (src,dst) rank pair
     dtype: jnp.dtype = jnp.bfloat16
     wire_dtype: jnp.dtype | None = None
-    quant_edge: str = "fused"     # "fused" | "pre"
+    quant_edge: str = "fused"     # "fused" | "pre" | "kernel"
     dequant_edge: str = "post"    # "post" | "kernel"
+    expert_major: bool = False
 
     def _dequant_in_kernel(self) -> bool:
         return self.dequant_edge == "kernel"
@@ -308,6 +475,11 @@ class EpAllToAllContext:
     def experts_per_rank(self) -> int:
         return self.num_experts // self.n_ranks
 
+    @property
+    def capacity_per_expert(self) -> int:
+        assert self.expert_major, "capacity is per-rank unless expert_major"
+        return self.capacity // self.experts_per_rank
+
 
 def create_all_to_all_context(ctx: ShmemContext, max_tokens: int, hidden: int,
                               topk: int, num_experts: int,
@@ -316,17 +488,24 @@ def create_all_to_all_context(ctx: ShmemContext, max_tokens: int, hidden: int,
                               dtype=jnp.bfloat16,
                               wire_dtype=None,
                               quant_edge: str = "fused",
-                              dequant_edge: str = "post"
+                              dequant_edge: str = "post",
+                              expert_major: bool = False
                               ) -> EpAllToAllContext:
     axis = axis or ctx.axis_names[0]
     n = ctx.axis_size(axis)
     assert num_experts % n == 0, (num_experts, n)
-    assert quant_edge in ("pre", "fused"), quant_edge
+    assert quant_edge in ("pre", "fused", "kernel"), quant_edge
     assert dequant_edge in ("kernel", "post", "expert"), dequant_edge
     if capacity is None:
         capacity = max_tokens * topk  # worst case: everything to one rank
     wire_itemsize = jnp.dtype(wire_dtype or dtype).itemsize
     capacity = _cap_round(capacity, wire_itemsize)
+    if expert_major:
+        # split the per-rank budget evenly per local expert, each sublane
+        # tile-rounded so every expert segment is independently DMA-aligned
+        epr = num_experts // n
+        cap_e = _cap_round(-(-capacity // epr), wire_itemsize)
+        capacity = cap_e * epr
     assert hidden % 128 == 0, f"hidden={hidden} must be a lane multiple (128)"
     return EpAllToAllContext(ctx=ctx, axis=axis, max_tokens=max_tokens,
                              hidden=hidden, topk=topk,
@@ -335,7 +514,8 @@ def create_all_to_all_context(ctx: ShmemContext, max_tokens: int, hidden: int,
                              wire_dtype=(jnp.dtype(wire_dtype)
                                          if wire_dtype is not None else None),
                              quant_edge=quant_edge,
-                             dequant_edge=dequant_edge)
+                             dequant_edge=dequant_edge,
+                             expert_major=expert_major)
 
 
 def route_tokens(a2a: EpAllToAllContext, topk_ids: jax.Array):
@@ -346,19 +526,43 @@ def route_tokens(a2a: EpAllToAllContext, topk_ids: jax.Array):
     ``dest``. Pure jnp under jit/shard_map; a host routing table (numpy
     ``topk_ids``) takes the native C++ path (``csrc.a2a_slot_assign`` —
     the registered-host-op analog, csrc registry.cc:32-44) with no device
-    round-trip. The twins are cross-tested in test_tools.py."""
+    round-trip. The twins are cross-tested in test_tools.py.
+
+    Under ``expert_major`` the slot allocation groups by (dest rank, LOCAL
+    expert) — the global expert id is the virtual destination over
+    ``num_experts`` groups of ``capacity_per_expert`` slots each — and the
+    returned slot is ``local_expert * cap_e + rank_in_group``, so each
+    (src, dst) block arrives expert-segmented and per-expert spill drops at
+    the source (see ``EpAllToAllContext.expert_major``)."""
     import numpy as np
     T, k = topk_ids.shape
+    epr = a2a.experts_per_rank
+    em = getattr(a2a, "expert_major", False)
+    cap_e = a2a.capacity_per_expert if em else None
     if isinstance(topk_ids, np.ndarray) and not isinstance(
             topk_ids, jax.Array):
         from triton_dist_tpu import csrc
-        dest = topk_ids.astype(np.int32) // a2a.experts_per_rank
-        res = csrc.native_or_none("a2a_slot_assign", dest.reshape(-1),
-                                  a2a.n_ranks, a2a.capacity)
-        if res is not None:
-            slot, valid = res
-            return dest, slot.reshape(T, k), valid.reshape(T, k)
-    dest = topk_ids // a2a.experts_per_rank                      # [T,k]
+        ids32 = topk_ids.astype(np.int32)
+        dest = ids32 // epr
+        if em:
+            # same counter kernel, finer groups: one per global expert
+            res = csrc.native_or_none("a2a_slot_assign", ids32.reshape(-1),
+                                      a2a.num_experts, cap_e)
+            if res is not None:
+                r, valid = res
+                slot = (ids32.reshape(-1) % epr) * cap_e + r
+                return dest, slot.reshape(T, k), valid.reshape(T, k)
+        else:
+            res = csrc.native_or_none("a2a_slot_assign", dest.reshape(-1),
+                                      a2a.n_ranks, a2a.capacity)
+            if res is not None:
+                slot, valid = res
+                return dest, slot.reshape(T, k), valid.reshape(T, k)
+    dest = topk_ids // epr                                       # [T,k]
+    if em:
+        r, valid = _slot_assign(topk_ids.reshape(-1), a2a.num_experts, cap_e)
+        slot = (topk_ids.reshape(-1) % epr) * cap_e + r
+        return dest, slot.reshape(T, k), valid.reshape(T, k)
     slot, valid = _slot_assign(dest.reshape(-1), a2a.n_ranks, a2a.capacity)
     return dest, slot.reshape(T, k), valid.reshape(T, k)
 
@@ -381,6 +585,9 @@ def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
 
     id_cols = _id_cols(cap)  # lane-aligned ids wire
     wire = a2a.wire_dtype
+    # quant_edge="kernel": the gather stays in the compute dtype and the
+    # collective quantizes per destination slot just before its put
+    kq = wire is not None and a2a.quant_edge == "kernel"
 
     def build(tok_shard, ids_shard):
         dest, slot, valid = route_tokens(a2a, ids_shard)
@@ -397,7 +604,7 @@ def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
         if wire is not None and a2a.quant_edge == "pre":
             send_buf, send_sc = _slot_gather_prequant(tok_shard, src, wire,
                                                       n, id_cols, cap)
-        elif wire is not None:
+        elif wire is not None and not kq:
             # fused gather+quant: one logical pass builds wire buf + scales
             send_buf, sc = _slot_gather_quant(tok_shard, src, wire)
             send_sc = jnp.ones((n, id_cols), jnp.float32).at[:, :cap].set(
@@ -409,22 +616,26 @@ def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
         # wire format: [n, rows, 128] so the per-peer DMA slice is
         # lane-aligned on real TPUs
         outs = (send_buf, send_ids.reshape(n, id_cols // 128, 128))
-        if wire is not None:
+        if wire is not None and not kq:
             outs += (send_sc,)
         return outs + (dest, slot, valid)
 
-    n_wire = 3 if wire is not None else 2
+    n_wire = 3 if (wire is not None and not kq) else 2
     sm = ctx.shard_map(build, in_specs=(P(axis), P(axis)),
                        out_specs=(P(axis),) * (n_wire + 3))
-    if wire is not None:
+    if wire is not None and not kq:
         send_buf, send_ids, send_sc, dest, slot, valid = sm(tokens, topk_ids)
     else:
         send_buf, send_ids, dest, slot, valid = sm(tokens, topk_ids)
     if wire is not None and a2a.dequant_edge == "expert":
         # no dequantization anywhere: tokens stay in the wire dtype and the
         # scales ride alongside for the expert GEMM's accumulator
-        recv_q, recv_ids_wire, recv_sc = all_to_all_push(
-            ctx, send_buf, send_ids, send_sc, axis=axis)
+        if kq:
+            recv_q, recv_ids_wire, recv_sc = all_to_all_push(
+                ctx, send_buf, send_ids, axis=axis, quant_from=wire)
+        else:
+            recv_q, recv_ids_wire, recv_sc = all_to_all_push(
+                ctx, send_buf, send_ids, send_sc, axis=axis)
         unpack_sc = ctx.shard_map(
             lambda w: w.reshape(n, -1)[:, :cap],
             in_specs=P(axis), out_specs=P(axis))
@@ -433,9 +644,14 @@ def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
         # dequant at the receive edge, per the context's dequant_edge
         # policy: one post-kernel XLA pass (default) or per-arrival
         # in-kernel (multi-chip experiment: overlaps later peers' waits)
-        recv_tokens, recv_ids_wire, _ = all_to_all_push(
-            ctx, send_buf, send_ids, send_sc, axis=axis,
-            dequant_to=a2a.dtype, fuse_dequant=a2a._dequant_in_kernel())
+        if kq:
+            recv_tokens, recv_ids_wire, _ = all_to_all_push(
+                ctx, send_buf, send_ids, axis=axis, quant_from=wire,
+                dequant_to=a2a.dtype, fuse_dequant=a2a._dequant_in_kernel())
+        else:
+            recv_tokens, recv_ids_wire, _ = all_to_all_push(
+                ctx, send_buf, send_ids, send_sc, axis=axis,
+                dequant_to=a2a.dtype, fuse_dequant=a2a._dequant_in_kernel())
     else:
         recv_tokens, recv_ids_wire = all_to_all_push(ctx, send_buf, send_ids,
                                                      axis=axis)
@@ -458,23 +674,17 @@ def combine(a2a: EpAllToAllContext, processed: jax.Array, layout,
     n, cap, H, k = a2a.n_ranks, a2a.capacity, a2a.hidden, a2a.topk
     wire = a2a.wire_dtype
     if wire is not None:
-        # quantize the return trip too (reference sends fp8 both ways)
-        id_cols = _id_cols(cap)
-
-        def qpack(p_shard):
-            q, s = _quant(p_shard.reshape(n * cap, H), wire)
-            sc = jnp.ones((n, id_cols), jnp.float32).at[:, :cap].set(
-                s.reshape(n, cap))
-            return q.reshape(n, cap, H), sc.reshape(n, -1, 128)
-
-        pq, psc = ctx.shard_map(qpack, in_specs=P(axis),
-                                out_specs=(P(axis), P(axis)))(processed)
+        # quantize the return trip too (reference sends fp8 both ways) —
+        # INSIDE the collective, per departure slot (all_to_all_push's
+        # quant_from; sub-128 capacities fall back to one XLA pass there)
         if a2a.dequant_edge == "expert":
             # no full-buffer dequant: the scale is gathered with the token
             # in the combine epilogue and folded into the f32 weighted sum
-            back, back_sc = all_to_all_push(ctx, pq, psc, axis=axis)
+            back, back_sc = all_to_all_push(ctx, processed, axis=axis,
+                                            quant_from=wire)
         else:
-            back, _ = all_to_all_push(ctx, pq, psc, axis=axis,
+            back, _ = all_to_all_push(ctx, processed, axis=axis,
+                                      quant_from=wire,
                                       dequant_to=a2a.dtype,
                                       fuse_dequant=a2a._dequant_in_kernel())
             back_sc = None
@@ -566,13 +776,30 @@ def _slot_onehot(src, R):
             == jnp.arange(R, dtype=src.dtype)[None, :])
 
 
+def _sanitize_rows(rows):
+    """Non-finite containment for the slot gathers: a single Inf/NaN source
+    row would poison EVERY slot on the MXU one-hot path (the 0.0·x terms of
+    the contraction are NaN), so non-finite values are clamped to the
+    dtype's finite range (``jnp.nan_to_num``: NaN→0, ±Inf→±max) BEFORE the
+    gather — on both paths, so the MXU and take twins stay bit-comparable.
+    Behavior change (documented): a token carrying non-finite activations
+    now dispatches as its clamped-finite row instead of corrupting the
+    whole dispatch; integer/wire-int rows pass through untouched."""
+    if jnp.issubdtype(rows.dtype, jnp.floating):
+        return jnp.nan_to_num(rows)
+    return rows
+
+
 def _slot_gather(rows, src, out_dtype):
     """Build a [n_dst, cap, H] send buffer by gathering ``rows`` [R, H]
     through the slot->source-row map ``src`` [n_dst, cap] (value R =
     unfilled -> zeros). Small-R path: gather-by-MXU (see
     ``_MXU_GATHER_MAX_ROWS``). Large-R path: one take-gather instead of
     zero-init + scattering pre-expanded rows — half the HBM traffic on the
-    dispatch critical path."""
+    dispatch critical path. Non-finite source rows are clamped first
+    (``_sanitize_rows``) so one bad row cannot poison every slot via the
+    one-hot contraction."""
+    rows = _sanitize_rows(rows)
     R = rows.shape[0]
     out_shape = src.shape + rows.shape[1:]
     if R <= _MXU_GATHER_MAX_ROWS and rows.ndim == 2:
@@ -616,7 +843,8 @@ def _slot_gather_quant(rows, src, wire_dtype):
     A token routed to k slots has its amax recomputed per slot — identical
     scale each time (bit-for-bit: same reduction over the same row).
     Unfilled slots quantize to zeros with scale 1 (``_quant``'s zero-row
-    rule)."""
+    rule). Non-finite source rows are clamped first (``_sanitize_rows``)."""
+    rows = _sanitize_rows(rows)
     R = rows.shape[0]
     H = rows.shape[-1]
     if R <= _MXU_GATHER_MAX_ROWS and rows.ndim == 2:
@@ -642,6 +870,7 @@ def _slot_gather_prequant(rows, src, wire_dtype, n_dst, cols, cap):
     TPU (see ``_slot_gather_quant``); kept selectable as the bit-parity
     twin. Returns (send_buf [n_dst, cap, H] wire, scale wire
     [n_dst, cols//128, 128] f32 with 1.0 in unfilled/pad slots)."""
+    rows = _sanitize_rows(rows)
     R = rows.shape[0]
     q, s = _quant(rows, wire_dtype)
     send = _slot_gather(q, src, wire_dtype)
@@ -905,17 +1134,12 @@ def combine_2d(a2a: Ep2dAllToAllContext, processed: jax.Array, layouts,
     wire = a2a.wire_dtype
 
     if wire is not None:
-        # quantize the return trip once at the experts; scales ride both
-        # hops with the payload (reference sends fp8 both ways)
-        def qpack(p_shard):
-            q, sv = _quant(p_shard.reshape(nm * cap2, H), wire)
-            sc = jnp.ones((nm, c2_cols), jnp.float32).at[:, :cap2].set(
-                sv.reshape(nm, cap2))
-            return q.reshape(nm, cap2, H), sc.reshape(nm, -1, 128)
-
-        pq, psc = ctx.shard_map(qpack, in_specs=both,
-                                out_specs=(both, both))(processed)
-        back2, b2sc = all_to_all_push(ctx, pq, psc, axis=minor, spec=both)
+        # quantize the return trip once at the experts — inside the minor
+        # collective, per departure slot (all_to_all_push's quant_from;
+        # sub-128 capacities fall back to one XLA pass there); scales ride
+        # both hops with the payload (reference sends fp8 both ways)
+        back2, b2sc = all_to_all_push(ctx, processed, axis=minor, spec=both,
+                                      quant_from=wire)
     else:
         (back2,) = all_to_all_push(ctx, processed, axis=minor, spec=both)
 
@@ -924,7 +1148,10 @@ def combine_2d(a2a: Ep2dAllToAllContext, processed: jax.Array, layouts,
         tok = b2_shard[bd, idx]
         if wire is not None:
             tok = jnp.where(ok[:, None], tok, 0).astype(wire)
-            sv = scs[0].reshape(nm, c2_cols)[:, :cap2][bd, idx]
+            # reshape(nm, -1): the fused-quant scale wire is
+            # [nm, cap2//128, 128]; the XLA-fallback wire [nm, c2_cols//128,
+            # 128] — both flatten to >= cap2 scale columns
+            sv = scs[0].reshape(nm, -1)[:, :cap2][bd, idx]
             sc = jnp.ones((nM, c1_cols), jnp.float32).at[:, :cap1].set(
                 jnp.where(ok, sv, 1.0).reshape(nM, cap1))
             return (tok.reshape(nM, cap1, H), sc.reshape(nM, -1, 128))

@@ -113,7 +113,23 @@ def _ag_ll_kernel(axis, mesh_axes, phase_ref, in_ref, ws_ref, out_ref,
     The write target must be the persistent ws, NOT the per-call output
     (XLA may alias a not-yet-entered call's output buffer to live data —
     an early peer put would corrupt it); the local unpack ws→out is one
-    VMEM-speed copy of a latency-sized payload."""
+    VMEM-speed copy of a latency-sized payload.
+
+    INTERLEAVING HAZARD (why this kernel must not share a program point
+    with other collectives): the one-call-ahead argument above bounds
+    in-flight traffic *of this kernel* only. Its scratch semaphores are
+    per-``pallas_call`` allocations of physical registers, NOT reserved
+    across kernels — if another collective runs between a slow peer's
+    call k and my call k+1, Mosaic may hand that kernel the same
+    registers, and the straggler's put then signals into the bystander's
+    wait. Barriered kernels are immune ("everyone entered k+1" implies
+    "everyone exited k", so no cross-kernel signal can be outstanding);
+    *this* kernel trades exactly that guarantee for latency. Contract:
+    back-to-back LL AG calls on one axis may interleave only with each
+    other (the phase key disambiguates them) or with collectives that
+    open with their own entry barrier — never with another barrier-free
+    kernel on an overlapping device group. See docs/primitives.md
+    ("Barrier-free kernels")."""
     me = shd.my_pe(axis)
     n = shd.n_pes(axis)
     m = in_ref.shape[0]

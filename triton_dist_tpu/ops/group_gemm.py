@@ -13,6 +13,7 @@ hand-written CUDA kernel: it runs on the VPU inside the same jit.
 
 from __future__ import annotations
 
+import dataclasses
 
 import jax
 import jax.numpy as jnp
@@ -20,6 +21,36 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from triton_dist_tpu.utils import default_interpret
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class PackedGatedWeights:
+    """The [E, H, 2F] interleaved gate‖up layout from ``pack_gated_weights``
+    together with the ``block_n`` it was packed with. The interleave is
+    invisible in the array's shape, so a bare array cannot be validated by
+    the consumer — carrying the pack width in the type is what closes that
+    contract: ``grouped_gemm_gated(packed=True)`` and
+    ``moe_mlp_ep_overlap`` reject a width mismatch instead of silently
+    computing garbage. ``block_n`` is pytree aux data (static under jit)."""
+
+    w: jax.Array
+    block_n: int
+
+    def tree_flatten(self):
+        return (self.w,), self.block_n
+
+    @classmethod
+    def tree_unflatten(cls, block_n, children):
+        return cls(children[0], block_n)
+
+    @property
+    def shape(self):
+        return self.w.shape
+
+    @property
+    def dtype(self):
+        return self.w.dtype
 
 
 def align_tokens_by_expert(ids: jax.Array, num_experts: int, block_m: int,
@@ -386,28 +417,31 @@ def grouped_gemm(tokens: jax.Array, weights: jax.Array,
 
 
 def pack_gated_weights(w_gate: jax.Array, w_up: jax.Array,
-                       block_n: int = 128) -> jax.Array:
+                       block_n: int = 128) -> PackedGatedWeights:
     """Interleave gate and up weights into ONE [E, H, 2F] array whose
     column groups alternate [g_j ‖ u_j] per ``block_n``-wide tile — the
     layout ``grouped_gemm_gated(packed=True)`` consumes. Two separate
     weight streams (one DMA sequence per projection) measured ~545 GB/s
     on v5e vs the dense GEMM's ~740; packing merges them into one
     double-width tile stream. Pack ONCE at weight-load time (serving
-    weights are static); ``block_n`` must match the kernel's."""
-    import math
+    weights are static).
 
+    Returns a ``PackedGatedWeights`` wrapper carrying ``block_n`` so the
+    consumer can verify the pack width instead of trusting the caller to
+    thread the same value to both sides."""
     E, H, F = w_gate.shape
     assert w_up.shape == (E, H, F), (w_up.shape, w_gate.shape)
-    # STRICT: no silent re-tiling — the consumer kernel cannot detect a
-    # pack-width mismatch (the interleave is invisible in the shape), so
-    # the only safe contract is "both sides pass the identical block_n"
+    # STRICT: no silent re-tiling — the interleave is invisible in the
+    # shape, so the pack width must be carried alongside the array (the
+    # wrapper) and re-checked by the consumer
     assert F % block_n == 0, (
         f"pack_gated_weights: block_n={block_n} must divide F={F} exactly "
         "(and must equal the block_n passed to grouped_gemm_gated)")
     bn = block_n
     g = w_gate.reshape(E, H, F // bn, 1, bn)
     u = w_up.reshape(E, H, F // bn, 1, bn)
-    return jnp.concatenate([g, u], axis=3).reshape(E, H, 2 * F)
+    return PackedGatedWeights(
+        jnp.concatenate([g, u], axis=3).reshape(E, H, 2 * F), block_n)
 
 
 def grouped_gemm_gated(tokens: jax.Array, w_gate: jax.Array,
@@ -419,7 +453,8 @@ def grouped_gemm_gated(tokens: jax.Array, w_gate: jax.Array,
                        activation=jax.nn.silu,
                        masked: bool = True,
                        block_k: int | None = None,
-                       packed: bool = False) -> jax.Array:
+                       packed: bool = False,
+                       prefetch_depth: int = 2) -> jax.Array:
     """Fused gated grouped GEMM: ``out = act(x @ wg[e]) * (x @ wu[e])`` per
     expert-aligned row block — the gate and up projections of the MoE FFN in
     ONE kernel. Each x-tile is read from HBM once and contracted against
@@ -437,12 +472,23 @@ def grouped_gemm_gated(tokens: jax.Array, w_gate: jax.Array,
     ``masked=False`` leaves rows past the bound undefined (see
     ``grouped_gemm``).
 
-    ``packed=True``: ``w_gate`` is the [E, H, 2F] interleaved layout from
+    ``packed=True``: ``w_gate`` is the ``PackedGatedWeights`` wrapper from
     ``pack_gated_weights(..., block_n)`` (``w_up`` must be None) — gate
     and up tiles ride ONE double-width DMA stream instead of two
     interleaved sequences (the measured ~545 GB/s two-stream rate vs the
-    dense GEMM's ~740 is the gap this targets). Bounded path only, and
-    ``block_n`` must match the packing."""
+    dense GEMM's ~740 is the gap this targets). Bounded path only; the
+    wrapper's pack width is VERIFIED against ``block_n`` (a bare [E, H,
+    2F] array is still accepted for internal callers, where divisibility
+    is the only possible check).
+
+    ``prefetch_depth`` (packed path): number of weight tiles kept in
+    flight by the kernel's own multi-buffered DMA stream. Depth ≥ 2
+    replaces the emit_pipeline weight stream with explicit
+    ``make_async_copy`` lookahead that crosses expert-block boundaries
+    without re-priming (the grouped dynamic-expert index_map is what
+    keeps the generic pipeline's prefetch shallow — measured ~545 GB/s vs
+    the dense GEMM's ~740). Depth is clamped to the VMEM budget; 1 (or a
+    non-packed layout) falls back to the emit_pipeline stream."""
     import math
 
     P, H = tokens.shape
@@ -450,16 +496,20 @@ def grouped_gemm_gated(tokens: jax.Array, w_gate: jax.Array,
         assert w_up is None, "packed layout carries gate AND up in w_gate"
         assert n_blocks_used is not None, (
             "packed gated GEMM is implemented on the bounded path only")
+        if isinstance(w_gate, PackedGatedWeights):
+            assert w_gate.block_n == block_n, (
+                f"PackedGatedWeights packed with block_n={w_gate.block_n} "
+                f"but the kernel was asked for block_n={block_n} — the "
+                "interleave would silently mix gate and up columns")
+            w_gate = w_gate.w
         E, H2, F2 = w_gate.shape
         assert F2 % 2 == 0, F2
         F = F2 // 2
         assert F % block_n == 0, (
             f"block_n={block_n} must divide F={F}")
-        # NOTE: divisibility is necessary but NOT sufficient — the
-        # interleave is invisible in the shape, so the kernel cannot
-        # verify the array was packed with THIS block_n. The contract is
-        # the caller passes the same value to pack_gated_weights (which
-        # rejects non-divisors rather than silently re-tiling).
+        # Divisibility is necessary but NOT sufficient for a bare array —
+        # prefer passing the PackedGatedWeights wrapper, which carries
+        # the actual pack width and is verified above.
     else:
         E, H2, F = w_gate.shape
         assert w_up.shape == (E, H2, F), (w_up.shape, w_gate.shape)
@@ -528,6 +578,20 @@ def grouped_gemm_gated(tokens: jax.Array, w_gate: jax.Array,
                     and F // block_n > 1)
     cdtype = w_gate.dtype
     n_w = 1 if packed else 2
+    # Deep weight-stream prefetch (packed layout only): keep ``depth``
+    # double-width weight tiles in flight via an explicit DMA ring instead
+    # of emit_pipeline's single-step lookahead. The ring is clamped so it
+    # plus the pipelined x strips stays under the scoped-VMEM budget; if
+    # even 2 tiles don't fit, fall back to the emit_pipeline stream.
+    bk_w = block_k if ksplit else H
+    _w_tile_bytes = bk_w * 2 * block_n * jnp.dtype(w_gate.dtype).itemsize
+    deep_depth = 0
+    if packed and prefetch_depth is not None and prefetch_depth >= 2:
+        _budget = 9 * 1024 * 1024
+        deep_depth = min(int(prefetch_depth), _budget // _w_tile_bytes)
+    deep = deep_depth >= 2
+    if not deep:
+        deep_depth = 0
 
     def split_w(w_blks):
         """(gate tile, up tile) from the weight block(s) — packed layout
@@ -538,12 +602,17 @@ def grouped_gemm_gated(tokens: jax.Array, w_gate: jax.Array,
         return w_blks[0][0], w_blks[1][0]
 
     def kernel(be_ref, nb_ref, *refs):
-        n_scr = (1 if convert_once else 0) + (2 if ksplit else 0)
+        n_scr = ((1 if convert_once else 0) + (2 if ksplit else 0)
+                 + (2 if deep else 0))
         scratch = refs[len(refs) - n_scr:] if n_scr else ()
         refs = refs[:len(refs) - n_scr]
         xcv = scratch[0] if convert_once else None
-        acc_g, acc_u = (scratch[-2], scratch[-1]) if ksplit else (None,
-                                                                  None)
+        w_buf, w_sem = (scratch[-2], scratch[-1]) if deep else (None, None)
+        if ksplit:
+            acc_g, acc_u = ((scratch[-4], scratch[-3]) if deep
+                            else (scratch[-2], scratch[-1]))
+        else:
+            acc_g = acc_u = None
         o_ref = refs[-1]
         t_ref = refs[0]
         w_refs = refs[1:1 + n_w]
@@ -551,13 +620,54 @@ def grouped_gemm_gated(tokens: jax.Array, w_gate: jax.Array,
         m_steps = jnp.minimum(nb_ref[0], P // block_m)
         sc_args = (sc_ref,) if sc_ref is not None else ()
 
+        # --- deep mode: explicit multi-buffered weight DMA ring.
+        # Flat step s walks the SAME (m, n[, k]) order as the pipeline
+        # grid; the copy for step s+depth-1 is issued at the TOP of step
+        # s (the guide's double-buffer shape generalized to depth): the
+        # slot it overwrites was last read at step s-1, already consumed.
+        # The dynamic-expert lookup ``be_ref[i]`` happens at ISSUE time,
+        # so the ring keeps streaming across expert-block boundaries —
+        # the re-priming that capped the two-stream rate at ~545 GB/s.
+        nn_steps = F // block_n
+        nk_steps = (H // block_k) if ksplit else 1
+
+        def w_dma(s):
+            i = s // (nn_steps * nk_steps)
+            r = s % (nn_steps * nk_steps)
+            j = r // nk_steps
+            kk = r % nk_steps
+            slot = s % deep_depth
+            src = w_refs[0].at[be_ref[i], pl.ds(kk * bk_w, bk_w),
+                               pl.ds(j * 2 * block_n, 2 * block_n)]
+            return pltpu.make_async_copy(src, w_buf.at[slot],
+                                         w_sem.at[slot])
+
+        def w_stream(s, n_steps):
+            """Warm the ring at step 0, issue the lookahead copy, wait
+            for this step's tile; returns the resident (bk_w, 2bn)
+            tile."""
+            @pl.when(s == 0)
+            def _():
+                for d in range(deep_depth - 1):
+                    @pl.when(d < n_steps)
+                    def _(d=d):
+                        w_dma(d).start()
+
+            @pl.when(s + deep_depth - 1 < n_steps)
+            def _():
+                w_dma(s + deep_depth - 1).start()
+
+            w_dma(s).wait()
+            return w_buf[s % deep_depth]
+
         if ksplit:
             nk = H // block_k
+            n_wp = 0 if deep else n_w
 
             def body_acc(t_blk, *rest):
                 o_blk = rest[-1]
-                w_blks = rest[:n_w]
-                sc_row = rest[n_w][0] if sc_ref is not None else None
+                w_blks = rest[:n_wp]
+                sc_row = rest[n_wp][0] if sc_ref is not None else None
                 k = pl.program_id(2)
                 if convert_once:
                     j = pl.program_id(1)
@@ -569,7 +679,14 @@ def grouped_gemm_gated(tokens: jax.Array, w_gate: jax.Array,
                     x_use = xcv[k, :, :]
                 else:
                     x_use = t_blk[...]
-                wg_t, wu_t = split_w(w_blks)
+                if deep:
+                    i = pl.program_id(0)
+                    j2 = pl.program_id(1)
+                    s = (i * nn_steps + j2) * nk_steps + k
+                    wtile = w_stream(s, m_steps * nn_steps * nk_steps)
+                    wg_t, wu_t = wtile[:, :block_n], wtile[:, block_n:]
+                else:
+                    wg_t, wu_t = split_w(w_blks)
                 g = jnp.dot(x_use, wg_t,
                             preferred_element_type=jnp.float32)
                 u = jnp.dot(x_use, wu_t,
@@ -593,11 +710,13 @@ def grouped_gemm_gated(tokens: jax.Array, w_gate: jax.Array,
             sc_specs = ([pl.BlockSpec((1, block_m),
                                       lambda i, j, k: (i, 0))]
                         if sc_ref is not None else [])
-            w_specs = ([pl.BlockSpec((1, block_k, 2 * block_n),
-                                     lambda i, j, k: (be_ref[i], k, j))]
-                       if packed else
-                       [pl.BlockSpec((1, block_k, block_n),
-                                     lambda i, j, k: (be_ref[i], k, j))] * 2)
+            w_specs = ([] if deep else
+                       ([pl.BlockSpec((1, block_k, 2 * block_n),
+                                      lambda i, j, k: (be_ref[i], k, j))]
+                        if packed else
+                        [pl.BlockSpec((1, block_k, block_n),
+                                      lambda i, j, k: (be_ref[i], k, j))]
+                        * 2))
             pltpu.emit_pipeline(
                 body_acc,
                 grid=(m_steps, F // block_n, nk),
@@ -607,13 +726,15 @@ def grouped_gemm_gated(tokens: jax.Array, w_gate: jax.Array,
                 ] + w_specs + sc_specs,
                 out_specs=[pl.BlockSpec((block_m, block_n),
                                         lambda i, j, k: (i, j))],
-            )(t_ref, *w_refs, *sc_args, o_ref)
+            )(t_ref, *(() if deep else tuple(w_refs)), *sc_args, o_ref)
             return
+
+        n_wp = 0 if deep else n_w
 
         def body(t_blk, *rest):
             o_blk = rest[-1]
-            w_blks = rest[:n_w]
-            sc_row = rest[n_w][0] if sc_ref is not None else None
+            w_blks = rest[:n_wp]
+            sc_row = rest[n_wp][0] if sc_ref is not None else None
             if convert_once:
                 j = pl.program_id(1)
 
@@ -624,18 +745,26 @@ def grouped_gemm_gated(tokens: jax.Array, w_gate: jax.Array,
                 x_use = xcv[...]
             else:
                 x_use = t_blk[...]
-            wg_t, wu_t = split_w(w_blks)
+            if deep:
+                i = pl.program_id(0)
+                j2 = pl.program_id(1)
+                s = i * nn_steps + j2
+                wtile = w_stream(s, m_steps * nn_steps)
+                wg_t, wu_t = wtile[:, :block_n], wtile[:, block_n:]
+            else:
+                wg_t, wu_t = split_w(w_blks)
             g = jnp.dot(x_use, wg_t, preferred_element_type=jnp.float32)
             u = jnp.dot(x_use, wu_t, preferred_element_type=jnp.float32)
             o_blk[...] = _gated_math(g, u, sc_row, out_dtype, activation)
 
         sc_specs = ([pl.BlockSpec((1, block_m), lambda i, j: (i, 0))]
                     if sc_ref is not None else [])
-        w_specs = ([pl.BlockSpec((1, H, 2 * block_n),
-                                 lambda i, j: (be_ref[i], 0, j))]
-                   if packed else
-                   [pl.BlockSpec((1, H, block_n),
-                                 lambda i, j: (be_ref[i], 0, j))] * 2)
+        w_specs = ([] if deep else
+                   ([pl.BlockSpec((1, H, 2 * block_n),
+                                  lambda i, j: (be_ref[i], 0, j))]
+                    if packed else
+                    [pl.BlockSpec((1, H, block_n),
+                                  lambda i, j: (be_ref[i], 0, j))] * 2))
         pltpu.emit_pipeline(
             body,
             grid=(m_steps, F // block_n),
@@ -644,7 +773,7 @@ def grouped_gemm_gated(tokens: jax.Array, w_gate: jax.Array,
             ] + w_specs + sc_specs,
             out_specs=[pl.BlockSpec((block_m, block_n),
                                     lambda i, j: (i, j))],
-        )(t_ref, *w_refs, *sc_args, o_ref)
+        )(t_ref, *(() if deep else tuple(w_refs)), *sc_args, o_ref)
 
     w_args = (w_gate,) if packed else (w_gate, w_up)
     out = pl.pallas_call(
@@ -660,7 +789,10 @@ def grouped_gemm_gated(tokens: jax.Array, w_gate: jax.Array,
                           else (block_m, H)), cdtype)]
              if convert_once else [])
             + ([pltpu.VMEM((block_m, block_n), jnp.float32)] * 2
-               if ksplit else [])),
+               if ksplit else [])
+            + ([pltpu.VMEM((deep_depth, bk_w, 2 * block_n), w_gate.dtype),
+                pltpu.SemaphoreType.DMA((deep_depth,))]
+               if deep else [])),
         out_shape=jax.ShapeDtypeStruct((P, F), out_dtype),
         cost_estimate=cost,
         interpret=default_interpret(),
@@ -741,4 +873,4 @@ def moe_ffn_local(tokens: jax.Array, ids: jax.Array, w_up: jax.Array,
 
 __all__ = ["align_tokens_by_expert", "used_block_count", "emit_grouped_gemm",
            "grouped_gemm", "grouped_gemm_gated", "pack_gated_weights",
-           "apply_grouped", "moe_ffn_local"]
+           "PackedGatedWeights", "apply_grouped", "moe_ffn_local"]

@@ -32,6 +32,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _DEFAULT_CONTEXT: "ShmemContext | None" = None
 
 
+def _distributed_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` with a fallback for jax
+    versions that predate the public accessor (e.g. 0.4.37 exposes only
+    ``initialize``/``shutdown``): the coordination-service client on the
+    private global state is None exactly until ``initialize`` succeeds."""
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        return bool(is_init())
+    try:
+        from jax._src import distributed as _dist
+        return getattr(_dist.global_state, "client", None) is not None
+    except Exception:
+        return False
+
+
 def initialize_distributed(axis_names: Sequence[str] = ("x",),
                            mesh_shape: Sequence[int] | None = None,
                            seed: int = 42) -> "ShmemContext":
@@ -52,7 +67,7 @@ def initialize_distributed(axis_names: Sequence[str] = ("x",),
         "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
         "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_ID",
     ))
-    if multihost_env and not jax.distributed.is_initialized():
+    if multihost_env and not _distributed_initialized():
         # jax auto-detects only managed clusters (Slurm/MPI/GKE-TPU);
         # the explicit JAX_NUM_PROCESSES/JAX_PROCESS_ID spelling that
         # scripts/launch.sh documents for ad-hoc pods must be forwarded by
@@ -78,7 +93,7 @@ def initialize_distributed(axis_names: Sequence[str] = ("x",),
                          f"only {devices.size} available")
     if (n_mesh == devices.size and n_mesh > 1
             and devices[0].platform == "cpu"
-            and not jax.distributed.is_initialized()
+            and not _distributed_initialized()
             and os.environ.get("TDT_NO_CPU_SPARES") != "1"):
         # (n_mesh > 1: a single-device mesh has no cross-device waits to
         # deadlock — don't churn the backend for it.)
@@ -232,6 +247,13 @@ class ShmemContext:
         """SPMD-launch ``f`` over the mesh — the analog of "one process per
         GPU running this kernel" in the reference's torchrun model. Pallas
         kernels with manual DMA/semaphores do not carry varying-manual-axes
-        info, hence ``check_vma=False``."""
-        return jax.shard_map(f, mesh=self.mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
+        info, hence ``check_vma=False`` (spelled ``check_rep`` on jax
+        versions that predate the public ``jax.shard_map``, e.g. 0.4.x —
+        same knob, renamed when the API was promoted)."""
+        sm = getattr(jax, "shard_map", None)
+        if sm is not None:
+            return sm(f, mesh=self.mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=False)
+        from jax.experimental.shard_map import shard_map as sm_exp
+        return sm_exp(f, mesh=self.mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)

@@ -15,6 +15,30 @@ from functools import lru_cache
 import jax
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):
+    # jax 0.4.x spells this TPUCompilerParams and its dataclass predates
+    # some fields (notably ``has_side_effects``). Alias it with a kwarg
+    # filter: unknown fields are dropped rather than erroring, which is
+    # sound everywhere this repo runs 0.4.x (CPU interpret mode executes
+    # kernels unconditionally; side-effect marking only guards compiled
+    # DCE). Installed once here — every module imports utils before
+    # touching pltpu.
+    import dataclasses as _dc
+
+    _TPU_CP = pltpu.TPUCompilerParams
+    _CP_FIELDS = {f.name for f in _dc.fields(_TPU_CP)}
+
+    def _compat_compiler_params(**kw):
+        return _TPU_CP(**{k: v for k, v in kw.items() if k in _CP_FIELDS})
+
+    pltpu.CompilerParams = _compat_compiler_params
+
+if not hasattr(jax.lax, "axis_size"):
+    # jax 0.4.x predates ``lax.axis_size``. ``psum`` of a concrete 1 over a
+    # named axis constant-folds to the axis size as a Python int, which is
+    # exactly the new API's behavior (callers use it as a loop bound).
+    jax.lax.axis_size = lambda name: jax.lax.psum(1, name)
+
 
 def _probe_default_backend(timeout_s: float = 45.0) -> int | None:
     """Device count of the DEFAULT backend, probed in a subprocess with a
@@ -105,17 +129,30 @@ def on_tpu() -> bool:
     return ("tpu" in p) or (p == "axon")
 
 
-def interpret_params(**kw) -> "pltpu.InterpretParams":
+def interpret_params(**kw):
     """TPU-interpret-mode params used when running on CPU devices.
 
     ``dma_execution_mode='on_wait'`` preserves the async-DMA/semaphore
     semantics closely enough to catch missing waits; set
     ``TDT_DETECT_RACES=1`` to enable the interpreter's race detector
     (the reference's analog is sleep-noise fuzzing, allgather.py:72-76).
-    """
+
+    jax versions that predate the TPU interpreter's params class (the
+    0.4.x line exposes neither ``InterpretParams`` nor its older
+    ``TPUInterpretParams`` spelling) fall back to plain ``True``: the
+    generic Pallas interpreter there executes local DMAs, semaphores and
+    (with the generation shim below) ``emit_pipeline``, which is the
+    surface the test suite needs."""
     if os.environ.get("TDT_DETECT_RACES") == "1":
         kw.setdefault("detect_races", True)
-    return pltpu.InterpretParams(**kw)
+    ip = (getattr(pltpu, "InterpretParams", None)
+          or getattr(pltpu, "TPUInterpretParams", None))
+    if ip is None:
+        return True
+    try:
+        return ip(**kw)
+    except TypeError:
+        return ip()
 
 
 @lru_cache(None)
@@ -157,6 +194,32 @@ def _register_cpu_tpu_info():
         #       jax's own "Unsupported TPU device kind" message
 
 
+@lru_cache(None)
+def _patch_pipeline_tpu_generation():
+    """Older jax (0.4.x) has no ``tpu_info`` registry; its pipeline helper
+    reads the TPU generation straight off ``device_kind`` and asserts on
+    anything that isn't a chip. Shim it to report a v5-class generation
+    when the live devices are CPUs so ``emit_pipeline`` works under the
+    generic interpreter (the generation only picks a DMA sublane tiling
+    constant — any supported value is semantically correct in
+    interpret mode)."""
+    try:
+        from jax._src.pallas.mosaic import pipeline as _mp
+    except ImportError:
+        return
+    orig = getattr(_mp, "_get_tpu_generation", None)
+    if orig is None:
+        return
+
+    def _gen():
+        try:
+            return orig()
+        except Exception:
+            return 5
+
+    _mp._get_tpu_generation = _gen
+
+
 def default_interpret():
     """What to pass as ``pallas_call(interpret=...)`` on this backend.
 
@@ -168,5 +231,6 @@ def default_interpret():
         return False
     if on_cpu():
         _register_cpu_tpu_info()
+        _patch_pipeline_tpu_generation()
         return interpret_params()
     return False
