@@ -912,6 +912,86 @@ def bench_decode(ctx, i1: int, i2: int, B: int = 1, Hq: int = 32,
     return res
 
 
+def bench_serving(ctx, i1: int, i2: int, B: int = 1, Hq: int = 32,
+                  Hkv: int = 8, D: int = 128, S: int = 4096,
+                  page_size: int = 128, num_slots: int = 4,
+                  n_layers: int = 2) -> dict:
+    """Serving-runtime extras (ISSUE 2 satellite: the paged step must sit
+    within ~10% of the contiguous rows at equal batch):
+
+    - ``serving_decode_step_us``: the jitted ``gqa_decode_paged`` attention
+      step at the SAME (B, Hq, Hkv, D, S) as ``bench_decode``'s contiguous
+      ``decode_push_us``/``decode_fused_us`` rows — the apples-to-apples
+      parity target (same bytes streamed; the block table is the only
+      extra traffic).
+    - ``serving_tok_per_s``: whole-model throughput of the jitted
+      ``decode_step_paged`` at batch = ``num_slots`` on a small config —
+      the engine's one-compiled-step-per-token hot loop, timed as a
+      data-dependent argmax chain (each step consumes the token the
+      previous step produced, exactly like ``ServingEngine.step``).
+
+    Knobs mirror ``scripts/serve_sim.py`` (--slots/--page-size/--layers).
+    """
+    from triton_dist_tpu.models.llama import (LlamaConfig, decode_step_paged,
+                                              init_page_pool, init_params)
+    from triton_dist_tpu.ops.flash_decode import gqa_decode_paged
+
+    out = {}
+    # 1. paged attention step at the contiguous-bench shape -----------------
+    n_pages = S // page_size
+    q = jax.random.normal(jax.random.key(0), (B, Hq, D), jnp.float32
+                          ).astype(jnp.bfloat16)
+    kp = jax.random.normal(jax.random.key(1), (n_pages, Hkv, page_size, D),
+                           jnp.float32).astype(jnp.bfloat16)
+    vp = jax.random.normal(jax.random.key(2), (n_pages, Hkv, page_size, D),
+                           jnp.float32).astype(jnp.bfloat16)
+    bt = jnp.tile(jnp.arange(n_pages, dtype=jnp.int32)[None], (B, 1))
+    kv = jnp.array([S] * B, jnp.int32)
+
+    def attn_step(qq, _):
+        o, _lse = gqa_decode_paged(qq, kp, vp, bt, kv)
+        return qq + (o * jnp.asarray(1e-20, o.dtype))
+
+    timer = make_chain_timer(attn_step, q, jnp.zeros((), jnp.bfloat16))
+    out["serving_decode_step_us"] = round(_per_iter(timer, i1, i2) * 1e6, 1)
+
+    # 2. full paged model step at batch = num_slots -------------------------
+    cfg = LlamaConfig.tiny(n_layers=n_layers)
+    params = init_params(jax.random.key(3), cfg)
+    pages_per_seq = -(-(i2 + 2) // page_size)
+    pool = init_page_pool(cfg, num_slots * pages_per_seq + 1, page_size)
+    bt2 = jnp.asarray(
+        1 + jnp.arange(num_slots * pages_per_seq, dtype=jnp.int32
+                       ).reshape(num_slots, pages_per_seq))
+    tok0 = jnp.zeros((num_slots,), jnp.int32)
+
+    cache = {}
+
+    def step_timer(iters: int):
+        if iters not in cache:
+            def chain(params, tok0, kp0, vp0, bt2):
+                def body(c, _):
+                    tok, pos, pages = c
+                    logits, pages = decode_step_paged(
+                        params, tok, pos, cfg, pages, bt2)
+                    tok = jnp.argmax(logits, -1).astype(jnp.int32)
+                    return (tok, pos + 1, pages), None
+                c0 = (tok0, jnp.zeros((num_slots,), jnp.int32),
+                      {"k": kp0, "v": vp0})
+                (tok, pos, _), _ = lax.scan(body, c0, None, length=iters)
+                return (jnp.sum(tok.astype(jnp.float32))
+                        + jnp.sum(pos.astype(jnp.float32)))
+            cache[iters] = jax.jit(chain)
+        return float(cache[iters](params, tok0, pool["k"], pool["v"], bt2))
+
+    step_s = _per_iter(step_timer, i1, i2)
+    out["serving_step_us"] = round(step_s * 1e6, 1)
+    out["serving_tok_per_s"] = round(num_slots / step_s, 1)
+    out["serving_knobs"] = {"num_slots": num_slots, "page_size": page_size,
+                            "n_layers": n_layers, "attn_B": B, "attn_S": S}
+    return out
+
+
 # --- EP-dispatch wire model (the DeepEP-comparison analog) -----------------
 #
 # The reference's headline 137 µs dispatch (README.md:55) is 32 H800 ranks,
@@ -1129,6 +1209,19 @@ def main(a2a_primary: bool = False):
         extras.update(bench_decode(ctx, i1=di1, i2=di2, **dec_shape))
 
     attempt("decode", _decode)
+
+    def _serving():
+        # paged-decode serving extras at the SAME attention shape as
+        # _decode's contiguous rows (the <=10% parity acceptance); the
+        # engine-step throughput row uses the single-device paged step, so
+        # it is scan-safe even on the CPU simulator (no shard_map inside)
+        ssh = (dict(S=256, Hq=8, Hkv=2, page_size=128, n_layers=1)
+               if on_cpu() else dict(S=4096 * len(jax.devices())
+                                     if len(jax.devices()) > 1 else 4096))
+        si1, si2 = (i1, i2) if on_cpu() else (10, 410)
+        extras.update(bench_serving(ctx, i1=si1, i2=si2, **ssh))
+
+    attempt("serving", _serving)
 
     def _attn():
         ash = dict(s_loc=256, Hq=4, Hkv=2) if on_cpu() else {}

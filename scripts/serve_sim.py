@@ -1,0 +1,80 @@
+"""Synthetic-trace replay through the continuous-batching serving engine
+(docs/serving.md). Generates a deterministic request trace (seeded prompt
+lengths / decode budgets / staggered arrivals), drives ``ServingEngine``
+to completion, and prints the metrics snapshot as ONE JSON line — the same
+counters/histograms bench.py's ``serving_*`` extras are built from, with
+matching knobs (--slots/--page-size/--layers mirror bench_serving's).
+
+    python scripts/serve_sim.py --sim 50
+    python scripts/serve_sim.py --sim 20 --slots 8 --pages 12  # preempts
+
+A deliberately small --pages forces preemption-by-eviction; the replay is
+bit-deterministic (same seed => same tokens, same metrics counters), which
+is also how tests/test_serving.py pins the trace down.
+"""
+import argparse
+import json
+import sys
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from triton_dist_tpu.models.llama import LlamaConfig, init_params  # noqa: E402
+from triton_dist_tpu.serving import ServingEngine  # noqa: E402
+
+p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+p.add_argument("--sim", type=int, default=50,
+               help="number of synthetic requests to replay")
+p.add_argument("--slots", type=int, default=4,
+               help="continuous-batching slots (engine batch rows)")
+p.add_argument("--page-size", type=int, default=8,
+               help="KV pool page size in tokens (multiple of 8)")
+p.add_argument("--pages", type=int, default=24,
+               help="usable KV pool pages (small => forced preemption)")
+p.add_argument("--pages-per-seq", type=int, default=8,
+               help="block-table width (max pages one request may own)")
+p.add_argument("--layers", type=int, default=2, help="model layers")
+p.add_argument("--max-new", type=int, default=12,
+               help="max decode budget per request (uniform 2..max-new)")
+p.add_argument("--arrive-every", type=int, default=2,
+               help="one new request submitted every N engine steps")
+p.add_argument("--seed", type=int, default=0, help="trace RNG seed")
+p.add_argument("--tokens", action="store_true",
+               help="also print one JSON line per finished request")
+args = p.parse_args()
+
+cfg = LlamaConfig.tiny(n_layers=args.layers)
+params = init_params(jax.random.PRNGKey(args.seed), cfg)
+eng = ServingEngine(params, cfg, num_slots=args.slots,
+                    page_size=args.page_size, num_pages=args.pages,
+                    pages_per_seq=args.pages_per_seq)
+
+rng = np.random.RandomState(args.seed)
+max_plen = min(args.pages_per_seq * args.page_size - args.max_new, 24)
+arrivals = []
+for i in range(args.sim):
+    plen = int(rng.randint(3, max(4, max_plen)))
+    mnt = int(rng.randint(2, max(3, args.max_new + 1)))
+    prompt = rng.randint(1, cfg.vocab_size, size=plen).tolist()
+    arrivals.append((i * args.arrive_every // max(args.arrive_every, 1),
+                     prompt, mnt))
+
+results = eng.run(max_steps=200_000, arrivals=arrivals)
+unfinished = [rid for rid, toks in results.items() if toks is None]
+if unfinished:
+    print(json.dumps({"error": "unfinished requests", "rids": unfinished}),
+          file=sys.stderr)
+    sys.exit(1)
+
+if args.tokens:
+    for req in sorted(eng._finished, key=lambda r: r.rid):
+        print(json.dumps({
+            "rid": req.rid, "prompt_len": len(req.prompt),
+            "tokens": list(req.generated),
+            "preemptions": req.preemptions,
+            "ttft_steps": req.first_token_step - req.submit_step,
+        }))
+eng.metrics.emit()

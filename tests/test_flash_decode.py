@@ -11,7 +11,8 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from conftest import TEST_WORLD
-from triton_dist_tpu.ops.flash_decode import (decode_combine,
+from triton_dist_tpu.ops.flash_decode import (NEG_INF, decode_combine,
+                                              gqa_decode_paged,
                                               gqa_decode_partial,
                                               sp_gqa_flash_decode)
 from triton_dist_tpu.shmem.context import initialize_distributed
@@ -75,6 +76,79 @@ def test_decode_combine_matches_monolithic():
     merged = jax.jit(decode_combine)(jnp.stack(outs), jnp.stack(lses))
     golden = _dense_golden(q, k, v, np.asarray(kv_len))
     assert_allclose(np.asarray(merged), golden, atol=1e-3, rtol=1e-3)
+
+
+def _paged_golden(q, k_pages, v_pages, block_table, kv_len):
+    """Dense paged golden: gather each row's live pages contiguously, then
+    plain softmax attention. Only pages [0, ceil(kv_len/ps)) are touched —
+    garbage block-table entries past that must not matter."""
+    q = np.asarray(q, np.float64)
+    kp = np.asarray(k_pages, np.float64)
+    vp = np.asarray(v_pages, np.float64)
+    bt = np.asarray(block_table)
+    B, Hq, D = q.shape
+    Hkv, ps = kp.shape[1], kp.shape[2]
+    G = Hq // Hkv
+    out = np.zeros((B, Hq, D))
+    for b in range(B):
+        L = int(kv_len[b])
+        if L == 0:
+            continue
+        n_pages = -(-L // ps)
+        k = np.concatenate([kp[p] for p in bt[b, :n_pages]], axis=1)[:, :L]
+        v = np.concatenate([vp[p] for p in bt[b, :n_pages]], axis=1)[:, :L]
+        for h in range(Hq):
+            kh = h // G
+            s = (k[kh] @ q[b, h]) / math.sqrt(D)
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            out[b, h] = p @ v[kh]
+    return out
+
+
+def test_paged_decode_garbage_block_table_entries():
+    """Block-table entries past ceil(kv_len/page_size) may be ARBITRARY —
+    even out-of-range page ids — without changing the result or faulting
+    (the index map clamps and never dereferences them)."""
+    B, Hq, Hkv, D, ps, pps, pool = 2, 4, 2, 64, 8, 6, 16
+    q = jax.random.normal(jax.random.key(0), (B, Hq, D), jnp.float32)
+    kp = jax.random.normal(jax.random.key(1), (pool, Hkv, ps, D), jnp.float32)
+    vp = jax.random.normal(jax.random.key(2), (pool, Hkv, ps, D), jnp.float32)
+    kv_len = jnp.array([2 * ps + 3, ps], jnp.int32)   # 3 and 1 live pages
+    bt_clean = np.array([[3, 7, 1, 0, 0, 0],
+                         [5, 0, 0, 0, 0, 0]], np.int32)
+    out_c, lse_c = jax.jit(gqa_decode_paged)(q, kp, vp,
+                                             jnp.asarray(bt_clean), kv_len)
+    # poison every dead entry with garbage incl. ids far outside the pool
+    bt_dirty = bt_clean.copy()
+    bt_dirty[0, 3:] = [10 ** 6, -5, 2 ** 31 - 1]
+    bt_dirty[1, 1:] = [-(2 ** 31), 999999, -1, 888, pool]
+    out_d, lse_d = jax.jit(gqa_decode_paged)(q, kp, vp,
+                                             jnp.asarray(bt_dirty), kv_len)
+    np.testing.assert_array_equal(np.asarray(out_c), np.asarray(out_d))
+    np.testing.assert_array_equal(np.asarray(lse_c), np.asarray(lse_d))
+    golden = _paged_golden(q, kp, vp, bt_clean, np.asarray(kv_len))
+    assert_allclose(np.asarray(out_d), golden, atol=1e-3, rtol=1e-3)
+
+
+def test_paged_decode_kv_len_zero():
+    """kv_len == 0 rows return zeros with lse = NEG_INF (the empty-shard
+    convention the SP combine honors); live rows in the same batch are
+    unaffected. The zero row's block table is all garbage on purpose."""
+    B, Hq, Hkv, D, ps, pps, pool = 2, 4, 2, 64, 8, 4, 8
+    q = jax.random.normal(jax.random.key(0), (B, Hq, D), jnp.float32)
+    kp = jax.random.normal(jax.random.key(1), (pool, Hkv, ps, D), jnp.float32)
+    vp = jax.random.normal(jax.random.key(2), (pool, Hkv, ps, D), jnp.float32)
+    bt = jnp.asarray(np.array([[-7, 10 ** 8, -1, 4096],
+                               [2, 6, 0, 0]], np.int32))
+    kv_len = jnp.array([0, 2 * ps + 1], jnp.int32)
+    out, lse = jax.jit(gqa_decode_paged)(q, kp, vp, bt, kv_len)
+    out, lse = np.asarray(out), np.asarray(lse)
+    np.testing.assert_array_equal(out[0], np.zeros_like(out[0]))
+    np.testing.assert_array_equal(lse[0], np.full_like(lse[0], NEG_INF))
+    golden = _paged_golden(q, kp, vp, np.asarray(bt), np.asarray(kv_len))
+    assert_allclose(out[1], golden[1], atol=1e-3, rtol=1e-3)
+    assert np.all(lse[1, :, 0] > -1e29)
 
 
 @pytest.mark.parametrize("ag_method", ["push", "fused"])

@@ -1,6 +1,6 @@
 from triton_dist_tpu.layers.allgather_layer import AllGatherLayer  # noqa: F401
 from triton_dist_tpu.layers.ep_a2a_layer import EPAll2AllLayer  # noqa: F401
 from triton_dist_tpu.layers.sp_flash_decode_layer import (  # noqa: F401
-    SpGQAFlashDecodeAttention)
+    PagedGQADecodeAttention, SpGQAFlashDecodeAttention)
 from triton_dist_tpu.layers.tp_linear import (  # noqa: F401
     ColumnParallelLinear, RowParallelLinear)

@@ -18,7 +18,8 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-from triton_dist_tpu.ops.flash_decode import sp_gqa_flash_decode
+from triton_dist_tpu.ops.flash_decode import (gqa_decode_paged,
+                                              sp_gqa_flash_decode)
 from triton_dist_tpu.shmem.context import ShmemContext
 
 
@@ -73,3 +74,49 @@ class SpGQAFlashDecodeAttention:
             [global_kv_lens,
              jnp.ones((mb - B,), global_kv_lens.dtype)])
         return self._fwd(q_pad, k_cache, v_cache, lens_pad)[:B]
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedGQADecodeAttention:
+    """Paged twin of :class:`SpGQAFlashDecodeAttention` — the serving-side
+    module over ``ops.flash_decode.gqa_decode_paged`` and the page pool the
+    serving runtime allocates (``serving.kv_pool.KVPagePool``).
+
+    Where the SP layer owns a growable AG buffer, the paged layer owns
+    nothing: the POOL is the growable buffer (pages, not rows), shared by
+    every sequence, so batch membership changes without touching device
+    memory — the block table is the only thing that moves. One jitted
+    forward serves every step: q [B, Hq, D], block_table [B, pages_per_seq]
+    and kv_len [B] are fixed shapes in a slot-based serving loop
+    (``serving.engine.ServingEngine``), and inactive rows ride along masked
+    (kv_len's mask means a parked row costs one page of compute).
+    """
+    num_q_heads: int
+    num_kv_heads: int
+    head_dim: int
+    page_size: int = 16
+    sm_scale: float | None = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "_fwd", jax.jit(
+            lambda q, kp, vp, bt, lens: gqa_decode_paged(
+                q, kp, vp, bt, lens, sm_scale=self.sm_scale)))
+
+    def __call__(self, q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                 block_table: jax.Array, kv_len: jax.Array
+                 ) -> tuple[jax.Array, jax.Array]:
+        """q [B, Hq, D]; k/v_pages [P, Hkv, page_size, D] pool;
+        block_table [B, pages_per_seq] int32; kv_len [B] (0 allowed).
+        Returns (out [B, Hq, D], lse [B, Hq, 128] f32) — the same
+        (out, lse) contract the SP combine consumes, so a later SP-serving
+        layer can allgather-merge paged partials exactly like
+        ``sp_gqa_flash_decode`` merges contiguous ones."""
+        B, Hq, D = q.shape
+        assert Hq == self.num_q_heads and D == self.head_dim
+        assert k_pages.shape[1] == self.num_kv_heads, (
+            f"pool has {k_pages.shape[1]} kv heads, layer configured for "
+            f"{self.num_kv_heads}")
+        assert k_pages.shape[2] == self.page_size, (
+            f"pool page_size {k_pages.shape[2]} != layer page_size "
+            f"{self.page_size}")
+        return self._fwd(q, k_pages, v_pages, block_table, kv_len)

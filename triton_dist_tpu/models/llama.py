@@ -377,6 +377,93 @@ def decode_step(params: dict, token: jax.Array, pos: jax.Array,
     return logits, {"k": ks, "v": vs}
 
 
+def init_page_pool(cfg: LlamaConfig, num_pages: int, page_size: int) -> dict:
+    """Paged KV pool: per-layer page-major arrays [L, P, Hkv, page_size, D]
+    — each page is the tiling-aligned DMA slice ``gqa_decode_paged``
+    streams by block-table index. The serving runtime
+    (``triton_dist_tpu.serving``) owns page accounting; this is just the
+    device memory."""
+    Hkv, Dh = cfg.n_kv_heads, cfg.head_dim
+    assert page_size % 8 == 0, f"page_size {page_size} must be 8-aligned"
+    shape = (cfg.n_layers, num_pages, Hkv, page_size, Dh)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def decode_step_paged(params: dict, token: jax.Array, pos: jax.Array,
+                      cfg: LlamaConfig, pages: dict,
+                      block_table: jax.Array,
+                      ffn=None) -> tuple[jax.Array, dict]:
+    """One-token decode over the paged KV pool — the continuous-batching
+    twin of ``decode_step``. Differences that make it a serving hot loop:
+
+    - ``pos`` is PER-SLOT [B] int32 (every slot sits at its own depth —
+      arrivals and finishes never force a shared position), vs
+      ``decode_step``'s single scalar.
+    - the cache is the page pool from ``init_page_pool`` plus a
+      ``block_table`` [B, pages_per_seq] int32; the new (k, v) is
+      scattered into page ``bt[b, pos_b // page_size]`` row
+      ``pos_b % page_size`` and attention is ``gqa_decode_paged``.
+    - inactive slots are driven by pointing their block-table row at a
+      reserved scratch page (the serving engine reserves page 0): their
+      writes land there, their reads mask out, and the batch shape never
+      changes — one compiled step per token regardless of arrivals.
+
+    Returns (logits [B, V] f32, updated pages). ``ffn(h, p) -> [B, D]``
+    overrides the per-layer FFN exactly as in ``decode_step`` (MoE
+    serving plugs ``moe_mlp_ep_overlap`` here); with a custom ``ffn`` the
+    layer loop unrolls in Python for the same backend reasons."""
+    from triton_dist_tpu.ops.flash_decode import gqa_decode_paged
+
+    B = token.shape[0]
+    Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    page_size = pages["k"].shape[3]
+    x = params["embed"][token].astype(cfg.dtype)          # [B, D]
+    positions = pos[:, None].astype(jnp.int32)            # [B, 1]
+    rows = jnp.arange(B)
+    page = block_table[rows, pos // page_size]            # [B]
+    slot = pos % page_size                                # [B]
+    kv_len = (pos + 1).astype(jnp.int32)
+
+    def body(x, layer):
+        p, kp, vp = layer
+        h = rmsnorm(x, p["attn_norm"], cfg.norm_eps)
+        q = rope((h @ p["wq"]).reshape(B, 1, Hq, Dh), positions,
+                 cfg.rope_theta)[:, 0]                     # [B, Hq, Dh]
+        k = rope((h @ p["wk"]).reshape(B, 1, Hkv, Dh), positions,
+                 cfg.rope_theta)[:, 0]                     # [B, Hkv, Dh]
+        v = (h @ p["wv"]).reshape(B, 1, Hkv, Dh)[:, 0]
+        # per-slot scatter: advanced indices (page, slot) around the head
+        # slice put the batch dim in front — [B, Hkv, Dh] rows
+        kp = kp.at[page, :, slot].set(k)
+        vp = vp.at[page, :, slot].set(v)
+        attn, _lse = gqa_decode_paged(q, kp, vp, block_table, kv_len)
+        x = x + attn.reshape(B, Hq * Dh) @ p["wo"]
+        h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps)
+        if ffn is None:
+            ff = (jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)
+                              ).astype(h.dtype) * (h @ p["w_up"])
+                  ) @ p["w_down"]
+        else:
+            ff = ffn(h, p)
+        x = x + ff.astype(x.dtype)
+        return x, (kp, vp)
+
+    if ffn is None:
+        x, (ks, vs) = lax.scan(body, x, (params["blocks"], pages["k"],
+                                         pages["v"]))
+    else:
+        ks_l, vs_l = [], []
+        for i in range(cfg.n_layers):
+            p = jax.tree.map(lambda a, i=i: a[i], params["blocks"])
+            x, (kp, vp) = body(x, (p, pages["k"][i], pages["v"][i]))
+            ks_l.append(kp)
+            vs_l.append(vp)
+        ks, vs = jnp.stack(ks_l), jnp.stack(vs_l)
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": ks, "v": vs}
+
+
 def decode_step_sp(ctx, params: dict, token: jax.Array, pos: jax.Array,
                    cfg: LlamaConfig, cache: dict,
                    axis: str | None = None,
@@ -539,5 +626,5 @@ def forward_tp_overlap(ctx: ShmemContext, params: dict, tokens: jax.Array,
 
 __all__ = ["LlamaConfig", "init_params", "param_specs", "forward",
            "forward_tp_overlap", "mlp_tp_overlap", "rmsnorm", "rope",
-           "block_apply", "init_kv_cache", "prefill", "decode_step",
-           "generate"]
+           "block_apply", "init_kv_cache", "init_page_pool", "prefill",
+           "decode_step", "decode_step_paged", "generate"]

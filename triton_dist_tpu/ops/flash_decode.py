@@ -189,9 +189,18 @@ def gqa_decode_paged(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     ``ref_paged_attn`` golden, test_sp_decode_attn.py:81-134).
 
     q [B, Hq, D]; k_pages/v_pages [P, Hkv, page_size, D] (page-major pool);
-    block_table [B, pages_per_seq] int32 page ids (entries past
-    ceil(kv_len/page_size) may be arbitrary valid ids — masked out);
-    kv_len [B]. Returns (out [B, Hq, D], lse [B, Hq, 128] f32).
+    block_table [B, pages_per_seq] int32 page ids — entries past
+    ceil(kv_len/page_size) may be ARBITRARY values (even out of range):
+    the index map never dereferences them. kv_len [B] (0 allowed: the row
+    returns zeros with lse = NEG_INF, the "empty shard" convention the SP
+    combine already honors). Returns (out [B, Hq, D], lse [B, Hq, 128] f32).
+
+    Dead pages are free twice over: their grid steps revisit the LAST
+    valid page (same block index as the previous step ⇒ the pipeline
+    skips the HBM→VMEM DMA entirely — the causal-attention kv-clamp
+    trick, docs/benchmarks.md) and their compute is skipped by the
+    ``s * page_size < kv_len`` mask, so a short sequence in a long
+    ``pages_per_seq`` batch costs its own length, not the batch max.
     """
     B, Hq, D = q.shape
     P_pool, Hkv, page_size, _ = k_pages.shape
@@ -199,6 +208,15 @@ def gqa_decode_paged(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     assert page_size % 8 == 0, f"page_size {page_size} must be 8-aligned"
     pages_per_seq = block_table.shape[1]
     sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+
+    def page_index(b, s, kl, bt):
+        # last valid page for row b (0 when kv_len == 0 — any real page
+        # works, the compute mask kills its contribution); steps past it
+        # revisit it (DMA-free), and the clamp keeps even garbage block-
+        # table entries inside the pool so the DMA can never read OOB
+        last = jnp.maximum((kl[b] + page_size - 1) // page_size - 1, 0)
+        page = bt[b, jnp.minimum(s, last)]
+        return (jnp.clip(page, 0, P_pool - 1), 0, 0, 0)
 
     kernel = functools.partial(_decode_paged_kernel, block_s=page_size,
                                sm_scale=sm_scale, n_kv_heads=Hkv)
@@ -210,10 +228,8 @@ def gqa_decode_paged(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, Hq, D), lambda b, s, kl, bt: (b, 0, 0)),
-                pl.BlockSpec((1, Hkv, page_size, D),
-                             lambda b, s, kl, bt: (bt[b, s], 0, 0, 0)),
-                pl.BlockSpec((1, Hkv, page_size, D),
-                             lambda b, s, kl, bt: (bt[b, s], 0, 0, 0)),
+                pl.BlockSpec((1, Hkv, page_size, D), page_index),
+                pl.BlockSpec((1, Hkv, page_size, D), page_index),
             ],
             out_specs=[
                 pl.BlockSpec((1, Hq, D), lambda b, s, kl, bt: (b, 0, 0)),

@@ -1,0 +1,28 @@
+"""Continuous-batching serving runtime over the paged decode +
+EP/SP overlap ops (see docs/serving.md).
+
+- kv_pool    — paged KV page allocator + cache<->pages converters
+- scheduler  — FIFO admission / preemption policy over fixed batch slots
+- engine     — the jitted one-step-per-token decode engine
+- metrics    — counters + histograms, JSON-lines wire format
+"""
+
+from triton_dist_tpu.serving.engine import ServingEngine
+from triton_dist_tpu.serving.kv_pool import (KVPagePool, cache_to_pages,
+                                             page_pool_pspec, pages_to_cache)
+from triton_dist_tpu.serving.metrics import Histogram, ServingMetrics
+from triton_dist_tpu.serving.scheduler import (ContinuousBatchingScheduler,
+                                               Request, RequestState)
+
+__all__ = [
+    "ServingEngine",
+    "KVPagePool",
+    "page_pool_pspec",
+    "cache_to_pages",
+    "pages_to_cache",
+    "ContinuousBatchingScheduler",
+    "Request",
+    "RequestState",
+    "ServingMetrics",
+    "Histogram",
+]

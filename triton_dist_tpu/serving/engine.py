@@ -1,0 +1,272 @@
+"""The decode engine: drives ``models.llama.decode_step_paged`` under
+``jax.jit`` so the hot loop is ONE compiled step per token regardless of
+arrivals, finishes or preemptions.
+
+Shape discipline (the TPU contract):
+
+- the batch is ``num_slots`` fixed rows; a request occupies one slot from
+  admission to finish. Inactive rows are parked on the reserved scratch
+  page (page 0) with pos 0 — their writes land on scratch, their logits
+  are ignored, and the compiled step never sees a shape change.
+- the page pool rides the jitted step as a DONATED argument (on backends
+  that support donation), so the per-layer scatter of the new (k, v)
+  updates pages in place — no pool-sized copy per token.
+- prefill runs per request OUTSIDE the batch (shape-keyed by prompt
+  length) into a small contiguous cache — the layout the full-sequence
+  kernels want — then ``cache_to_pages`` hands the pages to the pool.
+  This is the prefill/decode interleave: admissions prefill between
+  decode steps, the decode batch itself never stalls on a long prompt.
+
+Determinism: greedy argmax decode + deterministic allocation and policies
+mean a request's tokens are a pure function of (params, prompt) — a
+preempted-and-restarted request regenerates exactly the tokens it lost,
+and a contended run is bit-identical per request to an uncontended one
+(tests/test_serving.py asserts both).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_dist_tpu.models.llama import (LlamaConfig, decode_step_paged,
+                                          init_kv_cache, init_page_pool,
+                                          prefill)
+from triton_dist_tpu.serving.kv_pool import KVPagePool, cache_to_pages
+from triton_dist_tpu.serving.metrics import ServingMetrics
+from triton_dist_tpu.serving.scheduler import (ContinuousBatchingScheduler,
+                                               Request)
+
+
+class ServingEngine:
+    """Continuous-batching serving engine over the paged decode step.
+
+    ``num_pages`` counts usable pages; one extra scratch page (id 0) is
+    allocated on top for inactive rows. ``pages_per_seq`` bounds one
+    sequence's pages (the block table width — a compiled-shape constant).
+    ``ffn(h, p) -> [B, D]`` plugs a custom per-layer FFN into the decode
+    step (e.g. ``moe_mlp_ep_overlap`` for the EP-MoE serving path, the
+    same hook ``decode_step``/``decode_step_sp`` expose).
+    """
+
+    def __init__(self, params: dict, cfg: LlamaConfig, num_slots: int = 4,
+                 page_size: int = 16, num_pages: int = 64,
+                 pages_per_seq: int = 8, ffn=None,
+                 max_prefills_per_step: int | None = None,
+                 metrics: ServingMetrics | None = None):
+        self.params = params
+        self.cfg = cfg
+        self.page_size = page_size
+        self.pages_per_seq = pages_per_seq
+        self.num_slots = num_slots
+        self.max_prefills_per_step = max_prefills_per_step
+        self.metrics = metrics or ServingMetrics()
+
+        self.pool = init_page_pool(cfg, num_pages + 1, page_size)
+        self.alloc = KVPagePool(num_pages + 1, page_size, reserved=1)
+        self.sched = ContinuousBatchingScheduler(num_slots)
+        self._next_rid = 0
+        self._steps = 0
+        self._finished: list[Request] = []
+
+        # host-side mirrors of the per-slot device inputs
+        self._token = np.zeros(num_slots, np.int32)
+        self._pos = np.zeros(num_slots, np.int32)
+        self._bt = np.zeros((num_slots, pages_per_seq), np.int32)
+
+        step = lambda p, t, pos, pages, bt: decode_step_paged(  # noqa: E731
+            p, t, pos, cfg, pages, bt, ffn=ffn)
+        if jax.default_backend() == "cpu":
+            self._step = jax.jit(step)      # CPU: donation unsupported
+        else:
+            self._step = jax.jit(step, donate_argnums=(3,))
+        self._prefill_jit = {}              # keyed by (prompt_len, cache_len)
+
+    # -- request intake ---------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int, rid: int | None = None
+               ) -> int:
+        prompt = tuple(int(t) for t in np.asarray(prompt).reshape(-1))
+        assert prompt and max_new_tokens >= 1
+        total = len(prompt) + max_new_tokens - 1   # KV the request will hold
+        need = -(-total // self.page_size)
+        assert need <= self.pages_per_seq, (
+            f"request needs {need} pages > pages_per_seq "
+            f"{self.pages_per_seq}")
+        assert need <= self.alloc.num_pages - self.alloc.reserved, (
+            f"request needs {need} pages > pool size — it could never run "
+            "even alone")
+        if rid is None:
+            rid = self._next_rid
+        self._next_rid = max(self._next_rid, rid + 1)
+        req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
+                      submit_step=self._steps,
+                      submit_time=time.perf_counter())
+        self.sched.submit(req)
+        self.metrics.inc("requests_submitted")
+        return rid
+
+    # -- prefill + admission ----------------------------------------------
+    def _prefill_fn(self, prompt_len: int, cache_len: int):
+        key = (prompt_len, cache_len)
+        if key not in self._prefill_jit:
+            cfg = self.cfg
+            self._prefill_jit[key] = jax.jit(
+                lambda p, t, c: prefill(p, t, cfg, c))
+        return self._prefill_jit[key]
+
+    def _admit(self, slot: int, req: Request) -> None:
+        sp = len(req.prompt)
+        n_pages = -(-sp // self.page_size)
+        pages = self.alloc.alloc(req.rid, n_pages)
+        assert pages is not None, "admissible() guaranteed the pages"
+        cache_len = n_pages * self.page_size
+        cache = init_kv_cache(self.cfg, 1, cache_len)
+        tokens = jnp.asarray(np.asarray(req.prompt, np.int32)[None])
+        logits, cache = self._prefill_fn(sp, cache_len)(
+            self.params, tokens, cache)
+        bt_row = jnp.asarray(np.asarray(pages, np.int32)[None])
+        self.pool = {
+            "k": cache_to_pages(cache["k"], self.pool["k"], bt_row),
+            "v": cache_to_pages(cache["v"], self.pool["v"], bt_row),
+        }
+        tok0 = int(np.argmax(np.asarray(logits[0])))
+        self.sched.activate(slot, req)
+        req.generated.append(tok0)
+        self.metrics.inc("prefills")
+        self.metrics.inc("tokens_generated")
+        if req.first_token_time is None:
+            req.first_token_step = self._steps
+            req.first_token_time = time.perf_counter()
+            self.metrics.observe("ttft_s",
+                                 req.first_token_time - req.submit_time)
+        self._token[slot] = tok0
+        self._pos[slot] = sp
+        row = self.alloc.block_table_row(req.rid, self.pages_per_seq)
+        self._bt[slot] = np.asarray(row, np.int32)
+        if req.done:                      # max_new_tokens == 1: no decode
+            self._finish(slot)
+
+    # -- slot teardown ----------------------------------------------------
+    def _finish(self, slot: int) -> None:
+        req = self.sched.finish(slot)
+        self.alloc.free_seq(req.rid)
+        req.finish_step = self._steps
+        self._park(slot)
+        self._finished.append(req)
+        self.metrics.inc("requests_finished")
+
+    def _preempt(self, slot: int) -> None:
+        req = self.sched.slots[slot]
+        self.alloc.free_seq(req.rid)
+        self.sched.evict(slot)
+        self._park(slot)
+        self.metrics.inc("preemptions")
+
+    def _park(self, slot: int) -> None:
+        """Point an empty slot at the scratch page: its row writes land on
+        page 0 (reserved — never a live sequence's), its reads mask out."""
+        self._token[slot] = 0
+        self._pos[slot] = 0
+        self._bt[slot] = 0
+
+    # -- one engine iteration ---------------------------------------------
+    def step(self) -> bool:
+        """Admissions (prefill) + one batched decode step. Returns False
+        when there is nothing to do (engine idle)."""
+        if self.sched.idle:
+            return False
+
+        def can_hold(req: Request) -> bool:
+            return self.alloc.free_pages >= -(-len(req.prompt)
+                                              // self.page_size)
+
+        admitted = 0
+        while (self.max_prefills_per_step is None
+               or admitted < self.max_prefills_per_step):
+            adm = self.sched.admissible(can_hold)
+            if adm is None:
+                break
+            self._admit(*adm)
+            admitted += 1
+
+        # allocate-on-decode growth, preempting (youngest first) when dry.
+        # Slot order is index order — deterministic.
+        for slot in range(self.num_slots):
+            req = self.sched.slots[slot]
+            if req is None:
+                continue
+            while not self.alloc.ensure(req.rid, int(self._pos[slot]) + 1):
+                victim = self.sched.pick_victim(exclude_slot=slot)
+                if victim is None:
+                    raise RuntimeError(
+                        f"KV pool too small: request {req.rid} needs a page "
+                        "with no preemptible peer left")
+                self._preempt(victim)
+            # refresh AFTER growth — the kernel writes this step's (k, v)
+            # at bt[slot, pos // page_size], which may be the page ensure()
+            # just allocated
+            self._bt[slot] = np.asarray(
+                self.alloc.block_table_row(req.rid, self.pages_per_seq),
+                np.int32)
+
+        active = self.sched.active
+        if not active:
+            return not self.sched.idle
+
+        t0 = time.perf_counter()
+        logits, self.pool = self._step(
+            self.params, jnp.asarray(self._token), jnp.asarray(self._pos),
+            self.pool, jnp.asarray(self._bt))
+        nxt = np.argmax(np.asarray(logits), axis=-1).astype(np.int32)
+        dt = time.perf_counter() - t0
+
+        self._steps += 1
+        self.metrics.inc("decode_steps")
+        self.metrics.observe("queue_depth", self.sched.queue_depth)
+        self.metrics.observe("pool_occupancy", self.alloc.occupancy())
+        self.metrics.observe("active_slots", len(active))
+        for slot, req in active:
+            req.generated.append(int(nxt[slot]))
+            self._token[slot] = nxt[slot]
+            self._pos[slot] += 1
+            self.metrics.inc("tokens_generated")
+            self.metrics.observe("tok_latency_s", dt)
+            if req.done:
+                self._finish(slot)
+        return True
+
+    def run(self, max_steps: int | None = None,
+            arrivals=None) -> dict[int, list[int]]:
+        """Drive ``step()`` until idle (or ``max_steps``). ``arrivals`` is
+        an optional iterable of (step_index, prompt, max_new_tokens)
+        sorted by step — the synthetic-trace replay hook serve_sim uses.
+        Returns {rid: generated tokens} for every finished request."""
+        pending = list(arrivals or [])
+        results: dict[int, list[int]] = {}
+        i = 0
+        while max_steps is None or i < max_steps:
+            while pending and pending[0][0] <= i:
+                _, prompt, mnt = pending.pop(0)
+                results_key = self.submit(prompt, mnt)
+                results[results_key] = None
+            if not self.step() and not pending:
+                break
+            i += 1
+        for req in self._all_requests():
+            if req.state.value == "finished":
+                results[req.rid] = list(req.generated)
+        return results
+
+    def _all_requests(self):
+        seen = {}
+        for r in (list(self.sched.queue)
+                  + [s for s in self.sched.slots if s is not None]
+                  + self._finished):
+            seen[r.rid] = r
+        return seen.values()
+
+
+__all__ = ["ServingEngine"]

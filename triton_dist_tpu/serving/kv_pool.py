@@ -1,0 +1,166 @@
+"""Paged KV block allocator over the ``[P, Hkv, page_size, D]`` page pool
+that ``ops.flash_decode.gqa_decode_paged`` consumes.
+
+Two cleanly separated halves:
+
+- **device memory**: ``models.llama.init_page_pool`` arrays — plain jax
+  arrays the engine threads through its jitted step (donated, so the hot
+  loop updates pages in place). Nothing here ever looks at their values.
+- **host accounting** (this module): ``KVPagePool`` — a free-list over
+  page ids with per-sequence ownership, allocate-on-decode growth and
+  free-on-finish. Pure Python, deterministic (LIFO free list), microsecond
+  scale next to a decode step.
+
+Sharding: the pool shards exactly like the SP cache — the page-major pool
+array is the paged twin of the ``[L, B, Hkv, S, D]`` cache whose S dim is
+``P(..., axis, ...)``-sharded. ``page_pool_pspec(axis)`` shards the page
+dim: each SP rank owns the pages of its sequence shard and runs an
+identical (replicated-decision) allocator instance, so block tables stay
+host-replicated control plane — same split as ``decode_step_sp``'s cache.
+This PR's engine drives the single-device pool; the spec is the contract
+later SP-serving PRs build on.
+
+``cache_to_pages`` / ``pages_to_cache`` convert between the head-major
+contiguous ``init_kv_cache`` layout and the page pool — pure data
+movement (gather/scatter by block table), bit-exact round trip — so
+prefill can fill a contiguous cache (the layout the prefill kernels like)
+and hand the pages off to the pool.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def page_pool_pspec(axis: str | None) -> P:
+    """PartitionSpec for the [L, P, Hkv, page_size, D] pool arrays: pages
+    sharded over ``axis`` (the SP-cache analog — its S dim becomes the
+    page dim here); everything else replicated."""
+    return P(None, axis, None, None, None)
+
+
+class KVPagePool:
+    """Host-side free-list allocator over ``num_pages`` page ids.
+
+    Invariants (asserted here, exercised in tests/test_serving.py):
+    - a page id is owned by at most one sequence at a time;
+    - ``reserved`` low ids are never handed out (the engine parks
+      inactive batch slots on page 0 — its writes must never land on a
+      live sequence's page);
+    - alloc is all-or-nothing: a request for ``n`` pages either returns
+      ``n`` ids or ``None`` and changes nothing (no partial grabs to
+      unwind on preemption).
+    The free list is LIFO so allocation order is deterministic — replay
+    of the same trace allocates the same pages.
+    """
+
+    def __init__(self, num_pages: int, page_size: int, reserved: int = 0):
+        assert num_pages > reserved >= 0
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.reserved = reserved
+        # LIFO: lowest ids on top, so fresh pools allocate reserved, 1, 2…
+        self._free = list(range(num_pages - 1, reserved - 1, -1))
+        self._owned: dict[object, list[int]] = {}
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return (self.num_pages - self.reserved) - len(self._free)
+
+    def occupancy(self) -> float:
+        cap = self.num_pages - self.reserved
+        return self.used_pages / cap if cap else 0.0
+
+    def pages_of(self, seq_id) -> list[int]:
+        return list(self._owned.get(seq_id, ()))
+
+    def holds(self, seq_id) -> bool:
+        return seq_id in self._owned
+
+    # -- allocation -------------------------------------------------------
+    def alloc(self, seq_id, n_pages: int) -> list[int] | None:
+        """Grow ``seq_id`` by ``n_pages``; all-or-nothing. Returns the new
+        page ids or ``None`` when the pool is dry."""
+        if n_pages > len(self._free):
+            return None
+        got = [self._free.pop() for _ in range(n_pages)]
+        self._owned.setdefault(seq_id, []).extend(got)
+        return got
+
+    def ensure(self, seq_id, kv_len: int) -> bool:
+        """Allocate-on-decode growth: make ``seq_id`` own enough pages to
+        hold ``kv_len`` tokens. True on success (including no-op), False
+        when the pool is dry (caller preempts and retries)."""
+        have = len(self._owned.get(seq_id, ()))
+        need = -(-kv_len // self.page_size) - have
+        if need <= 0:
+            return True
+        return self.alloc(seq_id, need) is not None
+
+    def free_seq(self, seq_id) -> int:
+        """Free-on-finish (and on preemption): return every page of
+        ``seq_id`` to the free list. Returns how many were freed."""
+        pages = self._owned.pop(seq_id, [])
+        for p in pages:
+            assert p not in self._free, f"double free of page {p}"
+            self._free.append(p)
+        return len(pages)
+
+    def block_table_row(self, seq_id, pages_per_seq: int,
+                        fill: int = 0) -> list[int]:
+        """Fixed-width block-table row for the kernel: owned pages then
+        ``fill`` (the engine's scratch page — entries past the valid count
+        are never dereferenced by ``gqa_decode_paged``, but a valid id
+        keeps the row honest)."""
+        pages = self._owned.get(seq_id, [])
+        assert len(pages) <= pages_per_seq, (
+            f"seq {seq_id!r} owns {len(pages)} pages > pages_per_seq "
+            f"{pages_per_seq}")
+        return pages + [fill] * (pages_per_seq - len(pages))
+
+
+# ---------------------------------------------------------------------------
+# contiguous cache <-> page pool converters
+# ---------------------------------------------------------------------------
+
+def cache_to_pages(cache: jax.Array, pages: jax.Array,
+                   block_table: jax.Array) -> jax.Array:
+    """Scatter a head-major contiguous cache into the page pool.
+
+    cache [L, B, Hkv, S, D] (``init_kv_cache`` layout, one of k/v);
+    pages [L, P, Hkv, page_size, D] (``init_page_pool`` layout);
+    block_table [B, n_pages] int32 with n_pages * page_size <= S.
+    Writes cache[:, b, :, p*ps:(p+1)*ps] into pages[:, bt[b, p]] for every
+    (b, p) — whole pages, pure data movement (prefill zero-pads the tail
+    of its last page; decode overwrites those rows one token at a time).
+    """
+    L, B, Hkv, S, D = cache.shape
+    ps = pages.shape[3]
+    n_pages = block_table.shape[1]
+    assert n_pages * ps <= S, (n_pages, ps, S)
+    src = cache[:, :, :, :n_pages * ps].reshape(L, B, Hkv, n_pages, ps, D)
+    src = src.transpose(0, 1, 3, 2, 4, 5).reshape(L, B * n_pages, Hkv, ps, D)
+    return pages.at[:, block_table.reshape(-1)].set(src)
+
+
+def pages_to_cache(pages: jax.Array, block_table: jax.Array) -> jax.Array:
+    """Gather pool pages back into a contiguous head-major cache — the
+    exact inverse of ``cache_to_pages`` (bit-compare round trip is a
+    test). pages [L, P, Hkv, ps, D]; block_table [B, n_pages] →
+    [L, B, Hkv, n_pages*ps, D]."""
+    L = pages.shape[0]
+    Hkv, ps, D = pages.shape[2:]
+    B, n_pages = block_table.shape
+    g = pages[:, block_table.reshape(-1)]          # [L, B*n_pages, Hkv, ps, D]
+    g = g.reshape(L, B, n_pages, Hkv, ps, D).transpose(0, 1, 3, 2, 4, 5)
+    return g.reshape(L, B, Hkv, n_pages * ps, D)
+
+
+__all__ = ["KVPagePool", "page_pool_pspec", "cache_to_pages",
+           "pages_to_cache"]

@@ -1,0 +1,121 @@
+"""Serving metrics: counters + histograms, emitted as JSON lines.
+
+One ``ServingMetrics`` per engine. Everything is host-side and O(1) per
+event; histograms keep (count, sum, min, max) plus a bounded reservoir so
+percentiles stay cheap and memory stays flat over million-request runs.
+``json_line()`` is the wire format — one self-contained JSON object per
+call, the shape ``scripts/serve_sim.py`` prints and ``bench.py`` folds
+into its extras.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+class Histogram:
+    """Streaming histogram: exact count/sum/min/max + a bounded sample
+    reservoir (deterministic stride thinning, no RNG — replays emit
+    identical metrics) for approximate percentiles."""
+
+    def __init__(self, max_samples: int = 512):
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self._samples: list[float] = []
+        self._max_samples = max_samples
+        self._stride = 1
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.total += value
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+        if (self.count - 1) % self._stride == 0:
+            self._samples.append(value)
+            if len(self._samples) >= self._max_samples:
+                # thin deterministically: keep every other sample, double
+                # the stride — the reservoir stays size-bounded and replay-
+                # stable (random eviction would jitter the percentiles)
+                self._samples = self._samples[::2]
+                self._stride *= 2
+
+    def percentile(self, q: float) -> float | None:
+        if not self._samples:
+            return None
+        s = sorted(self._samples)
+        idx = min(int(q / 100.0 * len(s)), len(s) - 1)
+        return s[idx]
+
+    @property
+    def mean(self) -> float | None:
+        return self.total / self.count if self.count else None
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count,
+            "mean": self.mean,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.percentile(50),
+            "p90": self.percentile(90),
+            "p99": self.percentile(99),
+        }
+
+
+class ServingMetrics:
+    """The engine's instrument panel (ISSUE 2 tentpole part 4):
+
+    counters — tokens generated, requests submitted/finished, prefills,
+    preemptions, decode steps;
+    histograms — TTFT (s), per-token latency (s), queue depth (sampled
+    per step), pool occupancy (fraction, sampled per step), batch
+    occupancy (active slots per step).
+    """
+
+    def __init__(self):
+        self.counters = {
+            "requests_submitted": 0,
+            "requests_finished": 0,
+            "prefills": 0,
+            "preemptions": 0,
+            "decode_steps": 0,
+            "tokens_generated": 0,
+        }
+        self.hist = {
+            "ttft_s": Histogram(),
+            "tok_latency_s": Histogram(),
+            "queue_depth": Histogram(),
+            "pool_occupancy": Histogram(),
+            "active_slots": Histogram(),
+        }
+        self._t0 = time.perf_counter()
+
+    def inc(self, name: str, by: int = 1) -> None:
+        self.counters[name] += by
+
+    def observe(self, name: str, value: float) -> None:
+        self.hist[name].observe(value)
+
+    def snapshot(self) -> dict:
+        wall = time.perf_counter() - self._t0
+        toks = self.counters["tokens_generated"]
+        return {
+            "wall_s": round(wall, 4),
+            "tok_per_s": round(toks / wall, 2) if wall > 0 else None,
+            **self.counters,
+            **{k: v.summary() for k, v in self.hist.items()},
+        }
+
+    def json_line(self) -> str:
+        return json.dumps(self.snapshot())
+
+    def emit(self, file=None) -> None:
+        """Print one JSON line (the serve_sim / log-scraper format)."""
+        print(self.json_line(), file=file)
+
+
+__all__ = ["Histogram", "ServingMetrics"]

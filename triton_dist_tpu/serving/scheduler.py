@@ -1,0 +1,160 @@
+"""Continuous-batching scheduler: FIFO admission into fixed batch slots,
+prefill/decode interleaving, preemption-by-eviction when the KV pool runs
+dry.
+
+TPU-shaped by construction: the engine's decode step is ONE compiled
+kernel over ``num_slots`` batch rows, so the scheduler never changes
+shapes — it only decides which request occupies which slot and which
+slots are active this step (inactive rows are masked by parking them on
+the engine's scratch page). Policy lives here; mechanics (page
+allocation, prefill handoff, the jitted step) live in ``engine.py``.
+
+Policies (all deterministic — bit-identical replay is a test invariant):
+
+- **admission**: strict FIFO. A request is admitted when a slot is free
+  AND the pool can hold its whole prompt; admission stops at the first
+  request that does not fit (no reordering — small requests cannot
+  starve a big head-of-line request).
+- **preemption**: when decode growth finds the pool dry, evict the
+  YOUNGEST active request (latest admission wins the victim lottery —
+  it has the least sunk prefill+decode work), free its pages, requeue it
+  at the FRONT of the queue so it reclaims a slot as soon as pressure
+  clears. A preempted request restarts from its prompt: greedy decode is
+  deterministic, so the regenerated tokens are identical to the lost
+  ones (tests assert bit-equality against uncontended runs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from collections import deque
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    ACTIVE = "active"
+    FINISHED = "finished"
+
+
+@dataclasses.dataclass
+class Request:
+    """One serving request plus its runtime bookkeeping."""
+    rid: int
+    prompt: tuple[int, ...]            # token ids
+    max_new_tokens: int
+    state: RequestState = RequestState.QUEUED
+    generated: list[int] = dataclasses.field(default_factory=list)
+    preemptions: int = 0
+    admitted_seq: int = -1             # admission ticket (victim ordering)
+    submit_step: int = -1              # engine step counters for metrics
+    first_token_step: int = -1
+    finish_step: int = -1
+    submit_time: float | None = None   # wall clocks for TTFT
+    first_token_time: float | None = None
+
+    @property
+    def kv_len(self) -> int:
+        """Tokens holding KV right now: prompt + all but the newest
+        generated token (the newest one's KV is written by the step that
+        consumes it)."""
+        return len(self.prompt) + max(len(self.generated) - 1, 0)
+
+    @property
+    def done(self) -> bool:
+        return len(self.generated) >= self.max_new_tokens
+
+
+class ContinuousBatchingScheduler:
+    """Slot + queue state machine. The engine calls, in step order:
+    ``admissible()`` → prefill each admitted request → ``activate()``,
+    then ``pick_victim()`` whenever growth fails, then ``finish()`` as
+    slots complete."""
+
+    def __init__(self, num_slots: int):
+        assert num_slots >= 1
+        self.num_slots = num_slots
+        self.queue: deque[Request] = deque()
+        self.slots: list[Request | None] = [None] * num_slots
+        self._admit_ticket = 0
+
+    # -- queue ------------------------------------------------------------
+    def submit(self, req: Request, front: bool = False) -> None:
+        (self.queue.appendleft if front else self.queue.append)(req)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    @property
+    def active(self) -> list[tuple[int, Request]]:
+        return [(i, r) for i, r in enumerate(self.slots) if r is not None]
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and all(s is None for s in self.slots)
+
+    def free_slot(self) -> int | None:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    # -- admission --------------------------------------------------------
+    def admissible(self, pool_can_hold) -> tuple[int, Request] | None:
+        """Next (slot, request) to admit, or None. ``pool_can_hold(req)``
+        is the engine's pages-available check; FIFO order is strict — a
+        head-of-line request that does not fit blocks admission (it will
+        fit once finishes/preemptions release pages)."""
+        slot = self.free_slot()
+        if slot is None or not self.queue:
+            return None
+        req = self.queue[0]
+        if not pool_can_hold(req):
+            return None
+        return slot, req
+
+    def activate(self, slot: int, req: Request) -> None:
+        assert self.slots[slot] is None and self.queue[0] is req
+        self.queue.popleft()
+        req.state = RequestState.ACTIVE
+        req.admitted_seq = self._admit_ticket
+        self._admit_ticket += 1
+        self.slots[slot] = req
+
+    # -- preemption -------------------------------------------------------
+    def pick_victim(self, exclude_slot: int | None = None) -> int | None:
+        """Youngest active slot (highest admission ticket), optionally
+        excluding one slot (a grower never evicts itself while another
+        victim exists — evicting self frees its own pages but forfeits
+        more progress than evicting the youngest)."""
+        best, best_ticket = None, -1
+        for i, r in enumerate(self.slots):
+            if r is None or i == exclude_slot:
+                continue
+            if r.admitted_seq > best_ticket:
+                best, best_ticket = i, r.admitted_seq
+        return best
+
+    def evict(self, slot: int) -> Request:
+        """Remove the slot's request and requeue it at the FRONT; its
+        generation restarts from the prompt (see module docstring)."""
+        req = self.slots[slot]
+        assert req is not None
+        self.slots[slot] = None
+        req.state = RequestState.QUEUED
+        req.preemptions += 1
+        req.generated.clear()
+        self.submit(req, front=True)
+        return req
+
+    # -- completion -------------------------------------------------------
+    def finish(self, slot: int) -> Request:
+        req = self.slots[slot]
+        assert req is not None and req.done
+        self.slots[slot] = None
+        req.state = RequestState.FINISHED
+        return req
+
+
+__all__ = ["Request", "RequestState", "ContinuousBatchingScheduler"]
