@@ -915,26 +915,35 @@ def bench_decode(ctx, i1: int, i2: int, B: int = 1, Hq: int = 32,
 def bench_serving(ctx, i1: int, i2: int, B: int = 1, Hq: int = 32,
                   Hkv: int = 8, D: int = 128, S: int = 4096,
                   page_size: int = 128, num_slots: int = 4,
-                  n_layers: int = 2) -> dict:
-    """Serving-runtime extras (ISSUE 2 satellite: the paged step must sit
-    within ~10% of the contiguous rows at equal batch):
+                  n_layers: int = 2, decode_horizon: int = 4) -> dict:
+    """Serving-runtime extras (ISSUE 2 paged parity + ISSUE 4
+    device-resident hot loop):
 
     - ``serving_decode_step_us``: the jitted ``gqa_decode_paged`` attention
       step at the SAME (B, Hq, Hkv, D, S) as ``bench_decode``'s contiguous
       ``decode_push_us``/``decode_fused_us`` rows — the apples-to-apples
       parity target (same bytes streamed; the block table is the only
       extra traffic).
-    - ``serving_tok_per_s``: whole-model throughput of the jitted
-      ``decode_step_paged`` at batch = ``num_slots`` on a small config —
-      the engine's one-compiled-step-per-token hot loop, timed as a
-      data-dependent argmax chain (each step consumes the token the
-      previous step produced, exactly like ``ServingEngine.step``).
+    - ``serving_step_us``: one DISPATCH of the fused device chain
+      (``decode_multistep_paged`` at horizon K: K sample-fused model steps
+      per launch, tokens leave the device as one int32 slab), timed as a
+      data-dependent chain exactly like ``ServingEngine.step``'s hot path.
+      ``serving_step_tok_us`` divides by K.
+    - real-engine rows from a small seeded trace through ``ServingEngine``
+      at horizon K and again at K=1: ``serving_tok_per_s``,
+      ``serving_device_us``/``serving_host_us`` (the per-dispatch
+      device/host split from the engine's own histograms),
+      ``serving_dispatches`` vs ``serving_dispatches_k1`` (the >=K-times
+      launch-count win), ``serving_host_syncs``, ``serving_compiles``.
 
-    Knobs mirror ``scripts/serve_sim.py`` (--slots/--page-size/--layers).
+    Knobs mirror ``scripts/serve_sim.py``
+    (--slots/--page-size/--layers/--decode-horizon).
     """
-    from triton_dist_tpu.models.llama import (LlamaConfig, decode_step_paged,
+    from triton_dist_tpu.models.llama import (LlamaConfig,
+                                              decode_multistep_paged,
                                               init_page_pool, init_params)
     from triton_dist_tpu.ops.flash_decode import gqa_decode_paged
+    from triton_dist_tpu.serving import ServingEngine
 
     out = {}
     # 1. paged attention step at the contiguous-bench shape -----------------
@@ -955,40 +964,74 @@ def bench_serving(ctx, i1: int, i2: int, B: int = 1, Hq: int = 32,
     timer = make_chain_timer(attn_step, q, jnp.zeros((), jnp.bfloat16))
     out["serving_decode_step_us"] = round(_per_iter(timer, i1, i2) * 1e6, 1)
 
-    # 2. full paged model step at batch = num_slots -------------------------
+    # 2. fused device chain at batch = num_slots, horizon K -----------------
+    # one timed iteration == one DISPATCH (K sample-fused steps on device)
+    K = decode_horizon
     cfg = LlamaConfig.tiny(n_layers=n_layers)
     params = init_params(jax.random.key(3), cfg)
-    pages_per_seq = -(-(i2 + 2) // page_size)
+    pages_per_seq = -(-(i2 * K + 2) // page_size)
     pool = init_page_pool(cfg, num_slots * pages_per_seq + 1, page_size)
     bt2 = jnp.asarray(
         1 + jnp.arange(num_slots * pages_per_seq, dtype=jnp.int32
                        ).reshape(num_slots, pages_per_seq))
     tok0 = jnp.zeros((num_slots,), jnp.int32)
+    lim = jnp.full((num_slots,), K, jnp.int32)
 
     cache = {}
 
     def step_timer(iters: int):
         if iters not in cache:
-            def chain(params, tok0, kp0, vp0, bt2):
+            def chain(params, tok0, kp0, vp0, bt2, lim):
                 def body(c, _):
                     tok, pos, pages = c
-                    logits, pages = decode_step_paged(
-                        params, tok, pos, cfg, pages, bt2)
-                    tok = jnp.argmax(logits, -1).astype(jnp.int32)
-                    return (tok, pos + 1, pages), None
+                    _toks, tok, pos, pages = decode_multistep_paged(
+                        params, tok, pos, cfg, pages, bt2, lim, K)
+                    return (tok, pos, pages), None
                 c0 = (tok0, jnp.zeros((num_slots,), jnp.int32),
                       {"k": kp0, "v": vp0})
                 (tok, pos, _), _ = lax.scan(body, c0, None, length=iters)
                 return (jnp.sum(tok.astype(jnp.float32))
                         + jnp.sum(pos.astype(jnp.float32)))
             cache[iters] = jax.jit(chain)
-        return float(cache[iters](params, tok0, pool["k"], pool["v"], bt2))
+        return float(cache[iters](params, tok0, pool["k"], pool["v"], bt2,
+                                  lim))
 
     step_s = _per_iter(step_timer, i1, i2)
     out["serving_step_us"] = round(step_s * 1e6, 1)
-    out["serving_tok_per_s"] = round(num_slots / step_s, 1)
+    out["serving_step_tok_us"] = round(step_s / K * 1e6, 1)
+
+    # 3. real engine on a seeded trace: horizon K vs the K=1 baseline -------
+    import numpy as _np
+
+    def _engine_trace(horizon: int):
+        rng = _np.random.RandomState(0)
+        eng = ServingEngine(params, cfg, num_slots=num_slots, page_size=16,
+                            num_pages=8 * num_slots + 8, pages_per_seq=8,
+                            decode_horizon=horizon)
+        for _ in range(3 * num_slots):
+            plen = int(rng.randint(4, 24))
+            prompt = [int(t) for t in
+                      rng.randint(1, cfg.vocab_size, size=plen)]
+            eng.submit(prompt, int(rng.randint(8, 24)))
+        t0 = time.perf_counter()
+        res = eng.run(max_steps=100_000)
+        wall = time.perf_counter() - t0
+        assert len(res) == 3 * num_slots
+        return eng, eng.metrics.snapshot(), wall
+
+    eng, snap, wall = _engine_trace(K)
+    _, snap1, _ = _engine_trace(1)
+    out["serving_tok_per_s"] = round(snap["tokens_generated"] / wall, 1)
+    dev, host = snap["step_device_s"], snap["step_host_s"]
+    out["serving_device_us"] = round((dev["mean"] or 0.0) * 1e6, 1)
+    out["serving_host_us"] = round((host["mean"] or 0.0) * 1e6, 1)
+    out["serving_dispatches"] = snap["dispatches"]
+    out["serving_dispatches_k1"] = snap1["dispatches"]
+    out["serving_host_syncs"] = snap["host_syncs"]
+    out["serving_compiles"] = eng.compile_stats
     out["serving_knobs"] = {"num_slots": num_slots, "page_size": page_size,
-                            "n_layers": n_layers, "attn_B": B, "attn_S": S}
+                            "n_layers": n_layers, "attn_B": B, "attn_S": S,
+                            "decode_horizon": K}
     return out
 
 

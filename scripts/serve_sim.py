@@ -44,13 +44,27 @@ p.add_argument("--arrive-every", type=int, default=2,
 p.add_argument("--seed", type=int, default=0, help="trace RNG seed")
 p.add_argument("--tokens", action="store_true",
                help="also print one JSON line per finished request")
+p.add_argument("--decode-horizon", type=int, default=1,
+               help="K: scanned decode steps per host dispatch")
+p.add_argument("--prefill-buckets", default="pow2",
+               help='"pow2" (default), "exact", or a comma-separated '
+                    "ascending list of bucket lengths, e.g. 8,16,32")
 args = p.parse_args()
+
+if args.prefill_buckets == "pow2":
+    buckets = "pow2"
+elif args.prefill_buckets == "exact":
+    buckets = None
+else:
+    buckets = tuple(int(b) for b in args.prefill_buckets.split(","))
 
 cfg = LlamaConfig.tiny(n_layers=args.layers)
 params = init_params(jax.random.PRNGKey(args.seed), cfg)
 eng = ServingEngine(params, cfg, num_slots=args.slots,
                     page_size=args.page_size, num_pages=args.pages,
-                    pages_per_seq=args.pages_per_seq)
+                    pages_per_seq=args.pages_per_seq,
+                    decode_horizon=args.decode_horizon,
+                    prefill_buckets=buckets)
 
 rng = np.random.RandomState(args.seed)
 max_plen = min(args.pages_per_seq * args.page_size - args.max_new, 24)
@@ -63,7 +77,9 @@ for i in range(args.sim):
                      prompt, mnt))
 
 results = eng.run(max_steps=200_000, arrivals=arrivals)
-unfinished = [rid for rid, toks in results.items() if toks is None]
+# run() returns FINISHED requests only — anything submitted but absent
+# ran out of steps
+unfinished = sorted(set(range(args.sim)) - set(results))
 if unfinished:
     print(json.dumps({"error": "unfinished requests", "rids": unfinished}),
           file=sys.stderr)
@@ -77,4 +93,5 @@ if args.tokens:
             "preemptions": req.preemptions,
             "ttft_steps": req.first_token_step - req.submit_step,
         }))
+print(json.dumps({"compile_stats": eng.compile_stats}), file=sys.stderr)
 eng.metrics.emit()

@@ -24,6 +24,8 @@ from triton_dist_tpu.ops.all_to_all import (all_to_all_push, combine_2d,
                                             create_all_to_all_context_2d,
                                             dispatch_2d)
 from triton_dist_tpu.ops.allgather_gemm import ag_gemm
+from triton_dist_tpu.ops.gemm import GemmConfig
+from triton_dist_tpu.ops.gemm_reduce_scatter import gemm_rs
 from triton_dist_tpu.shmem.context import initialize_distributed
 from triton_dist_tpu.utils import assert_allclose
 
@@ -145,6 +147,55 @@ def test_ag_gemm_2tier_dcn(ctx2d, dcn_major):
         ctx2d.shard(a, P(("a", "b"))), ctx2d.shard(b, P(None, ("a", "b"))))
     assert_allclose(np.asarray(c), np.asarray(a) @ np.asarray(b),
                     atol=1e-3, rtol=1e-3)
+
+
+def test_gemm_rs_dcn(ctx2d, dcn_major):
+    """Single-axis GEMM-RS over a DCN axis: routed to XLA dot +
+    psum_scatter end to end, same golden as the Pallas ring."""
+    na = 2
+    M, K, N = na * 16, na * 64, 64
+    a = jax.random.normal(jax.random.key(0), (M, K), jnp.float32) * 0.3
+    b = jax.random.normal(jax.random.key(1), (K, N), jnp.float32) * 0.3
+    c = jax.jit(lambda x, y: gemm_rs(ctx2d, x, y, axis="a"))(
+        ctx2d.shard(a, P(None, "a")), ctx2d.shard(b, P("a", None)))
+    assert_allclose(np.asarray(c), np.asarray(a) @ np.asarray(b),
+                    atol=1e-3, rtol=1e-3)
+
+
+def test_gemm_rs_2tier_dcn_outer(ctx2d, dcn_major):
+    """Hierarchical GEMM-RS with the OUTER tier on DCN: the fast-tier
+    fused GEMM+RS stays Pallas, the slow ring becomes psum_scatter —
+    semantics (and segment order) unchanged."""
+    n = 6
+    axes = ("a", "b")
+    M, K, N = n * 16, n * 32, 64
+    a = jax.random.normal(jax.random.key(0), (M, K), jnp.float32) * 0.3
+    b = jax.random.normal(jax.random.key(1), (K, N), jnp.float32) * 0.3
+    cfg = GemmConfig(block_m=16, block_n=32)
+    try:
+        c = jax.jit(lambda x, y: gemm_rs(ctx2d, x, y, axis=axes, cfg=cfg))(
+            ctx2d.shard(a, P(None, axes)), ctx2d.shard(b, P(axes, None)))
+    except NotImplementedError as e:   # pragma: no cover
+        # this jax version cannot run multi-axis LOGICAL remote DMA (the
+        # fast-tier Pallas stage) — same limitation test_ag_gemm_2tier_dcn
+        # hits; the routing logic itself is covered by the single-axis and
+        # axis-order tests
+        pytest.skip(f"multi-axis Pallas DMA unavailable: {e}")
+    assert_allclose(np.asarray(c), np.asarray(a) @ np.asarray(b),
+                    atol=1e-3, rtol=1e-3)
+
+
+def test_gemm_rs_dcn_axis_order_enforced(ctx2d, monkeypatch):
+    """A DCN axis buried BEHIND an ICI axis must be rejected loudly —
+    the fast-tier stage is remote DMA, which cannot cross DCN."""
+    monkeypatch.setenv("TDT_DCN_AXES", "b")
+    n = 6
+    M, K, N = n * 16, n * 32, 64
+    a = jax.random.normal(jax.random.key(0), (M, K), jnp.float32)
+    b = jax.random.normal(jax.random.key(1), (K, N), jnp.float32)
+    with pytest.raises(ValueError, match="slow tier"):
+        gemm_rs(ctx2d, ctx2d.shard(a, P(None, ("a", "b"))),
+                ctx2d.shard(b, P(("a", "b"), None)), axis=("a", "b"))
 
 
 def test_ag_gemm_dcn_axis_order_enforced(ctx2d, monkeypatch):

@@ -181,16 +181,19 @@ def test_engine_smoke(tiny_model):
     cfg, params = tiny_model
     reqs = _mk_requests(cfg, 3, seed=1, mnt_hi=6)
 
+    ref_prefill = jax.jit(lambda p, t, c: prefill(p, t, cfg, c))
+    ref_step = jax.jit(lambda p, tk, ps, c: decode_step(p, tk, ps, cfg, c))
+
     def reference(prompt, mnt):
         cache = init_kv_cache(cfg, 1, 32)
-        logits, cache = prefill(params, jnp.asarray([prompt], jnp.int32),
-                                cfg, cache)
+        logits, cache = ref_prefill(params, jnp.asarray([prompt], jnp.int32),
+                                    cache)
         toks = [int(jnp.argmax(logits[0]))]
         pos = len(prompt)
         while len(toks) < mnt:
-            logits, cache = decode_step(
+            logits, cache = ref_step(
                 params, jnp.asarray([toks[-1]], jnp.int32),
-                jnp.int32(pos), cfg, cache)
+                jnp.int32(pos), cache)
             toks.append(int(jnp.argmax(logits[0])))
             pos += 1
         return toks
@@ -207,27 +210,37 @@ def test_engine_smoke(tiny_model):
     assert snap["ttft_s"]["count"] == len(reqs)
 
 
-def test_trace_bit_identical_under_preemption(tiny_model):
-    """The acceptance trace: 50 requests through a 4-slot engine with a
-    pool small enough to force preemptions. Every request's tokens must be
-    bit-identical to the same request decoded in a single-batch engine
-    with an uncontended pool — including every preempted request."""
+@pytest.fixture(scope="module")
+def golden_trace(tiny_model):
+    """Golden for the acceptance trace: 50 requests through ONE
+    single-slot engine with an ample pool — requests run strictly one at
+    a time (per-request single-batch decoding, horizon 1)."""
     cfg, params = tiny_model
     reqs = _mk_requests(cfg, 50, seed=2, mnt_lo=6, mnt_hi=14)
-
-    # golden: ONE single-slot engine with an ample pool — requests run
-    # strictly one at a time (per-request single-batch decoding)
     gold_eng = ServingEngine(params, cfg, num_slots=1, page_size=8,
                              num_pages=8, pages_per_seq=8)
     gold_rids = [gold_eng.submit(p, m) for p, m in reqs]
     gold = gold_eng.run(max_steps=5000)
     assert gold_eng.metrics.counters["preemptions"] == 0
+    return reqs, gold_rids, gold
+
+
+@pytest.mark.parametrize("horizon", [1, 4])
+def test_trace_bit_identical_under_preemption(tiny_model, golden_trace,
+                                              horizon):
+    """The acceptance trace: 50 requests through a 4-slot engine with a
+    pool small enough to force preemptions. Every request's tokens must be
+    bit-identical to the same request decoded in a single-batch engine
+    with an uncontended pool — including every preempted request, and at
+    every decode horizon (K=1 per-token semantics, K=4 scanned)."""
+    cfg, params = tiny_model
+    reqs, gold_rids, gold = golden_trace
 
     # contended: 4 slots, pool deliberately too small for 4 long tails —
     # growth must preempt. Arrivals staggered so admission interleaves
     # with decode of earlier requests.
     eng = ServingEngine(params, cfg, num_slots=4, page_size=8, num_pages=9,
-                        pages_per_seq=8)
+                        pages_per_seq=8, decode_horizon=horizon)
     arrivals = [(i // 2, p, m) for i, (p, m) in enumerate(reqs)]
     res = eng.run(max_steps=5000, arrivals=arrivals)
     snap = eng.metrics.snapshot()
@@ -243,6 +256,12 @@ def test_trace_bit_identical_under_preemption(tiny_model):
     # spot-check: the preempted ones specifically
     for r in preempted:
         assert res[r.rid] == gold[r.rid]
+    if horizon > 1:
+        # the multi-token win: far fewer host dispatches than tokens, and
+        # quiet dispatches re-upload nothing
+        decode_toks = (snap["tokens_generated"] - snap["prefills"])
+        assert snap["dispatches"] < decode_toks
+        assert snap["host_syncs"] <= snap["dispatches"]
 
 
 def test_engine_refuses_impossible_request(tiny_model):
@@ -251,3 +270,117 @@ def test_engine_refuses_impossible_request(tiny_model):
                         pages_per_seq=8)
     with pytest.raises(AssertionError):
         eng.submit(list(range(1, 50)), 8)      # needs 7 pages, pool has 4
+
+
+def test_truncated_run_returns_only_finished(tiny_model):
+    """run() with a small step budget must return ONLY finished requests —
+    no None placeholders for work still in flight — and a follow-up run()
+    finishes the rest."""
+    cfg, params = tiny_model
+    eng = ServingEngine(params, cfg, num_slots=2, page_size=8, num_pages=16,
+                        pages_per_seq=4)
+    reqs = _mk_requests(cfg, 5, seed=4, mnt_lo=6, mnt_hi=9)
+    rids = [eng.submit(p, m) for p, m in reqs]
+    res = eng.run(max_steps=3)
+    assert all(v is not None for v in res.values())
+    assert set(res) == {r.rid for r in eng._finished}
+    assert len(res) < len(reqs)                # budget was really too small
+    res2 = eng.run(max_steps=5000)
+    assert set(res2) == set(rids)
+    assert all(len(res2[r]) == m for r, (_, m) in zip(rids, reqs))
+
+
+def test_bucketed_prefill_token_identical_to_exact(tiny_model):
+    """Bucketed (padded + length-masked) prefill must produce the same
+    tokens as exact-length prefill for every request — the compile-cache
+    bound may not change a single sampled token."""
+    cfg, params = tiny_model
+    reqs = _mk_requests(cfg, 6, seed=7, mnt_lo=2, mnt_hi=7)
+
+    def run(buckets):
+        eng = ServingEngine(params, cfg, num_slots=2, page_size=8,
+                            num_pages=16, pages_per_seq=4,
+                            prefill_buckets=buckets)
+        rids = [eng.submit(p, m) for p, m in reqs]
+        return [eng.run(max_steps=2000)[r] for r in rids]
+
+    assert run("pow2") == run(None)
+
+
+def test_compile_count_guard(tiny_model, monkeypatch):
+    """A trace with 20 DISTINCT prompt lengths must compile the decode
+    step exactly once and at most one prefill program per bucket — the
+    whole point of bucketing + shape-stable multi-step decode."""
+    cfg, params = tiny_model
+    real_jit = jax.jit
+    made = []
+
+    def counting_jit(fun, *a, **k):
+        made.append(fun)
+        return real_jit(fun, *a, **k)
+
+    monkeypatch.setattr(jax, "jit", counting_jit)
+    eng = ServingEngine(params, cfg, num_slots=4, page_size=8, num_pages=32,
+                        pages_per_seq=8, decode_horizon=2,
+                        prefill_buckets=(8, 16, 32))
+    rng = np.random.RandomState(3)
+    arrivals = []
+    for i, plen in enumerate(range(3, 23)):    # 20 distinct prompt lengths
+        prompt = [int(t) for t in rng.randint(1, cfg.vocab_size, size=plen)]
+        arrivals.append((i, prompt, int(rng.randint(2, 8))))
+    res = eng.run(max_steps=5000, arrivals=arrivals)
+    assert len(res) == 20
+    stats = eng.compile_stats
+    assert stats["decode_compiles"] == 1
+    assert stats["prefill_programs"] <= 3      # one per bucket, max
+    assert stats["prefill_compiles"] <= 3
+    # the jit-entry hook agrees: one decode program + one per prefill bucket
+    # (pallas interpret mode jits its own internal wrappers — not ours)
+    ours = [f for f in made
+            if "ServingEngine" in getattr(f, "__qualname__", "")]
+    assert len(ours) == 1 + stats["prefill_programs"]
+
+
+def test_eos_truncation_multistep(tiny_model):
+    """With eos_id set, generation stops right after the first EOS even
+    mid-scan at K=4 — the frozen-lane mask must not let a finished row
+    keep decoding (or keep writing KV) inside the horizon."""
+    cfg, params = tiny_model
+    prompt, _ = _mk_requests(cfg, 1, seed=5)[0]
+    mnt = 12
+    base = ServingEngine(params, cfg, num_slots=1, page_size=8, num_pages=8,
+                         pages_per_seq=8, decode_horizon=4)
+    rid = base.submit(prompt, mnt)
+    toks = base.run(max_steps=1000)[rid]
+    assert len(toks) == mnt
+
+    eos = toks[len(toks) // 2]                 # a token we KNOW gets emitted
+    first = toks.index(eos)
+    eng = ServingEngine(params, cfg, num_slots=1, page_size=8, num_pages=8,
+                        pages_per_seq=8, decode_horizon=4, eos_id=eos)
+    rid2 = eng.submit(prompt, mnt)
+    got = eng.run(max_steps=1000)[rid2]
+    assert got == toks[:first + 1]             # truncated AT the EOS
+
+
+@pytest.mark.parametrize("horizon", [1, 4])
+def test_dispatch_count_bound(tiny_model, horizon):
+    """One request alone: dispatches == ceil(decode_tokens / K) exactly,
+    and host re-uploads stay rare (device state is authoritative between
+    control-plane changes)."""
+    cfg, params = tiny_model
+    prompt, _ = _mk_requests(cfg, 1, seed=6)[0]
+    mnt = 13
+    eng = ServingEngine(params, cfg, num_slots=1, page_size=8, num_pages=8,
+                        pages_per_seq=8, decode_horizon=horizon)
+    rid = eng.submit(prompt, mnt)
+    res = eng.run(max_steps=1000)
+    assert len(res[rid]) == mnt
+    c = eng.metrics.counters
+    decode_tokens = mnt - 1                    # token 0 comes from prefill
+    assert c["dispatches"] == -(-decode_tokens // horizon)
+    assert c["host_syncs"] <= c["dispatches"]
+    if horizon == 1:
+        # only admission + page growth dirty the mirrors; the steady-state
+        # dispatch re-uploads nothing
+        assert c["host_syncs"] < c["dispatches"]

@@ -120,3 +120,25 @@ class PagedGQADecodeAttention:
             f"pool page_size {k_pages.shape[2]} != layer page_size "
             f"{self.page_size}")
         return self._fwd(q, k_pages, v_pages, block_table, kv_len)
+
+    def update_and_attend(self, q: jax.Array, k_new: jax.Array,
+                          v_new: jax.Array, k_pages: jax.Array,
+                          v_pages: jax.Array, block_table: jax.Array,
+                          pos: jax.Array,
+                          active: jax.Array | None = None
+                          ) -> tuple[jax.Array, jax.Array, jax.Array,
+                                     jax.Array]:
+        """The decode-step composite: scatter this step's (k, v) row into
+        the pool (``ops.flash_decode.paged_kv_write``), then attend over
+        ``kv_len = pos + 1``. ``pos`` [B] int32 is each slot's write
+        position; ``active`` [B] bool parks frozen rows' writes on the
+        scratch page (the multi-token scanned decode's done-mask).
+        Returns (out, lse, k_pages, v_pages) — callers thread the updated
+        pool through their layer loop."""
+        from triton_dist_tpu.ops.flash_decode import paged_kv_write
+
+        k_pages, v_pages = paged_kv_write(k_pages, v_pages, k_new, v_new,
+                                          block_table, pos, active=active)
+        out, lse = self(q, k_pages, v_pages, block_table,
+                        (pos + 1).astype(jnp.int32))
+        return out, lse, k_pages, v_pages

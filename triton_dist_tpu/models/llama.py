@@ -162,16 +162,24 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
                            axis=-1).astype(x.dtype)
 
 
-def _attention(q, k, v, sm_scale: float) -> jax.Array:
-    """Causal GQA attention. q [B,S,Hq,Dh]; k,v [B,S,Hkv,Dh]."""
+def _attention(q, k, v, sm_scale: float, kv_len=None) -> jax.Array:
+    """Causal GQA attention. q [B,S,Hq,Dh]; k,v [B,S,Hkv,Dh]. ``kv_len``
+    [B] int32 (optional) additionally masks keys at/after each row's
+    length — the bucketed-prefill guard against padded tail positions
+    (causality already shields queries < kv_len; the extra mask keeps the
+    padded queries' rows finite too, same -1e30 fill as the causal mask,
+    so valid rows are bit-identical with or without it)."""
     B, S, Hq, Dh = q.shape
     Hkv = k.shape[2]
     G = Hq // Hkv
     q = q.reshape(B, S, Hkv, G, Dh)
     scores = jnp.einsum("bshgd,bthd->bhgst", q, k,
                         preferred_element_type=jnp.float32) * sm_scale
-    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
-    scores = jnp.where(mask[None, None, None], scores, -1e30)
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None, None]
+    if kv_len is not None:
+        valid = jnp.arange(S)[None] < kv_len[:, None]      # [B, S] keys
+        mask = jnp.logical_and(mask, valid[:, None, None, None])
+    scores = jnp.where(mask, scores, -1e30)
     p = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgst,bthd->bshgd", p.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
@@ -281,9 +289,19 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_seq: int) -> dict:
 
 
 def prefill(params: dict, tokens: jax.Array, cfg: LlamaConfig,
-            cache: dict) -> tuple[jax.Array, dict]:
+            cache: dict, length: jax.Array | None = None
+            ) -> tuple[jax.Array, dict]:
     """Full-sequence forward that also writes K/V into ``cache[:, :, :S]``.
-    Returns (last-position logits [B, V], cache)."""
+    Returns (last-position logits [B, V], cache).
+
+    ``length`` [B] int32 (optional) is the per-row VALID prompt length for
+    bucketed prefill: ``tokens`` is padded to a bucket size S ≥ length, an
+    attention length mask hides the padded tail from every query row, and
+    the returned logits are taken at position ``length - 1`` per row (not
+    ``S - 1``). Cache rows at/after ``length`` hold padding K/V — callers
+    hand off only the first ``length`` positions (the serving engine's
+    page handoff already copies exactly the prompt's pages). ``None``
+    keeps the original exact-length code path unchanged."""
     B, S = tokens.shape
     Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     x = params["embed"][tokens].astype(cfg.dtype)
@@ -301,7 +319,7 @@ def prefill(params: dict, tokens: jax.Array, cfg: LlamaConfig,
             ck, k.transpose(0, 2, 1, 3), (0, 0, 0, 0))
         cv = lax.dynamic_update_slice(
             cv, v.transpose(0, 2, 1, 3), (0, 0, 0, 0))
-        attn = _attention(q, k, v, 1.0 / math.sqrt(Dh))
+        attn = _attention(q, k, v, 1.0 / math.sqrt(Dh), kv_len=length)
         x = x + attn.reshape(B, S, Hq * Dh) @ p["wo"]
         h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps)
         ff = jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)
@@ -311,7 +329,12 @@ def prefill(params: dict, tokens: jax.Array, cfg: LlamaConfig,
 
     x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"],
                                      cache["v"]))
-    x = rmsnorm(x[:, -1], params["final_norm"], cfg.norm_eps)
+    if length is None:
+        last = x[:, -1]
+    else:
+        last = jnp.take_along_axis(
+            x, (length - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    x = rmsnorm(last, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, {"k": ks, "v": vs}
 
@@ -391,8 +414,9 @@ def init_page_pool(cfg: LlamaConfig, num_pages: int, page_size: int) -> dict:
 
 def decode_step_paged(params: dict, token: jax.Array, pos: jax.Array,
                       cfg: LlamaConfig, pages: dict,
-                      block_table: jax.Array,
-                      ffn=None) -> tuple[jax.Array, dict]:
+                      block_table: jax.Array, ffn=None,
+                      active: jax.Array | None = None,
+                      sample: bool = False) -> tuple[jax.Array, dict]:
     """One-token decode over the paged KV pool — the continuous-batching
     twin of ``decode_step``. Differences that make it a serving hot loop:
 
@@ -411,17 +435,21 @@ def decode_step_paged(params: dict, token: jax.Array, pos: jax.Array,
     Returns (logits [B, V] f32, updated pages). ``ffn(h, p) -> [B, D]``
     overrides the per-layer FFN exactly as in ``decode_step`` (MoE
     serving plugs ``moe_mlp_ep_overlap`` here); with a custom ``ffn`` the
-    layer loop unrolls in Python for the same backend reasons."""
-    from triton_dist_tpu.ops.flash_decode import gqa_decode_paged
+    layer loop unrolls in Python for the same backend reasons.
+
+    ``active`` [B] bool (optional) parks frozen rows' KV writes on the
+    scratch page (``ops.flash_decode.paged_kv_write``) — the device-side
+    slot mask the scanned multi-token loop uses for rows done mid-scan.
+    ``sample=True`` fuses greedy sampling: the first return value is the
+    on-device argmax ``next_token`` [B] int32 instead of the [B, vocab]
+    logits, so a serving host only ever downloads a token slab."""
+    from triton_dist_tpu.ops.flash_decode import (gqa_decode_paged,
+                                                  paged_kv_write)
 
     B = token.shape[0]
     Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    page_size = pages["k"].shape[3]
     x = params["embed"][token].astype(cfg.dtype)          # [B, D]
     positions = pos[:, None].astype(jnp.int32)            # [B, 1]
-    rows = jnp.arange(B)
-    page = block_table[rows, pos // page_size]            # [B]
-    slot = pos % page_size                                # [B]
     kv_len = (pos + 1).astype(jnp.int32)
 
     def body(x, layer):
@@ -432,10 +460,8 @@ def decode_step_paged(params: dict, token: jax.Array, pos: jax.Array,
         k = rope((h @ p["wk"]).reshape(B, 1, Hkv, Dh), positions,
                  cfg.rope_theta)[:, 0]                     # [B, Hkv, Dh]
         v = (h @ p["wv"]).reshape(B, 1, Hkv, Dh)[:, 0]
-        # per-slot scatter: advanced indices (page, slot) around the head
-        # slice put the batch dim in front — [B, Hkv, Dh] rows
-        kp = kp.at[page, :, slot].set(k)
-        vp = vp.at[page, :, slot].set(v)
+        kp, vp = paged_kv_write(kp, vp, k, v, block_table, pos,
+                                active=active)
         attn, _lse = gqa_decode_paged(q, kp, vp, block_table, kv_len)
         x = x + attn.reshape(B, Hq * Dh) @ p["wo"]
         h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps)
@@ -461,7 +487,74 @@ def decode_step_paged(params: dict, token: jax.Array, pos: jax.Array,
         ks, vs = jnp.stack(ks_l), jnp.stack(vs_l)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
+    if sample:
+        return jnp.argmax(logits, -1).astype(jnp.int32), {"k": ks, "v": vs}
     return logits, {"k": ks, "v": vs}
+
+
+def decode_multistep_paged(params: dict, token: jax.Array, pos: jax.Array,
+                           cfg: LlamaConfig, pages: dict,
+                           block_table: jax.Array, limit: jax.Array,
+                           horizon: int, eos_id: int | None = None,
+                           ffn=None
+                           ) -> tuple[jax.Array, jax.Array, jax.Array, dict]:
+    """Device-resident multi-token decode: ``horizon`` fused sampled steps
+    (``decode_step_paged(..., sample=True)``) chained under one trace, so
+    ONE host dispatch advances every slot up to ``horizon`` tokens. The
+    serving hot loop (``serving.engine``) jits this once per engine — the
+    horizon and ``eos_id`` are static trace constants; all per-step
+    dynamism rides in ``limit``.
+
+    ``limit`` [B] int32 is the per-slot step budget for THIS dispatch:
+    ``min(horizon, tokens remaining, page capacity headroom)``, 0 for
+    parked slots. A row freezes once its inner step index reaches its
+    limit OR it has emitted ``eos_id`` — its token/pos stop advancing and
+    its KV writes are parked on the scratch page via the ``active`` mask.
+    The limit clamp is how the horizon auto-clamps so no slot can outgrow
+    its pre-ensured pages mid-scan; the EOS freeze is the device half of
+    the done-mask (the host reconciles finishes from the slab). Frozen
+    rows keep computing harmlessly — the fixed-shape batch never changes.
+
+    Returns ``(toks [horizon, B] int32, token' [B], pos' [B], pages)``:
+    ``toks[i, b]`` is the token sampled by row ``b``'s step ``i`` (valid
+    while the row was live); ``token'``/``pos'`` are the post-scan slot
+    states (advanced exactly as many steps as the row was live) the
+    engine keeps device-resident for the next dispatch. ``horizon=1``
+    is exactly one fused ``decode_step_paged`` — today's per-token
+    semantics."""
+    assert horizon >= 1
+    limit = limit.astype(jnp.int32)
+    stopped0 = jnp.zeros(token.shape, jnp.bool_)
+
+    def one(carry, i):
+        tok, pos_c, stopped, pages_c = carry
+        act = jnp.logical_and(i < limit, ~stopped)         # [B] bool
+        nxt, pages_c = decode_step_paged(params, tok, pos_c, cfg, pages_c,
+                                         block_table, ffn=ffn, active=act,
+                                         sample=True)
+        tok = jnp.where(act, nxt, tok)
+        pos_c = jnp.where(act, pos_c + 1, pos_c)
+        if eos_id is not None:
+            stopped = jnp.logical_or(stopped,
+                                     jnp.logical_and(act, nxt == eos_id))
+        return (tok, pos_c, stopped, pages_c), nxt
+
+    if ffn is None and horizon > 1:
+        (token, pos, _, pages), toks = lax.scan(
+            one, (token, pos, stopped0, pages),
+            jnp.arange(horizon, dtype=jnp.int32))
+    else:
+        # custom ffn may close over shard_map'd kernels that don't compose
+        # with scan on every backend — unroll (same reason as the layer
+        # loop above); horizon=1 skips the scan machinery entirely
+        toks_l = []
+        carry = (token, pos, stopped0, pages)
+        for i in range(horizon):
+            carry, nxt = one(carry, jnp.int32(i))
+            toks_l.append(nxt)
+        token, pos, _, pages = carry
+        toks = jnp.stack(toks_l)
+    return toks, token, pos, pages
 
 
 def decode_step_sp(ctx, params: dict, token: jax.Array, pos: jax.Array,
@@ -627,4 +720,5 @@ def forward_tp_overlap(ctx: ShmemContext, params: dict, tokens: jax.Array,
 __all__ = ["LlamaConfig", "init_params", "param_specs", "forward",
            "forward_tp_overlap", "mlp_tp_overlap", "rmsnorm", "rope",
            "block_apply", "init_kv_cache", "init_page_pool", "prefill",
-           "decode_step", "decode_step_paged", "generate"]
+           "decode_step", "decode_step_paged", "decode_multistep_paged",
+           "generate"]

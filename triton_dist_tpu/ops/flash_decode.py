@@ -254,6 +254,36 @@ def gqa_decode_paged(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     )(kv_len, block_table, q, k_pages, v_pages)
 
 
+def paged_kv_write(k_pages: jax.Array, v_pages: jax.Array,
+                   k_new: jax.Array, v_new: jax.Array,
+                   block_table: jax.Array, pos: jax.Array,
+                   active: jax.Array | None = None
+                   ) -> tuple[jax.Array, jax.Array]:
+    """Scatter one new (k, v) row per batch slot into the page pool:
+    page ``block_table[b, pos_b // page_size]``, row ``pos_b % page_size``.
+
+    k/v_pages [P, Hkv, page_size, D]; k/v_new [B, Hkv, D]; pos [B] int32.
+    ``active`` [B] bool (optional) PARKS the write of masked-off rows on
+    the scratch page (page 0, the id the serving engine reserves): a slot
+    frozen mid-scan by the multi-token decode loop (done on EOS/budget, or
+    clamped by page capacity) keeps computing, but its writes can never
+    land on a live sequence's page — the device-side twin of the engine's
+    host-side slot parking. Rows whose block-table lookup walks past the
+    owned pages hit the row's fill id (0, same scratch page) either way.
+    """
+    B = pos.shape[0]
+    page_size = k_pages.shape[2]
+    rows = jnp.arange(B)
+    page = block_table[rows, pos // page_size]              # [B]
+    if active is not None:
+        page = jnp.where(active, page, 0)
+    slot = pos % page_size                                  # [B]
+    # advanced indices (page, slot) around the head slice put the batch
+    # dim in front — [B, Hkv, D] rows
+    return (k_pages.at[page, :, slot].set(k_new),
+            v_pages.at[page, :, slot].set(v_new))
+
+
 def _combine_kernel(outs_ref, lses_ref, out_ref):
     """Inter-rank lse-weighted merge (analog of
     kernel_inter_rank_gqa_fwd_batch_decode_combine_kv,
@@ -469,5 +499,5 @@ def sp_gqa_flash_decode(ctx: ShmemContext, q: jax.Array, k_cache: jax.Array,
     return smc(g)
 
 
-__all__ = ["gqa_decode_partial", "gqa_decode_paged", "decode_combine",
-           "ll_ag_merge", "sp_gqa_flash_decode"]
+__all__ = ["gqa_decode_partial", "gqa_decode_paged", "paged_kv_write",
+           "decode_combine", "ll_ag_merge", "sp_gqa_flash_decode"]

@@ -146,6 +146,27 @@ def _gemm_rs_2d_stage_kernel(axes, mesh_axes, cfg, acc_dtype,
     emit_slot_reduction(ws_ref, red_ref, cfg.block_m, cfg.block_n)
 
 
+def _gemm_rs_xla(ctx, a, b, axis, out_dtype):
+    """XLA-collective GEMM-RS for a scatter axis that crosses slice
+    boundaries (``is_dcn_axis``): remote DMA cannot cross DCN, so the
+    partial GEMM runs as a plain sharded dot and ``psum_scatter`` routes
+    the reduction over the right transport — the same per-op DCN routing
+    ``reduce_scatter``/``all_gather`` apply (reduce_scatter.py), and the
+    RS twin of ``allgather_gemm._ag_gemm_dcn``. Segment order matches the
+    ring path (the golden the Pallas kernel is tested against)."""
+    out_dtype = out_dtype or a.dtype
+    acc_dtype = jnp.float32 if out_dtype == jnp.bfloat16 else out_dtype
+
+    def f(a_shard, b_shard):
+        part = jnp.dot(a_shard, b_shard, preferred_element_type=acc_dtype)
+        return lax.psum_scatter(part, axis, scatter_dimension=0,
+                                tiled=True).astype(out_dtype)
+
+    sm = ctx.shard_map(f, in_specs=(P(None, axis), P(axis, None)),
+                       out_specs=P(axis))
+    return sm(a, b)
+
+
 def _gemm_rs_2d(ctx, a, b, axes, cfg, out_dtype, ws=None, stage=None):
     """Hierarchical 2-tier GEMM-RS over ``axes = (outer, *inner)`` — the
     inter-node analog of ``gemm_rs`` (reference 2-D RS pipeline,
@@ -162,6 +183,17 @@ def _gemm_rs_2d(ctx, a, b, axes, cfg, out_dtype, ws=None, stage=None):
     acc_dtype = jnp.float32 if out_dtype == jnp.bfloat16 else out_dtype
     mesh_axes = ctx.axis_names
     outer, inner = axes[0], tuple(axes[1:])
+    inner_dcn = tuple(ax for ax in inner if ctx.is_dcn_axis(ax))
+    if inner_dcn:
+        raise ValueError(
+            f"DCN (slice-crossing) axes {inner_dcn} must come first in the "
+            f"hierarchical axis tuple {axes} — put the slow tier outermost "
+            "(the fast-tier stage is remote DMA, which cannot cross DCN; "
+            "cf. gemm_rs docstring)")
+    # DCN outer tier: the fast-tier fused GEMM+RS stays Pallas, the slow
+    # outer ring becomes an XLA psum_scatter (same surviving-chunk layout,
+    # same segment order — only the transport changes)
+    dcn_outer = ctx.is_dcn_axis(outer)
     no, ni = ctx.axis_size(outer), ctx.axis_size(inner)
     n, M, _K, N, m_seg, cfg = _validate(ctx, a, b, axes, cfg)
     chunk = no * m_seg
@@ -215,7 +247,11 @@ def _gemm_rs_2d(ctx, a, b, axes, cfg, out_dtype, ws=None, stage=None):
                 in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
                 **common,
             )(a_shard, b_shard)
-        out = _rs_call(outer, mesh_axes, no, red).astype(out_dtype)
+        if dcn_outer:
+            out = lax.psum_scatter(red, outer, scatter_dimension=0,
+                                   tiled=True).astype(out_dtype)
+        else:
+            out = _rs_call(outer, mesh_axes, no, red).astype(out_dtype)
         if persistent:
             return (out, ws_o.reshape(persist[0].shape),
                     st_o.reshape(persist[1].shape))
@@ -335,6 +371,10 @@ def gemm_rs(ctx: ShmemContext, a: jax.Array, b: jax.Array,
     axis = _norm_axis(ctx, axis)
     if isinstance(axis, tuple):
         return _gemm_rs_2d(ctx, a, b, axis, cfg, out_dtype)
+    if ctx.is_dcn_axis(axis):
+        # slice-crossing scatter axis: XLA collectives end to end (remote
+        # DMA cannot cross DCN) — mirrors reduce_scatter/all_gather routing
+        return _gemm_rs_xla(ctx, a, b, axis, out_dtype)
     cfg = cfg or _default_cfg(ctx, a, b, axis)
     out_dtype = out_dtype or a.dtype
     acc_dtype = jnp.float32 if out_dtype == jnp.bfloat16 else out_dtype
@@ -366,6 +406,10 @@ def gemm_rs_ws(ctx: ShmemContext, a: jax.Array, b: jax.Array,
     if isinstance(axis, tuple):
         return _gemm_rs_2d(ctx, a, b, axis, cfg, out_dtype,
                            ws=ws, stage=stage)
+    if ctx.is_dcn_axis(axis):
+        # XLA path needs no symmetric workspace; thread the buffers back
+        # untouched so callers' donate/scan plumbing is shape-stable
+        return _gemm_rs_xla(ctx, a, b, axis, out_dtype), ws, stage
     cfg = cfg or _default_cfg(ctx, a, b, axis)
     out_dtype = out_dtype or a.dtype
     acc_dtype = jnp.float32 if out_dtype == jnp.bfloat16 else out_dtype

@@ -1,38 +1,58 @@
-"""The decode engine: drives ``models.llama.decode_step_paged`` under
-``jax.jit`` so the hot loop is ONE compiled step per token regardless of
-arrivals, finishes or preemptions.
+"""The decode engine: drives ``models.llama.decode_multistep_paged``
+under ``jax.jit`` so the hot loop is ONE compiled program per DISPATCH —
+and one dispatch advances every slot up to ``decode_horizon`` tokens.
 
 Shape discipline (the TPU contract):
 
 - the batch is ``num_slots`` fixed rows; a request occupies one slot from
   admission to finish. Inactive rows are parked on the reserved scratch
-  page (page 0) with pos 0 — their writes land on scratch, their logits
+  page (page 0) with pos 0 — their writes land on scratch, their tokens
   are ignored, and the compiled step never sees a shape change.
 - the page pool rides the jitted step as a DONATED argument (on backends
   that support donation), so the per-layer scatter of the new (k, v)
   updates pages in place — no pool-sized copy per token.
-- prefill runs per request OUTSIDE the batch (shape-keyed by prompt
-  length) into a small contiguous cache — the layout the full-sequence
-  kernels want — then ``cache_to_pages`` hands the pages to the pool.
-  This is the prefill/decode interleave: admissions prefill between
-  decode steps, the decode batch itself never stalls on a long prompt.
+- prefill runs per request OUTSIDE the batch into a small contiguous
+  cache — the layout the full-sequence kernels want — then
+  ``cache_to_pages`` hands the pages to the pool. Prompts are padded to
+  BUCKET lengths (power-of-two by default) with an attention length mask,
+  so the prefill compile cache is O(log max_prompt), not one program per
+  distinct prompt length.
+
+Device-resident hot loop (the host/device split):
+
+- sampling is fused: the jitted program argmaxes on device and the host
+  downloads a ``[horizon, num_slots]`` int32 token slab — never the
+  ``[B, vocab]`` logits.
+- ``token``/``pos``/``block_table`` live on device between dispatches;
+  the host keeps numpy MIRRORS for control decisions (growth, finishes,
+  preemption) and re-uploads only after a control-plane change (counted
+  as ``host_syncs`` — a quiet dispatch uploads nothing but the per-slot
+  ``limit`` word).
+- ``decode_horizon=K`` runs K fused steps in one ``lax.scan`` dispatch;
+  the per-slot ``limit`` input clamps each row to
+  ``min(K, budget, pre-ensured page capacity)`` so no slot can outgrow
+  its pages mid-scan, and rows freeze on EOS. The engine reconciles
+  scheduler state (finishes, growth, preemption) every K tokens — K=1
+  preserves per-token semantics exactly.
 
 Determinism: greedy argmax decode + deterministic allocation and policies
 mean a request's tokens are a pure function of (params, prompt) — a
 preempted-and-restarted request regenerates exactly the tokens it lost,
-and a contended run is bit-identical per request to an uncontended one
-(tests/test_serving.py asserts both).
+and a contended run is bit-identical per request to an uncontended one,
+at every horizon (tests/test_serving.py asserts both for K in {1, 4}).
 """
 
 from __future__ import annotations
 
 import time
+from collections import deque
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from triton_dist_tpu.models.llama import (LlamaConfig, decode_step_paged,
+from triton_dist_tpu.models.llama import (LlamaConfig,
+                                          decode_multistep_paged,
                                           init_kv_cache, init_page_pool,
                                           prefill)
 from triton_dist_tpu.serving.kv_pool import KVPagePool, cache_to_pages
@@ -50,13 +70,25 @@ class ServingEngine:
     ``ffn(h, p) -> [B, D]`` plugs a custom per-layer FFN into the decode
     step (e.g. ``moe_mlp_ep_overlap`` for the EP-MoE serving path, the
     same hook ``decode_step``/``decode_step_sp`` expose).
+
+    ``decode_horizon`` is K, the inner scanned steps per dispatch (see
+    module docstring). ``prefill_buckets`` is ``"pow2"`` (pad prompts to
+    the next power of two, floor 8), an explicit ascending tuple of
+    bucket lengths, or ``None`` for exact-length prefill (one compile per
+    distinct prompt length — the pre-bucketing behavior, bit-exact).
+    ``eos_id`` enables early finish: a slot freezes on device the step it
+    emits ``eos_id`` and the host finishes the request at reconcile.
     """
 
     def __init__(self, params: dict, cfg: LlamaConfig, num_slots: int = 4,
                  page_size: int = 16, num_pages: int = 64,
                  pages_per_seq: int = 8, ffn=None,
                  max_prefills_per_step: int | None = None,
-                 metrics: ServingMetrics | None = None):
+                 metrics: ServingMetrics | None = None,
+                 decode_horizon: int = 1,
+                 prefill_buckets="pow2",
+                 eos_id: int | None = None):
+        assert decode_horizon >= 1
         self.params = params
         self.cfg = cfg
         self.page_size = page_size
@@ -64,6 +96,12 @@ class ServingEngine:
         self.num_slots = num_slots
         self.max_prefills_per_step = max_prefills_per_step
         self.metrics = metrics or ServingMetrics()
+        self.decode_horizon = decode_horizon
+        self.eos_id = eos_id
+        if prefill_buckets is not None and prefill_buckets != "pow2":
+            prefill_buckets = tuple(sorted(int(b) for b in prefill_buckets))
+            assert prefill_buckets, "bucket list must be non-empty"
+        self.prefill_buckets = prefill_buckets
 
         self.pool = init_page_pool(cfg, num_pages + 1, page_size)
         self.alloc = KVPagePool(num_pages + 1, page_size, reserved=1)
@@ -72,25 +110,32 @@ class ServingEngine:
         self._steps = 0
         self._finished: list[Request] = []
 
-        # host-side mirrors of the per-slot device inputs
+        # host-side mirrors of the per-slot device state (control plane);
+        # the device copies below are authoritative between dispatches
         self._token = np.zeros(num_slots, np.int32)
         self._pos = np.zeros(num_slots, np.int32)
         self._bt = np.zeros((num_slots, pages_per_seq), np.int32)
+        self._token_dev = jnp.asarray(self._token)
+        self._pos_dev = jnp.asarray(self._pos)
+        self._bt_dev = jnp.asarray(self._bt)
+        self._dirty = False                 # mirrors diverged from device
 
-        step = lambda p, t, pos, pages, bt: decode_step_paged(  # noqa: E731
-            p, t, pos, cfg, pages, bt, ffn=ffn)
+        K = decode_horizon
+        step = lambda p, t, pos, pages, bt, lim: decode_multistep_paged(  # noqa: E731
+            p, t, pos, cfg, pages, bt, lim, horizon=K, eos_id=eos_id,
+            ffn=ffn)
         if jax.default_backend() == "cpu":
             self._step = jax.jit(step)      # CPU: donation unsupported
         else:
             self._step = jax.jit(step, donate_argnums=(3,))
-        self._prefill_jit = {}              # keyed by (prompt_len, cache_len)
+        self._prefill_jit = {}              # keyed by (bucket, cache_len)
 
     # -- request intake ---------------------------------------------------
     def submit(self, prompt, max_new_tokens: int, rid: int | None = None
                ) -> int:
         prompt = tuple(int(t) for t in np.asarray(prompt).reshape(-1))
         assert prompt and max_new_tokens >= 1
-        total = len(prompt) + max_new_tokens - 1   # KV the request will hold
+        total = len(prompt) + max_new_tokens - 1   # KV the request may hold
         need = -(-total // self.page_size)
         assert need <= self.pages_per_seq, (
             f"request needs {need} pages > pages_per_seq "
@@ -102,6 +147,7 @@ class ServingEngine:
             rid = self._next_rid
         self._next_rid = max(self._next_rid, rid + 1)
         req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
+                      eos_token=self.eos_id,
                       submit_step=self._steps,
                       submit_time=time.perf_counter())
         self.sched.submit(req)
@@ -109,24 +155,51 @@ class ServingEngine:
         return rid
 
     # -- prefill + admission ----------------------------------------------
-    def _prefill_fn(self, prompt_len: int, cache_len: int):
-        key = (prompt_len, cache_len)
+    def _bucket_len(self, prompt_len: int) -> int:
+        """Bucket (padded) length for a prompt — the compile-cache key."""
+        if self.prefill_buckets is None:
+            return prompt_len
+        if self.prefill_buckets == "pow2":
+            b = 8
+            while b < prompt_len:
+                b *= 2
+            return b
+        for b in self.prefill_buckets:
+            if b >= prompt_len:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest prefill "
+            f"bucket {self.prefill_buckets[-1]}")
+
+    def _prefill_fn(self, bucket: int, cache_len: int):
+        key = (bucket, cache_len)
         if key not in self._prefill_jit:
             cfg = self.cfg
-            self._prefill_jit[key] = jax.jit(
-                lambda p, t, c: prefill(p, t, cfg, c))
+            if self.prefill_buckets is None:
+                # exact mode: the legacy no-length trace, bit-for-bit
+                self._prefill_jit[key] = jax.jit(
+                    lambda p, t, c, n: prefill(p, t, cfg, c))
+            else:
+                self._prefill_jit[key] = jax.jit(
+                    lambda p, t, c, n: prefill(p, t, cfg, c, length=n))
         return self._prefill_jit[key]
 
     def _admit(self, slot: int, req: Request) -> None:
         sp = len(req.prompt)
+        bucket = self._bucket_len(sp)
         n_pages = -(-sp // self.page_size)
         pages = self.alloc.alloc(req.rid, n_pages)
         assert pages is not None, "admissible() guaranteed the pages"
-        cache_len = n_pages * self.page_size
+        cache_len = -(-bucket // self.page_size) * self.page_size
         cache = init_kv_cache(self.cfg, 1, cache_len)
-        tokens = jnp.asarray(np.asarray(req.prompt, np.int32)[None])
-        logits, cache = self._prefill_fn(sp, cache_len)(
-            self.params, tokens, cache)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :sp] = req.prompt
+        logits, cache = self._prefill_fn(bucket, cache_len)(
+            self.params, jnp.asarray(toks), cache,
+            jnp.asarray([sp], np.int32))
+        # only the prompt's pages are handed off; in-page padding tail
+        # rows hold padded K/V but decode overwrites position p before
+        # any read of kv_len > p sees it
         bt_row = jnp.asarray(np.asarray(pages, np.int32)[None])
         self.pool = {
             "k": cache_to_pages(cache["k"], self.pool["k"], bt_row),
@@ -146,7 +219,8 @@ class ServingEngine:
         self._pos[slot] = sp
         row = self.alloc.block_table_row(req.rid, self.pages_per_seq)
         self._bt[slot] = np.asarray(row, np.int32)
-        if req.done:                      # max_new_tokens == 1: no decode
+        self._dirty = True
+        if req.done:            # max_new_tokens == 1 or tok0 == eos_id
             self._finish(slot)
 
     # -- slot teardown ----------------------------------------------------
@@ -171,11 +245,14 @@ class ServingEngine:
         self._token[slot] = 0
         self._pos[slot] = 0
         self._bt[slot] = 0
+        self._dirty = True
 
     # -- one engine iteration ---------------------------------------------
     def step(self) -> bool:
-        """Admissions (prefill) + one batched decode step. Returns False
-        when there is nothing to do (engine idle)."""
+        """Admissions (prefill) + one batched decode dispatch (up to
+        ``decode_horizon`` tokens per slot). Returns False when there is
+        nothing to do (engine idle)."""
+        t_begin = time.perf_counter()
         if self.sched.idle:
             return False
 
@@ -193,49 +270,94 @@ class ServingEngine:
             admitted += 1
 
         # allocate-on-decode growth, preempting (youngest first) when dry.
-        # Slot order is index order — deterministic.
+        # Slot order is index order — deterministic. The FIRST step is
+        # guaranteed (preempt until a page frees); the rest of the horizon
+        # is opportunistic: extend capacity page by page WITHOUT
+        # preempting, and clamp the slot's limit where growth stops — the
+        # auto-clamp that keeps a slot inside its pre-ensured pages
+        # mid-scan.
+        limits = np.zeros(self.num_slots, np.int32)
         for slot in range(self.num_slots):
             req = self.sched.slots[slot]
             if req is None:
                 continue
-            while not self.alloc.ensure(req.rid, int(self._pos[slot]) + 1):
+            pos = int(self._pos[slot])
+            while not self.alloc.ensure(req.rid, pos + 1):
                 victim = self.sched.pick_victim(exclude_slot=slot)
                 if victim is None:
                     raise RuntimeError(
                         f"KV pool too small: request {req.rid} needs a page "
                         "with no preemptible peer left")
                 self._preempt(victim)
-            # refresh AFTER growth — the kernel writes this step's (k, v)
-            # at bt[slot, pos // page_size], which may be the page ensure()
-            # just allocated
-            self._bt[slot] = np.asarray(
+            want = min(self.decode_horizon, req.remaining)
+            lim = 1
+            while lim < want and self.alloc.ensure(req.rid, pos + lim + 1):
+                lim += 1
+            limits[slot] = lim
+            # refresh AFTER growth — the kernel writes this scan's (k, v)
+            # into pages ensure() may just have allocated
+            row = np.asarray(
                 self.alloc.block_table_row(req.rid, self.pages_per_seq),
                 np.int32)
+            if not np.array_equal(row, self._bt[slot]):
+                self._bt[slot] = row
+                self._dirty = True
+        # a slot preempted while a LATER slot grew already has its limit
+        # computed — zero it (its mirrors are parked; writes go to scratch)
+        for slot in range(self.num_slots):
+            if self.sched.slots[slot] is None:
+                limits[slot] = 0
 
         active = self.sched.active
         if not active:
             return not self.sched.idle
 
-        t0 = time.perf_counter()
-        logits, self.pool = self._step(
-            self.params, jnp.asarray(self._token), jnp.asarray(self._pos),
-            self.pool, jnp.asarray(self._bt))
-        nxt = np.argmax(np.asarray(logits), axis=-1).astype(np.int32)
-        dt = time.perf_counter() - t0
+        if self._dirty:
+            self._token_dev = jnp.asarray(self._token)
+            self._pos_dev = jnp.asarray(self._pos)
+            self._bt_dev = jnp.asarray(self._bt)
+            self._dirty = False
+            self.metrics.inc("host_syncs")
+
+        t_disp = time.perf_counter()
+        toks, self._token_dev, self._pos_dev, self.pool = self._step(
+            self.params, self._token_dev, self._pos_dev, self.pool,
+            self._bt_dev, jnp.asarray(limits))
+        slab = np.asarray(toks)            # [horizon, B] — blocks on device
+        t_done = time.perf_counter()
 
         self._steps += 1
-        self.metrics.inc("decode_steps")
+        self.metrics.inc("dispatches")
+        self.metrics.inc("decode_steps", int(limits.max()))
         self.metrics.observe("queue_depth", self.sched.queue_depth)
         self.metrics.observe("pool_occupancy", self.alloc.occupancy())
         self.metrics.observe("active_slots", len(active))
+
+        n_tokens = 0
         for slot, req in active:
-            req.generated.append(int(nxt[slot]))
-            self._token[slot] = nxt[slot]
-            self._pos[slot] += 1
-            self.metrics.inc("tokens_generated")
-            self.metrics.observe("tok_latency_s", dt)
+            emitted = 0
+            for i in range(int(limits[slot])):
+                req.generated.append(int(slab[i, slot]))
+                emitted += 1
+                self.metrics.inc("tokens_generated")
+                if req.done:               # budget exhausted or EOS
+                    break
+            # the device froze this row after the same ``emitted`` steps
+            # (limit clamp / EOS mask), so the mirrors stay equal to the
+            # device carry — a continuing slot costs no re-upload
+            self._token[slot] = slab[emitted - 1, slot]
+            self._pos[slot] += emitted
+            n_tokens += emitted
             if req.done:
                 self._finish(slot)
+
+        dev_dt = t_done - t_disp
+        host_dt = (t_disp - t_begin) + (time.perf_counter() - t_done)
+        self.metrics.observe("step_device_s", dev_dt)
+        self.metrics.observe("step_host_s", host_dt)
+        per_tok = (dev_dt + host_dt) / max(n_tokens, 1)
+        for _ in range(n_tokens):
+            self.metrics.observe("tok_latency_s", per_tok)
         return True
 
     def run(self, max_steps: int | None = None,
@@ -243,30 +365,38 @@ class ServingEngine:
         """Drive ``step()`` until idle (or ``max_steps``). ``arrivals`` is
         an optional iterable of (step_index, prompt, max_new_tokens)
         sorted by step — the synthetic-trace replay hook serve_sim uses.
-        Returns {rid: generated tokens} for every finished request."""
-        pending = list(arrivals or [])
-        results: dict[int, list[int]] = {}
+        Returns {rid: generated tokens} for FINISHED requests only — a
+        truncated run (``max_steps`` hit) simply omits the unfinished."""
+        pending = deque(arrivals or [])
         i = 0
         while max_steps is None or i < max_steps:
             while pending and pending[0][0] <= i:
-                _, prompt, mnt = pending.pop(0)
-                results_key = self.submit(prompt, mnt)
-                results[results_key] = None
+                _, prompt, mnt = pending.popleft()
+                self.submit(prompt, mnt)
             if not self.step() and not pending:
                 break
             i += 1
-        for req in self._all_requests():
-            if req.state.value == "finished":
-                results[req.rid] = list(req.generated)
-        return results
+        return {req.rid: list(req.generated) for req in self._finished}
 
-    def _all_requests(self):
-        seen = {}
-        for r in (list(self.sched.queue)
-                  + [s for s in self.sched.slots if s is not None]
-                  + self._finished):
-            seen[r.rid] = r
-        return seen.values()
+    # -- introspection ----------------------------------------------------
+    @property
+    def compile_stats(self) -> dict:
+        """Compile counts for the hot loop: the decode program (should be
+        exactly 1 however mixed the traffic) and the prefill programs
+        (bounded by the bucket count). Uses the jit-internal cache size
+        when available, falling back to the program-key count."""
+        def n(fn, fallback):
+            try:
+                return int(fn._cache_size())
+            except Exception:
+                return fallback
+
+        prefills = sum(n(f, 1) for f in self._prefill_jit.values())
+        return {
+            "decode_compiles": n(self._step, 1 if self._steps else 0),
+            "prefill_compiles": prefills,
+            "prefill_programs": len(self._prefill_jit),
+        }
 
 
 __all__ = ["ServingEngine"]

@@ -70,10 +70,16 @@ class ServingMetrics:
     """The engine's instrument panel (ISSUE 2 tentpole part 4):
 
     counters — tokens generated, requests submitted/finished, prefills,
-    preemptions, decode steps;
+    preemptions, decode steps (inner device steps: += horizon per
+    dispatch), dispatches (host→device decode launches — at horizon K one
+    dispatch covers up to K steps, so dispatches ≲ decode_steps / K),
+    host_syncs (dispatches that had to re-upload host slot state after a
+    control-plane change — admission, finish, preemption, growth; a quiet
+    dispatch reuses the device-resident carry and uploads nothing);
     histograms — TTFT (s), per-token latency (s), queue depth (sampled
     per step), pool occupancy (fraction, sampled per step), batch
-    occupancy (active slots per step).
+    occupancy (active slots per step), per-dispatch device time and host
+    overhead (s) — the device/host split bench.py reports.
     """
 
     def __init__(self):
@@ -83,6 +89,8 @@ class ServingMetrics:
             "prefills": 0,
             "preemptions": 0,
             "decode_steps": 0,
+            "dispatches": 0,
+            "host_syncs": 0,
             "tokens_generated": 0,
         }
         self.hist = {
@@ -91,6 +99,8 @@ class ServingMetrics:
             "queue_depth": Histogram(),
             "pool_occupancy": Histogram(),
             "active_slots": Histogram(),
+            "step_device_s": Histogram(),
+            "step_host_s": Histogram(),
         }
         self._t0 = time.perf_counter()
 

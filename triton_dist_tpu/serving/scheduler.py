@@ -43,6 +43,7 @@ class Request:
     rid: int
     prompt: tuple[int, ...]            # token ids
     max_new_tokens: int
+    eos_token: int | None = None       # finish early when generated
     state: RequestState = RequestState.QUEUED
     generated: list[int] = dataclasses.field(default_factory=list)
     preemptions: int = 0
@@ -62,7 +63,15 @@ class Request:
 
     @property
     def done(self) -> bool:
-        return len(self.generated) >= self.max_new_tokens
+        if len(self.generated) >= self.max_new_tokens:
+            return True
+        return (self.eos_token is not None and bool(self.generated)
+                and self.generated[-1] == self.eos_token)
+
+    @property
+    def remaining(self) -> int:
+        """Token budget left (0 once done — EOS or max_new_tokens)."""
+        return 0 if self.done else self.max_new_tokens - len(self.generated)
 
 
 class ContinuousBatchingScheduler:
