@@ -49,6 +49,10 @@ p.add_argument("--decode-horizon", type=int, default=1,
 p.add_argument("--prefill-buckets", default="pow2",
                help='"pow2" (default), "exact", or a comma-separated '
                     "ascending list of bucket lengths, e.g. 8,16,32")
+p.add_argument("--prefill-chunk", type=int, default=None,
+               help="chunked paged prefill: tokens per co-scheduled chunk "
+                    "(≤1 chunk per step rides beside the decode dispatch; "
+                    "omit for the bucketed inline prefill path)")
 args = p.parse_args()
 
 if args.prefill_buckets == "pow2":
@@ -64,7 +68,8 @@ eng = ServingEngine(params, cfg, num_slots=args.slots,
                     page_size=args.page_size, num_pages=args.pages,
                     pages_per_seq=args.pages_per_seq,
                     decode_horizon=args.decode_horizon,
-                    prefill_buckets=buckets)
+                    prefill_buckets=buckets,
+                    prefill_chunk=args.prefill_chunk)
 
 rng = np.random.RandomState(args.seed)
 max_plen = min(args.pages_per_seq * args.page_size - args.max_new, 24)
@@ -94,4 +99,22 @@ if args.tokens:
             "ttft_steps": req.first_token_step - req.submit_step,
         }))
 print(json.dumps({"compile_stats": eng.compile_stats}), file=sys.stderr)
+
+# prefill-stall / TTFT-split summary: the numbers chunked prefill moves
+# (per-step decode stall bound, queue-vs-prefill TTFT split)
+snap = eng.metrics.snapshot()
+us = lambda v: None if v is None else round(v * 1e6, 1)
+print(json.dumps({
+    "prefill_chunk": args.prefill_chunk,
+    "prefill_chunks": snap["prefill_chunks"],
+    "prefill_stall_us": {k: us(snap["prefill_stall_s"][k])
+                         for k in ("mean", "p50", "p99", "max")},
+    "decode_stall_us": {k: us(snap["decode_stall_s"][k])
+                        for k in ("mean", "p50", "p99", "max")},
+    "step_prefill_tokens_max": snap["step_prefill_tokens"]["max"],
+    "ttft_queue_us": {k: us(snap["ttft_queue_s"][k])
+                      for k in ("mean", "p99")},
+    "ttft_prefill_us": {k: us(snap["ttft_prefill_s"][k])
+                        for k in ("mean", "p99")},
+}), file=sys.stderr)
 eng.metrics.emit()

@@ -208,3 +208,137 @@ def test_ag_gemm_dcn_axis_order_enforced(ctx2d, monkeypatch):
     with pytest.raises(ValueError, match="slow tier"):
         ag_gemm(ctx2d, ctx2d.shard(a, P(("a", "b"))),
                 ctx2d.shard(b, P(None, ("a", "b"))), axis=("a", "b"))
+
+
+def _ag_moe_golden(tokens, ids, weights):
+    t, idn, wn = (np.asarray(tokens), np.asarray(ids),
+                  np.asarray(weights, np.float32))
+    out = np.zeros((t.shape[0], wn.shape[-1]), np.float32)
+    for r in range(t.shape[0]):
+        if idn[r] >= 0:
+            out[r] = t[r] @ wn[idn[r]]
+    return out
+
+
+def _moe_rs_golden(tokens, ids, tw, weights):
+    t, idn = np.asarray(tokens), np.asarray(ids)
+    wn, twn = np.asarray(weights, np.float32), np.asarray(tw, np.float32)
+    T, topk = twn.shape
+    N = wn.shape[-1]
+    rows = np.zeros((t.shape[0], N), np.float32)
+    for r in range(t.shape[0]):
+        if idn[r] >= 0:
+            rows[r] = t[r] @ wn[idn[r]]
+    return (rows.reshape(T, topk, N) * twn[..., None]).sum(axis=1)
+
+
+def test_ag_moe_dcn(ctx2d, dcn_major):
+    """Single-axis AG-MoE over a DCN axis: routed to XLA all_gather +
+    masked dense per-expert matmul end to end, same golden as the fused
+    Pallas path (invalid -1 ids included)."""
+    from triton_dist_tpu.ops.moe import ag_moe_group_gemm
+    na = 2
+    E, H, N, T = 4, 64, na * 64, na * 16
+    tokens = jax.random.normal(jax.random.key(0), (T, H), jnp.float32)
+    ids = jax.random.randint(jax.random.key(1), (T,), -1, E)
+    weights = jax.random.normal(jax.random.key(2), (E, H, N),
+                                jnp.float32) * 0.1
+    out = jax.jit(lambda t, i, w: ag_moe_group_gemm(
+        ctx2d, t, i, w, axis="a"))(
+        ctx2d.shard(tokens, P("a")), ctx2d.shard(ids, P("a")),
+        ctx2d.shard(weights, P(None, None, "a")))
+    assert_allclose(np.asarray(out), _ag_moe_golden(tokens, ids, weights),
+                    atol=1e-3, rtol=1e-3)
+
+
+def test_ag_moe_2tier_dcn_prefix(ctx2d, dcn_major):
+    """Hierarchical AG-MoE with the outer tier on DCN: the whole gather
+    rides XLA collectives (correctness-first fallback — the fused fast
+    tier is ICI-only), rows in P((a, b)) order."""
+    from triton_dist_tpu.ops.moe import ag_moe_group_gemm
+    n = 6
+    E, H, N, T = 6, 64, n * 64, n * 8
+    tokens = jax.random.normal(jax.random.key(0), (T, H), jnp.float32)
+    ids = jax.random.randint(jax.random.key(1), (T,), 0, E)
+    weights = jax.random.normal(jax.random.key(2), (E, H, N),
+                                jnp.float32) * 0.1
+    axes = ("a", "b")
+    out = jax.jit(lambda t, i, w: ag_moe_group_gemm(
+        ctx2d, t, i, w, axis=axes))(
+        ctx2d.shard(tokens, P(axes)), ctx2d.shard(ids, P(axes)),
+        ctx2d.shard(weights, P(None, None, axes)))
+    assert_allclose(np.asarray(out), _ag_moe_golden(tokens, ids, weights),
+                    atol=1e-3, rtol=1e-3)
+
+
+def test_moe_reduce_rs_dcn(ctx2d, dcn_major):
+    """Single-axis GroupGEMM-RS over a DCN axis: routed to masked dense
+    per-expert matmul + psum_scatter end to end (the op's golden)."""
+    from triton_dist_tpu.ops.moe import moe_reduce_rs
+    na = 2
+    E, K, N, T, topk = 4, na * 64, 64, na * 8, 2
+    tokens = jax.random.normal(jax.random.key(0), (T * topk, K), jnp.float32)
+    ids = jax.random.randint(jax.random.key(1), (T * topk,), 0, E)
+    tw = jax.nn.softmax(jax.random.normal(jax.random.key(2), (T, topk)), -1)
+    weights = jax.random.normal(jax.random.key(3), (E, K, N),
+                                jnp.float32) * 0.1
+    out = jax.jit(lambda t, i, w, ww: moe_reduce_rs(
+        ctx2d, t, i, ww, w, axis="a"))(
+        ctx2d.shard(tokens, P(None, "a")), ids, weights, tw)
+    assert_allclose(np.asarray(out), _moe_rs_golden(tokens, ids, tw, weights),
+                    atol=1e-3, rtol=1e-3)
+
+
+def test_moe_reduce_rs_2tier_dcn_outer(ctx2d, dcn_major):
+    """Hierarchical GroupGEMM-RS with the OUTER tier on DCN: the fused
+    GroupGEMM + fast-tier RS stays Pallas, the slow outer ring becomes an
+    XLA psum_scatter — semantics (and segment order) unchanged."""
+    from triton_dist_tpu.ops.moe import moe_reduce_rs
+    n = 6
+    axes = ("a", "b")
+    E, K, N, T, topk = 6, n * 32, 64, n * 4, 2
+    tokens = jax.random.normal(jax.random.key(0), (T * topk, K), jnp.float32)
+    ids = jax.random.randint(jax.random.key(1), (T * topk,), 0, E)
+    tw = jax.nn.softmax(jax.random.normal(jax.random.key(2), (T, topk)), -1)
+    weights = jax.random.normal(jax.random.key(3), (E, K, N),
+                                jnp.float32) * 0.1
+    try:
+        out = jax.jit(lambda t, i, w, ww: moe_reduce_rs(
+            ctx2d, t, i, ww, w, axis=axes, block_m=16))(
+            ctx2d.shard(tokens, P(None, axes)), ids, weights, tw)
+    except NotImplementedError as e:   # pragma: no cover
+        pytest.skip(f"multi-axis Pallas DMA unavailable: {e}")
+    assert_allclose(np.asarray(out), _moe_rs_golden(tokens, ids, tw, weights),
+                    atol=1e-3, rtol=1e-3)
+
+
+def test_ag_moe_dcn_axis_order_enforced(ctx2d, monkeypatch):
+    """A DCN axis buried BEHIND an ICI axis must be rejected loudly —
+    the fast-tier gather is remote DMA, which cannot cross DCN."""
+    from triton_dist_tpu.ops.moe import ag_moe_group_gemm
+    monkeypatch.setenv("TDT_DCN_AXES", "b")
+    n = 6
+    E, H, N, T = 6, 64, n * 64, n * 8
+    tokens = jax.random.normal(jax.random.key(0), (T, H), jnp.float32)
+    ids = jax.random.randint(jax.random.key(1), (T,), 0, E)
+    weights = jax.random.normal(jax.random.key(2), (E, H, N), jnp.float32)
+    with pytest.raises(ValueError, match="slow tier"):
+        ag_moe_group_gemm(ctx2d, ctx2d.shard(tokens, P(("a", "b"))),
+                          ctx2d.shard(ids, P(("a", "b"))),
+                          ctx2d.shard(weights, P(None, None, ("a", "b"))),
+                          axis=("a", "b"))
+
+
+def test_moe_reduce_rs_dcn_axis_order_enforced(ctx2d, monkeypatch):
+    """A DCN axis buried BEHIND an ICI axis must be rejected loudly."""
+    from triton_dist_tpu.ops.moe import moe_reduce_rs
+    monkeypatch.setenv("TDT_DCN_AXES", "b")
+    n = 6
+    E, K, N, T, topk = 6, n * 32, 64, n * 4, 2
+    tokens = jax.random.normal(jax.random.key(0), (T * topk, K), jnp.float32)
+    ids = jax.random.randint(jax.random.key(1), (T * topk,), 0, E)
+    tw = jax.nn.softmax(jax.random.normal(jax.random.key(2), (T, topk)), -1)
+    weights = jax.random.normal(jax.random.key(3), (E, K, N), jnp.float32)
+    with pytest.raises(ValueError, match="slow tier"):
+        moe_reduce_rs(ctx2d, ctx2d.shard(tokens, P(None, ("a", "b"))),
+                      ids, tw, weights, axis=("a", "b"))

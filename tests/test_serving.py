@@ -226,13 +226,16 @@ def golden_trace(tiny_model):
 
 
 @pytest.mark.parametrize("horizon", [1, 4])
+@pytest.mark.parametrize("chunk", [None, 64, 256])
 def test_trace_bit_identical_under_preemption(tiny_model, golden_trace,
-                                              horizon):
+                                              chunk, horizon):
     """The acceptance trace: 50 requests through a 4-slot engine with a
     pool small enough to force preemptions. Every request's tokens must be
     bit-identical to the same request decoded in a single-batch engine
-    with an uncontended pool — including every preempted request, and at
-    every decode horizon (K=1 per-token semantics, K=4 scanned)."""
+    with an uncontended pool — including every preempted request, at
+    every decode horizon (K=1 per-token semantics, K=4 scanned), and on
+    BOTH admit paths (bucketed inline prefill and chunked paged prefill —
+    ISSUE 5's ``prefill_chunk=None`` bit-for-bit guarantee)."""
     cfg, params = tiny_model
     reqs, gold_rids, gold = golden_trace
 
@@ -240,12 +243,20 @@ def test_trace_bit_identical_under_preemption(tiny_model, golden_trace,
     # growth must preempt. Arrivals staggered so admission interleaves
     # with decode of earlier requests.
     eng = ServingEngine(params, cfg, num_slots=4, page_size=8, num_pages=9,
-                        pages_per_seq=8, decode_horizon=horizon)
+                        pages_per_seq=8, decode_horizon=horizon,
+                        prefill_chunk=chunk)
     arrivals = [(i // 2, p, m) for i, (p, m) in enumerate(reqs)]
     res = eng.run(max_steps=5000, arrivals=arrivals)
     snap = eng.metrics.snapshot()
     assert snap["requests_finished"] == len(reqs)
     assert snap["preemptions"] >= 1, "trace was meant to force preemption"
+    if chunk is not None:
+        # every finished request went through the chunk program at least
+        # once (admissions preempted at cursor 0 may dispatch no chunk) —
+        # and the bucketed prefill programs never compiled
+        assert snap["prefill_chunks"] >= len(reqs)
+        assert eng.compile_stats["prefill_programs"] == 0
+        assert eng.compile_stats["prefill_chunk_compiles"] == 1
 
     preempted = [r for r in eng._finished if r.preemptions > 0]
     assert preempted, "no request actually lost work to preemption"
@@ -384,3 +395,125 @@ def test_dispatch_count_bound(tiny_model, horizon):
         # only admission + page growth dirty the mirrors; the steady-state
         # dispatch re-uploads nothing
         assert c["host_syncs"] < c["dispatches"]
+
+
+# ---------------------------------------------------------------------------
+# chunked paged prefill (ISSUE 5)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.quick
+def test_compile_count_guard_chunked(tiny_model, monkeypatch):
+    """With prefill_chunk set, 20 DISTINCT prompt lengths compile exactly
+    TWO ServingEngine programs total: one decode step and one chunk
+    program. The bucketed prefill programs never compile — start offset
+    and prompt length are runtime scalars of the chunk program."""
+    cfg, params = tiny_model
+    real_jit = jax.jit
+    made = []
+
+    def counting_jit(fun, *a, **k):
+        made.append(fun)
+        return real_jit(fun, *a, **k)
+
+    monkeypatch.setattr(jax, "jit", counting_jit)
+    eng = ServingEngine(params, cfg, num_slots=4, page_size=8, num_pages=32,
+                        pages_per_seq=8, decode_horizon=2,
+                        prefill_buckets=(8, 16, 32), prefill_chunk=8)
+    rng = np.random.RandomState(3)
+    arrivals = []
+    for i, plen in enumerate(range(3, 23)):    # 20 distinct prompt lengths
+        prompt = [int(t) for t in rng.randint(1, cfg.vocab_size, size=plen)]
+        arrivals.append((i, prompt, int(rng.randint(2, 8))))
+    res = eng.run(max_steps=5000, arrivals=arrivals)
+    assert len(res) == 20
+    stats = eng.compile_stats
+    assert stats["decode_compiles"] == 1
+    assert stats["prefill_chunk_compiles"] == 1
+    assert stats["prefill_programs"] == 0
+    assert stats["prefill_compiles"] == 0
+    ours = [f for f in made
+            if "ServingEngine" in getattr(f, "__qualname__", "")]
+    assert len(ours) == 2                      # decode + chunk, nothing else
+
+
+@pytest.mark.quick
+def test_mid_prefill_preemption_resumes_at_cursor(tiny_model):
+    """A request preempted MID-prefill (cursor between chunks) resumes at
+    its chunk cursor, not from chunk 0: pages already filled survive the
+    eviction (free_tail keeps them) and total chunk dispatches equal the
+    zero-rework count ceil(10/4) + ceil(40/4) = 13. A from-scratch restart
+    would dispatch strictly more. Tokens stay bit-identical to solo."""
+    cfg, params = tiny_model
+    rng = np.random.RandomState(11)
+    pa = [int(t) for t in rng.randint(1, cfg.vocab_size, size=10)]
+    pb = [int(t) for t in rng.randint(1, cfg.vocab_size, size=40)]
+
+    def solo(prompt, mnt):
+        e = ServingEngine(params, cfg, num_slots=1, page_size=8, num_pages=8,
+                          pages_per_seq=7, prefill_chunk=4)
+        rid = e.submit(prompt, mnt)
+        return e.run(max_steps=2000)[rid]
+
+    gold_a, gold_b = solo(pa, 21), solo(pb, 2)
+
+    # contended: B's 40-token prompt needs 5 pages mid-prefill while A's
+    # decode tail grows — the pool (6 usable pages) forces a mid-prefill
+    # eviction of B, whose cursor + filled pages must survive.
+    eng = ServingEngine(params, cfg, num_slots=2, page_size=8, num_pages=7,
+                        pages_per_seq=6, prefill_chunk=4)
+    ra = eng.submit(pa, 21)
+    rb = eng.submit(pb, 2)
+    res = eng.run(max_steps=4000)
+    snap = eng.metrics.snapshot()
+    assert snap["preemptions"] >= 1
+    assert res[ra] == gold_a and res[rb] == gold_b
+    assert snap["prefill_chunks"] == 13        # ceil(10/4)+ceil(40/4): no rework
+
+
+@pytest.mark.quick
+def test_chunked_admit_no_converters_no_host_argmax(tiny_model, monkeypatch):
+    """Acceptance criterion: the chunked admit path never calls the
+    cache<->pages converters (KV is written into pages in place) and never
+    argmaxes on host (the chunk program samples on device). We make the
+    converter a landmine and count host syncs."""
+    import triton_dist_tpu.serving.engine as engine_mod
+    cfg, params = tiny_model
+
+    def boom(*a, **k):
+        raise AssertionError("cache_to_pages called on the chunked path")
+
+    monkeypatch.setattr(engine_mod, "cache_to_pages", boom)
+    eng = ServingEngine(params, cfg, num_slots=2, page_size=8, num_pages=16,
+                        pages_per_seq=4, prefill_chunk=8)
+    reqs = _mk_requests(cfg, 4, seed=9, mnt_lo=2, mnt_hi=6)
+    rids = [eng.submit(p, m) for p, m in reqs]
+    res = eng.run(max_steps=2000)
+    assert all(rid in res for rid in rids)
+    snap = eng.metrics.snapshot()
+    assert snap["prefills"] == len(reqs)
+    assert snap["prefill_chunks"] >= len(reqs)
+    # sampling stays on device: syncs only re-upload control-plane state
+    assert snap["host_syncs"] <= snap["dispatches"]
+
+
+@pytest.mark.quick
+def test_decode_stall_bounded_by_chunk(tiny_model):
+    """The headline scheduling property: with chunking on, no single step
+    admits more than C prompt tokens (running decodes stall for at most
+    one chunk), while the inline path admits whole prompts at once."""
+    cfg, params = tiny_model
+    C = 8
+    reqs = _mk_requests(cfg, 8, seed=10, mnt_lo=2, mnt_hi=5)
+    assert max(len(p) for p, _ in reqs) > C    # trace must exceed the chunk
+
+    def run(chunk):
+        eng = ServingEngine(params, cfg, num_slots=2, page_size=8,
+                            num_pages=16, pages_per_seq=4,
+                            prefill_chunk=chunk)
+        arrivals = [(i, p, m) for i, (p, m) in enumerate(reqs)]
+        res = eng.run(max_steps=4000, arrivals=arrivals)
+        assert len(res) == len(reqs)
+        return eng.metrics.snapshot()["step_prefill_tokens"]["max"]
+
+    assert run(C) <= C                         # stall bounded by the chunk
+    assert run(None) > C                       # inline path: whole prompts

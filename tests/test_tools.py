@@ -329,3 +329,60 @@ def test_a2a_dispatch_wire_model():
     # monotone in n: more ranks, more hops (wire term saturates)
     m8 = bench.a2a_dispatch_model_us(65.0, 8)
     assert 65.0 < m8 < m32
+
+
+def test_a2a_wire_fit_two_segment(monkeypatch):
+    """The payload-scaling fit resolves a launch-latency floor meeting a
+    bandwidth line (t = max(t_lat, t0 + bytes/BW)) and reports BOTH
+    segment residuals — a single affine through floored small points drags
+    the slope (the round-5 0.19/0.17 residuals)."""
+    import bench
+
+    class _FakeCtx:
+        axis_names = ("x",)
+
+        def axis_size(self, axis):
+            return 4
+
+    # synthetic truth: 60 µs floor, then 10 µs + bytes / 150 GB/s — at
+    # (64 tok, hidden 1024, topk 2) the 1x/2x points sit on the floor and
+    # the 4x/8x points on the line (knee at 7.5 MB)
+    t_lat, t0, bw = 60e-6, 10e-6, 150e9
+
+    def fake_wire(ctx, tokens, hidden, topk, num_experts, i1, i2,
+                  wire_dtype=None, clamp=False):
+        b = bench._wire_bytes(4, tokens, hidden, topk, wire_dtype)
+        return max(t_lat, t0 + b / bw)
+
+    monkeypatch.setattr(bench, "bench_a2a_wire", fake_wire)
+    fit = bench.bench_a2a_wire_fit(_FakeCtx(), tokens_per_rank=64,
+                                   hidden=1024, topk=2, num_experts=8,
+                                   i1=1, i2=5)
+    assert fit["latency_points"] == 2
+    assert abs(fit["t_lat_us"] - 60.0) < 0.5
+    assert abs(fit["t0_us"] - 10.0) < 0.5
+    assert abs(fit["knee_mb"] - 7.5) < 0.1
+    assert 145.0 < fit["gb_per_s"] < 155.0
+    # both segments resolved well inside the 0.15 gate
+    assert fit["fit_residual_small"] <= 0.01
+    assert fit["fit_residual_big"] <= 0.01
+    # the seed is the model at the 1x payload: on the floor here
+    assert abs(fit["wire_us"] - 60.0) < 0.5
+    assert fit["t0_pinned_reason"] is None
+
+    # purely linear data (no floor in range): the plain affine wins the
+    # split search and the floor terms are absent
+    def fake_linear(ctx, tokens, hidden, topk, num_experts, i1, i2,
+                    wire_dtype=None, clamp=False):
+        return t0 + bench._wire_bytes(4, tokens, hidden, topk,
+                                      wire_dtype) / bw
+
+    monkeypatch.setattr(bench, "bench_a2a_wire", fake_linear)
+    lin = bench.bench_a2a_wire_fit(_FakeCtx(), tokens_per_rank=64,
+                                   hidden=1024, topk=2, num_experts=8,
+                                   i1=1, i2=5)
+    assert lin["latency_points"] == 0
+    assert lin["t_lat_us"] is None and lin["knee_mb"] is None
+    assert lin["fit_residual_small"] is None
+    assert lin["fit_residual_big"] <= 0.01
+    assert abs(lin["t0_us"] - 10.0) < 0.5

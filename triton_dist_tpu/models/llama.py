@@ -492,6 +492,109 @@ def decode_step_paged(params: dict, token: jax.Array, pos: jax.Array,
     return logits, {"k": ks, "v": vs}
 
 
+def prefill_chunk_paged(params: dict, tokens: jax.Array, start: jax.Array,
+                        prompt_len: jax.Array, cfg: LlamaConfig,
+                        pages: dict, block_table: jax.Array,
+                        ffn=None) -> tuple[jax.Array, dict]:
+    """Prefill one fixed-size chunk of a prompt DIRECTLY into the page
+    pool — the admission half of the serving hot loop (ISSUE 5 tentpole).
+
+    ``tokens`` [C] int32 is chunk ``[start, start + C)`` of the prompt,
+    zero-padded past ``prompt_len``; ``start`` and ``prompt_len`` are
+    runtime scalars, so ONE compiled program (keyed only by the chunk
+    size C) serves every prompt length and every chunk position — the
+    prefill jit cache shrinks from O(log max_prompt) bucket programs to
+    O(1). ``block_table`` [pages_per_seq] int32 is the sequence's block-
+    table row (fill entries past the owned pages are never dereferenced).
+
+    The chunk rides the PAGED machinery end to end, treating its C tokens
+    as C batch rows of ``ops.flash_decode``:
+
+    - KV lands straight in the pool via ``paged_kv_write`` (pos = the
+      absolute token position, ``active`` masks the padded tail onto the
+      scratch page) — no temporary contiguous cache, no
+      ``cache_to_pages`` converter copy on the admit path.
+    - attention is ``gqa_decode_paged`` with per-row
+      ``kv_len = position + 1``: each query walks the block table over
+      ALL pages filled so far — the pages of every previous chunk plus
+      this chunk's own causal prefix (written just above). The chunk-
+      boundary attention state therefore never crosses the host: it IS
+      the pages, re-read through the same online-softmax walk decode
+      uses, instead of an (m, l, acc) carry threaded between chunk
+      calls. Padded rows run with ``kv_len = 0`` (the empty-shard
+      convention — zeros out, masked writes) and their residual-stream
+      garbage is never read.
+
+    Returns ``(tok [()], pages)``: ``tok`` is the on-device greedy argmax
+    of the logits at row ``prompt_len - 1 - start`` (the first generated
+    token, fused like ``decode_step_paged(sample=True)`` — the host never
+    downloads logits or argmaxes them). It is meaningful only for the
+    chunk that contains the prompt's last token; earlier chunks compute
+    the same (cheap, one-row) head on a garbage row and the engine
+    ignores it — the price of keeping every chunk the same program.
+
+    ``ffn(h, p) -> [C, D]`` overrides the per-layer FFN exactly as in
+    ``decode_step_paged`` (the MoE serving hook); with a custom ``ffn``
+    the layer loop unrolls in Python for the same backend reasons.
+    """
+    from triton_dist_tpu.ops.flash_decode import (gqa_decode_paged,
+                                                  paged_kv_write)
+
+    C = tokens.shape[0]
+    Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    idx = start.astype(jnp.int32) + jnp.arange(C, dtype=jnp.int32)   # [C]
+    valid = idx < prompt_len                                         # [C]
+    # padded rows park on the scratch page: position 0 keeps the block-
+    # table lookup in range, active=False reroutes the write to page 0
+    pos = jnp.where(valid, idx, 0).astype(jnp.int32)
+    kv_len = jnp.where(valid, idx + 1, 0).astype(jnp.int32)
+    bt = jnp.broadcast_to(block_table[None, :], (C, block_table.shape[0]))
+    x = params["embed"][tokens].astype(cfg.dtype)                    # [C, D]
+    positions = pos[:, None]                                         # [C, 1]
+
+    def body(x, layer):
+        p, kp, vp = layer
+        h = rmsnorm(x, p["attn_norm"], cfg.norm_eps)
+        q = rope((h @ p["wq"]).reshape(C, 1, Hq, Dh), positions,
+                 cfg.rope_theta)[:, 0]                    # [C, Hq, Dh]
+        k = rope((h @ p["wk"]).reshape(C, 1, Hkv, Dh), positions,
+                 cfg.rope_theta)[:, 0]
+        v = (h @ p["wv"]).reshape(C, 1, Hkv, Dh)[:, 0]
+        kp, vp = paged_kv_write(kp, vp, k, v, bt, pos, active=valid)
+        attn, _lse = gqa_decode_paged(q, kp, vp, bt, kv_len)
+        x = x + attn.reshape(C, Hq * Dh) @ p["wo"]
+        h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps)
+        if ffn is None:
+            ff = (jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)
+                              ).astype(h.dtype) * (h @ p["w_up"])
+                  ) @ p["w_down"]
+        else:
+            ff = ffn(h, p)
+        x = x + ff.astype(x.dtype)
+        return x, (kp, vp)
+
+    if ffn is None:
+        x, (ks, vs) = lax.scan(body, x, (params["blocks"], pages["k"],
+                                         pages["v"]))
+    else:
+        ks_l, vs_l = [], []
+        for i in range(cfg.n_layers):
+            p = jax.tree.map(lambda a, i=i: a[i], params["blocks"])
+            x, (kp, vp) = body(x, (p, pages["k"][i], pages["v"][i]))
+            ks_l.append(kp)
+            vs_l.append(vp)
+        ks, vs = jnp.stack(ks_l), jnp.stack(vs_l)
+    # one-row head: the prompt's last token sits at chunk row
+    # prompt_len - 1 - start when this is the final chunk (clamped into
+    # range otherwise — the result is then garbage the engine discards)
+    last = jnp.clip(prompt_len - 1 - start, 0, C - 1).astype(jnp.int32)
+    h_last = lax.dynamic_slice_in_dim(x, last, 1)                    # [1, D]
+    h_last = rmsnorm(h_last, params["final_norm"], cfg.norm_eps)
+    logits = (h_last @ params["lm_head"]).astype(jnp.float32)
+    tok = jnp.argmax(logits[0], -1).astype(jnp.int32)
+    return tok, {"k": ks, "v": vs}
+
+
 def decode_multistep_paged(params: dict, token: jax.Array, pos: jax.Array,
                            cfg: LlamaConfig, pages: dict,
                            block_table: jax.Array, limit: jax.Array,
@@ -721,4 +824,4 @@ __all__ = ["LlamaConfig", "init_params", "param_specs", "forward",
            "forward_tp_overlap", "mlp_tp_overlap", "rmsnorm", "rope",
            "block_apply", "init_kv_cache", "init_page_pool", "prefill",
            "decode_step", "decode_step_paged", "decode_multistep_paged",
-           "generate"]
+           "prefill_chunk_paged", "generate"]

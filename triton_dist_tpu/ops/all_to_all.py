@@ -481,18 +481,73 @@ class EpAllToAllContext:
         return self.capacity // self.experts_per_rank
 
 
+# --- wire-dtype auto-selection (wire-fit driven) ---------------------------
+#
+# ``wire_dtype="auto"`` picks bf16 vs fp8 per message size from the same
+# wire model bench.py's ``bench_a2a_wire_fit`` emits per dtype:
+# ``t = t0 + bytes/BW``. fp8 moves half the payload bytes but pays a fixed
+# quant/dequant + f32-scale-wire latency, so small dispatches (latency-
+# dominated) keep the bf16 wire and large ones (bandwidth-dominated) take
+# the fp8 win. Feed measured fits through ``wire_fit=`` — the
+# ``{"bf16": {"t0_us", "gb_per_s"}, "fp8": {...}}`` shape of bench.py's
+# ``a2a_wire_fit`` extras. The defaults below encode the ICI egress
+# roofline (bench.py ``_ICI_EGRESS_GBS``) with a conservative fp8 latency
+# premium (quant + dequant XLA passes + the scale side-channel) and only
+# matter until a measured fit artifact is supplied.
+
+_DEFAULT_WIRE_FIT = {
+    "bf16": {"t0_us": 5.0, "gb_per_s": 180.0},
+    "fp8": {"t0_us": 25.0, "gb_per_s": 180.0},
+}
+
+
+def a2a_wire_bytes(n_ranks: int, max_tokens: int, hidden: int, topk: int,
+                   wire_dtype=None) -> int:
+    """Dispatch+combine wire bytes for one rank at the drop-proof capacity
+    (bench.py ``_wire_bytes`` twin — keep the formulas in sync): payload at
+    the wire itemsize plus the int32 id columns, plus the f32 scale
+    side-channel when quantized."""
+    itemsize = jnp.dtype(wire_dtype or jnp.bfloat16).itemsize
+    cap = _cap_round(max_tokens * topk, itemsize)
+    idc = _id_cols(cap)
+    b = n_ranks * (cap * hidden * itemsize + idc * 4)
+    if wire_dtype is not None:
+        b += n_ranks * idc * 4
+    return 2 * b
+
+
+def pick_wire_dtype(n_ranks: int, max_tokens: int, hidden: int, topk: int,
+                    wire_fit: dict | None = None,
+                    fp8_dtype=jnp.float8_e4m3fn):
+    """Resolve ``wire_dtype="auto"``: ``None`` (bf16 wire) or ``fp8_dtype``,
+    whichever the per-dtype wire fit predicts faster at this message size.
+    Ties keep the bf16 wire (no quant pass to maintain)."""
+    fit = wire_fit or _DEFAULT_WIRE_FIT
+
+    def t_us(dt, seg):
+        b = a2a_wire_bytes(n_ranks, max_tokens, hidden, topk, dt)
+        return fit[seg]["t0_us"] + b / (fit[seg]["gb_per_s"] * 1e3)
+
+    return None if t_us(None, "bf16") <= t_us(fp8_dtype, "fp8") else fp8_dtype
+
+
 def create_all_to_all_context(ctx: ShmemContext, max_tokens: int, hidden: int,
                               topk: int, num_experts: int,
                               capacity: int | None = None,
                               axis: str | None = None,
                               dtype=jnp.bfloat16,
                               wire_dtype=None,
+                              wire_fit: dict | None = None,
                               quant_edge: str = "fused",
                               dequant_edge: str = "post",
                               expert_major: bool = False
                               ) -> EpAllToAllContext:
     axis = axis or ctx.axis_names[0]
     n = ctx.axis_size(axis)
+    if isinstance(wire_dtype, str):
+        assert wire_dtype == "auto", wire_dtype
+        wire_dtype = pick_wire_dtype(n, max_tokens, hidden, topk,
+                                     wire_fit=wire_fit)
     assert num_experts % n == 0, (num_experts, n)
     assert quant_edge in ("pre", "fused", "kernel"), quant_edge
     assert dequant_edge in ("kernel", "post", "expert"), dequant_edge
@@ -1186,4 +1241,4 @@ def combine_2d(a2a: Ep2dAllToAllContext, processed: jax.Array, layouts,
 __all__ = ["all_to_all_push", "EpAllToAllContext", "create_all_to_all_context",
            "route_tokens", "dispatch", "combine", "Ep2dAllToAllContext",
            "create_all_to_all_context_2d", "route_tokens_2d", "dispatch_2d",
-           "combine_2d"]
+           "combine_2d", "a2a_wire_bytes", "pick_wire_dtype"]

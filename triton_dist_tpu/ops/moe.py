@@ -105,6 +105,34 @@ def _segment_alignment(gids: jax.Array, num_experts: int, block_m: int):
 # AG + GroupGEMM (fused)
 # ---------------------------------------------------------------------------
 
+def _ag_moe_xla(ctx: ShmemContext, tokens, ids, weights, axis):
+    """XLA-collective AG-MoE for a token axis that crosses slice boundaries
+    (``is_dcn_axis``): remote DMA cannot cross DCN, so the token + routing-id
+    gather runs as plain ``lax.all_gather`` over every tier and the grouped
+    GEMM as a masked dense per-expert matmul — the op's golden, computed
+    directly (the MoE twin of gemm_reduce_scatter's ``_gemm_rs_xla``).
+    Output layout matches the fused path: [T, N] sharded P(None, axis)."""
+    axes_t = axis if isinstance(axis, tuple) else (axis,)
+    E = weights.shape[0]
+    out_dtype = tokens.dtype
+
+    def f(tok_shard, ids_shard, w_shard):
+        tok, gids = tok_shard, ids_shard
+        for ax in reversed(axes_t):     # P(axes) flattening order
+            tok = lax.all_gather(tok, ax, axis=0, tiled=True)
+            gids = lax.all_gather(gids, ax, axis=0, tiled=True)
+        out = jnp.zeros((tok.shape[0], w_shard.shape[-1]), jnp.float32)
+        for e in range(E):              # -1 pad rows match no expert
+            ye = jnp.dot(tok, w_shard[e],
+                         preferred_element_type=jnp.float32)
+            out = out + jnp.where((gids == e)[:, None], ye, 0.0)
+        return out.astype(out_dtype)
+
+    sm = ctx.shard_map(f, in_specs=(P(axis), P(axis), P(None, None, axis)),
+                       out_specs=P(None, axis))
+    return sm(tokens, ids, weights)
+
+
 def _ag_moe_kernel(axis, mesh_axes, bm, bn, out_dtype, n_blocks,
                    x_ref, w_ref, be_ref, nb_ref, out_ref, ws_ref,
                    send_sems, recv_sems):
@@ -135,8 +163,27 @@ def ag_moe_group_gemm(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
     Entry analog: ag_group_gemm_intra_node
     (allgather_group_gemm.py:317-770). ``axis`` may be an (outer, inner…)
     tuple — the hierarchical 2-tier AG feeds the grouped GEMM (inter-node
-    analog, allgather_group_gemm.py:171-228)."""
+    analog, allgather_group_gemm.py:171-228). A DCN (slice-crossing) axis
+    routes to the XLA-collective fallback — remote DMA cannot cross DCN —
+    and must sit at the FRONT of a hierarchical tuple (slow tier
+    outermost), same rules as ``gemm_rs``/``ag_gemm``."""
     axis = norm_axis(ctx, axis)
+    if isinstance(axis, tuple):
+        dcn = tuple(ax for ax in axis if ctx.is_dcn_axis(ax))
+        if dcn and dcn != axis[:len(dcn)]:
+            raise ValueError(
+                f"DCN (slice-crossing) axes {dcn} must come first in the "
+                f"hierarchical axis tuple {axis} — put the slow tier "
+                "outermost (the fast-tier gather is remote DMA, which "
+                "cannot cross DCN; cf. ag_moe_group_gemm docstring)")
+        if dcn:
+            # DCN-prefix group: the whole gather goes over XLA transport
+            # (a mixed DCN-outer/Pallas-inner tier swap would need the
+            # grouped-GEMM alignment recomputed per tier — correctness
+            # first, the fused fast tier stays ICI-only)
+            return _ag_moe_xla(ctx, tokens, ids, weights, axis)
+    elif ctx.is_dcn_axis(axis):
+        return _ag_moe_xla(ctx, tokens, ids, weights, axis)
     n = ctx.axis_size(axis)
     mesh_axes = ctx.axis_names
     T, H = tokens.shape
@@ -250,6 +297,35 @@ def _moe_rs_2d_kernel(axes, mesh_axes, bm, bn, n_blocks, P_seg,
     emit_slot_reduction(ws_ref, red_ref, bm, bn)
 
 
+def _moe_rs_xla(ctx: ShmemContext, tokens, ids, topk_weights, weights, axis):
+    """XLA-collective GroupGEMM-RS for a scatter axis that crosses slice
+    boundaries (``is_dcn_axis``): the grouped down-GEMM partial runs as a
+    masked dense per-expert matmul on the local K-shard, the topk fold
+    commutes with the cross-rank sum, and ``psum_scatter`` routes the
+    reduction over the right transport — the op's golden (dense +
+    psum_scatter), computed directly. Output matches the fused path:
+    [T, N] sharded P(axis)."""
+    T, topk = topk_weights.shape
+    E, _, N = weights.shape
+    out_dtype = tokens.dtype
+
+    def f(tok_shard, ids_full, tw_full, w_shard):
+        part = jnp.zeros((tok_shard.shape[0], N), jnp.float32)
+        for e in range(E):              # -1 pad rows match no expert
+            ye = jnp.dot(tok_shard, w_shard[e],
+                         preferred_element_type=jnp.float32)
+            part = part + jnp.where((ids_full == e)[:, None], ye, 0.0)
+        folded = jnp.sum(part.reshape(T, topk, N)
+                         * tw_full[..., None].astype(jnp.float32), axis=1)
+        out = lax.psum_scatter(folded, axis, scatter_dimension=0, tiled=True)
+        return out.astype(out_dtype)
+
+    sm = ctx.shard_map(f, in_specs=(P(None, axis), P(None), P(None, None),
+                                    P(None, axis, None)),
+                       out_specs=P(axis))
+    return sm(tokens, ids, topk_weights, weights)
+
+
 def moe_reduce_rs(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
                   topk_weights: jax.Array, weights: jax.Array,
                   axis: str | None = None, block_m: int = 128) -> jax.Array:
@@ -263,8 +339,24 @@ def moe_reduce_rs(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
     P(axis). Golden: dense compute + psum_scatter
     (cf. moe_reduce_rs.py:889-1027). ``axis`` may be an (outer, inner…)
     tuple — fused GroupGEMM + fast-tier RS, then a slow-tier ring (the
-    inter-node analog, moe_reduce_rs.py:590-670)."""
+    inter-node analog, moe_reduce_rs.py:590-670). A DCN (slice-crossing)
+    scatter axis routes to the XLA-collective fallback; in a hierarchical
+    tuple DCN may only be the OUTER tier (slow tier outermost, same rule
+    as ``gemm_rs``) — the outer ring then becomes an XLA ``psum_scatter``
+    while the fused fast tier stays Pallas."""
     axis = norm_axis(ctx, axis)
+    dcn_outer = False
+    if isinstance(axis, tuple):
+        inner_dcn = tuple(ax for ax in axis[1:] if ctx.is_dcn_axis(ax))
+        if inner_dcn:
+            raise ValueError(
+                f"DCN (slice-crossing) axes {inner_dcn} must come first in "
+                f"the hierarchical axis tuple {axis} — put the slow tier "
+                "outermost (the fast-tier stage is remote DMA, which "
+                "cannot cross DCN; cf. moe_reduce_rs docstring)")
+        dcn_outer = ctx.is_dcn_axis(axis[0])
+    elif ctx.is_dcn_axis(axis):
+        return _moe_rs_xla(ctx, tokens, ids, topk_weights, weights, axis)
     n = ctx.axis_size(axis)
     mesh_axes = ctx.axis_names
     Tk, K = tokens.shape
@@ -337,8 +429,15 @@ def moe_reduce_rs(ctx: ShmemContext, tokens: jax.Array, ids: jax.Array,
             interpret=default_interpret(),
         )(x, w_shard, be_full, nb_full)
         if hier:
-            from triton_dist_tpu.ops.reduce_scatter import _rs_call
-            y = _rs_call(axis[0], mesh_axes, no, y)   # [P_seg, N] f32
+            if dcn_outer:
+                # slow tier over XLA: same surviving-chunk layout, same
+                # segment order — only the transport changes (gemm_rs's
+                # dcn_outer pattern)
+                y = lax.psum_scatter(y, axis[0], scatter_dimension=0,
+                                     tiled=True)       # [P_seg, N] f32
+            else:
+                from triton_dist_tpu.ops.reduce_scatter import _rs_call
+                y = _rs_call(axis[0], mesh_axes, no, y)   # [P_seg, N] f32
 
         # my segment's metadata: unscramble aligned rows → (token, k) rows
         gi_me = lax.dynamic_index_in_dim(gi_full, me, keepdims=False)

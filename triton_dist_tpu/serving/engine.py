@@ -16,7 +16,12 @@ Shape discipline (the TPU contract):
   ``cache_to_pages`` hands the pages to the pool. Prompts are padded to
   BUCKET lengths (power-of-two by default) with an attention length mask,
   so the prefill compile cache is O(log max_prompt), not one program per
-  distinct prompt length.
+  distinct prompt length. With ``prefill_chunk`` set the admit path is
+  CHUNKED instead: ``prefill_chunk_paged`` writes each chunk's KV
+  straight into pages through the block table (no contiguous cache, no
+  converter copies, device-fused first-token argmax), at most one chunk
+  per engine step co-scheduled with the decode dispatch — see the class
+  docstring.
 
 Device-resident hot loop (the host/device split):
 
@@ -54,11 +59,11 @@ import numpy as np
 from triton_dist_tpu.models.llama import (LlamaConfig,
                                           decode_multistep_paged,
                                           init_kv_cache, init_page_pool,
-                                          prefill)
+                                          prefill, prefill_chunk_paged)
 from triton_dist_tpu.serving.kv_pool import KVPagePool, cache_to_pages
 from triton_dist_tpu.serving.metrics import ServingMetrics
 from triton_dist_tpu.serving.scheduler import (ContinuousBatchingScheduler,
-                                               Request)
+                                               Request, RequestState)
 
 
 class ServingEngine:
@@ -78,6 +83,20 @@ class ServingEngine:
     distinct prompt length — the pre-bucketing behavior, bit-exact).
     ``eos_id`` enables early finish: a slot freezes on device the step it
     emits ``eos_id`` and the host finishes the request at reconcile.
+
+    ``prefill_chunk`` (ISSUE 5 tentpole) switches admission to CHUNKED
+    PAGED prefill: an admitted slot enters PREFILLING holding its pages
+    and a chunk cursor, and each ``step()`` dispatches AT MOST ONE
+    ``prefill_chunk``-token chunk (``models.llama.prefill_chunk_paged``)
+    alongside the batched decode dispatch — Sarathi-style co-scheduling
+    that bounds the per-step decode stall by one chunk instead of a
+    whole prompt. KV goes straight into pages through the block table
+    (no contiguous cache, no ``cache_to_pages`` copies) and the first
+    token's argmax is fused on device (no host logits download). One
+    compiled chunk program serves every prompt length — with chunking on
+    the prefill jit cache is O(1) and ``prefill_buckets`` is unused.
+    ``prefill_chunk=None`` (default) keeps the bucketed inline path
+    bit-for-bit.
     """
 
     def __init__(self, params: dict, cfg: LlamaConfig, num_slots: int = 4,
@@ -87,8 +106,10 @@ class ServingEngine:
                  metrics: ServingMetrics | None = None,
                  decode_horizon: int = 1,
                  prefill_buckets="pow2",
-                 eos_id: int | None = None):
+                 eos_id: int | None = None,
+                 prefill_chunk: int | None = None):
         assert decode_horizon >= 1
+        assert prefill_chunk is None or prefill_chunk >= 1
         self.params = params
         self.cfg = cfg
         self.page_size = page_size
@@ -129,6 +150,19 @@ class ServingEngine:
         else:
             self._step = jax.jit(step, donate_argnums=(3,))
         self._prefill_jit = {}              # keyed by (bucket, cache_len)
+
+        self.prefill_chunk = prefill_chunk
+        self._chunk_step = None
+        if prefill_chunk is not None:
+            # ONE program for every prompt length/position: chunk size is
+            # the only shape; cursor and prompt length ride as runtime
+            # scalars (same trick as the decode limit argument)
+            chunk = lambda p, t, s, n, pages, bt: prefill_chunk_paged(  # noqa: E731
+                p, t, s, n, cfg, pages, bt, ffn=ffn)
+            if jax.default_backend() == "cpu":
+                self._chunk_step = jax.jit(chunk)
+            else:
+                self._chunk_step = jax.jit(chunk, donate_argnums=(4,))
 
     # -- request intake ---------------------------------------------------
     def submit(self, prompt, max_new_tokens: int, rid: int | None = None
@@ -184,9 +218,22 @@ class ServingEngine:
                     lambda p, t, c, n: prefill(p, t, cfg, c, length=n))
         return self._prefill_jit[key]
 
+    def _mark_prefill_start(self, req: Request) -> None:
+        """TTFT-split bookkeeping: queue time ends at FIRST admission
+        (re-admissions after preemption keep the original clock)."""
+        if req.prefill_start_time is None:
+            req.prefill_start_step = self._steps
+            req.prefill_start_time = time.perf_counter()
+            self.metrics.observe("ttft_queue_s",
+                                 req.prefill_start_time - req.submit_time)
+
     def _admit(self, slot: int, req: Request) -> None:
+        if self.prefill_chunk is not None:
+            self._admit_chunked(slot, req)
+            return
         sp = len(req.prompt)
         bucket = self._bucket_len(sp)
+        self._mark_prefill_start(req)
         n_pages = -(-sp // self.page_size)
         pages = self.alloc.alloc(req.rid, n_pages)
         assert pages is not None, "admissible() guaranteed the pages"
@@ -215,6 +262,9 @@ class ServingEngine:
             req.first_token_time = time.perf_counter()
             self.metrics.observe("ttft_s",
                                  req.first_token_time - req.submit_time)
+            self.metrics.observe(
+                "ttft_prefill_s",
+                req.first_token_time - req.prefill_start_time)
         self._token[slot] = tok0
         self._pos[slot] = sp
         row = self.alloc.block_table_row(req.rid, self.pages_per_seq)
@@ -222,6 +272,85 @@ class ServingEngine:
         self._dirty = True
         if req.done:            # max_new_tokens == 1 or tok0 == eos_id
             self._finish(slot)
+
+    # -- chunked paged prefill (the PREFILLING state machine) -------------
+    def _admit_chunked(self, slot: int, req: Request) -> None:
+        """Chunked admission does NO prefill math: allocate the prompt's
+        pages (only the ones the request does not already own — a
+        mid-prefill preemptee kept its filled pages and resumes at its
+        cursor) and park the slot in PREFILLING. The chunks themselves
+        run one per engine step, co-scheduled with decode."""
+        sp = len(req.prompt)
+        n_pages = -(-sp // self.page_size)
+        have = len(self.alloc.pages_of(req.rid))
+        if n_pages > have:
+            got = self.alloc.alloc(req.rid, n_pages - have)
+            assert got is not None, "admissible() guaranteed the pages"
+        self.sched.activate(slot, req)
+        req.state = RequestState.PREFILLING
+        self._mark_prefill_start(req)
+        self.metrics.inc("prefills")
+        # slot mirrors stay parked (scratch page) until the LAST chunk
+        # lands — the chunk program carries its own block-table argument,
+        # so the decode batch never sees a half-prefilled row
+
+    def _dispatch_prefill_chunk(self) -> int:
+        """Run AT MOST ONE prefill chunk: the oldest (lowest admission
+        ticket) PREFILLING slot advances its cursor by one chunk. The
+        final chunk fuses the first-token argmax on device and flips the
+        slot to ACTIVE (mirrors set, ready for this step's decode
+        dispatch). Returns prompt tokens processed (0 = no prefill work).
+        """
+        slot, req = None, None
+        for i, r in enumerate(self.sched.slots):
+            if (r is not None and r.state is RequestState.PREFILLING
+                    and (req is None or r.admitted_seq < req.admitted_seq)):
+                slot, req = i, r
+        if slot is None:
+            return 0
+        C = self.prefill_chunk
+        sp = len(req.prompt)
+        start = req.prefill_cursor
+        toks = np.zeros(C, np.int32)
+        part = req.prompt[start:start + C]
+        toks[:len(part)] = part
+        row = np.asarray(
+            self.alloc.block_table_row(req.rid, self.pages_per_seq),
+            np.int32)
+        t0 = time.perf_counter()
+        tok_dev, self.pool = self._chunk_step(
+            self.params, jnp.asarray(toks),
+            jnp.asarray(start, jnp.int32), jnp.asarray(sp, jnp.int32),
+            self.pool, jnp.asarray(row))
+        # one int32 scalar download — it fences the chunk for honest
+        # stall timing and, on the final chunk, IS the first token (the
+        # argmax ran on device; the host never sees logits)
+        tok0 = int(tok_dev)
+        dt = time.perf_counter() - t0
+        req.prefill_cursor = min(start + C, sp)
+        self.metrics.inc("prefill_chunks")
+        self.metrics.observe("prefill_stall_s", dt)
+        if req.prefill_cursor < sp:
+            return len(part)
+        # last chunk → the slot starts decoding this very step
+        req.state = RequestState.ACTIVE
+        req.generated.append(tok0)
+        self.metrics.inc("tokens_generated")
+        if req.first_token_time is None:
+            req.first_token_step = self._steps
+            req.first_token_time = time.perf_counter()
+            self.metrics.observe("ttft_s",
+                                 req.first_token_time - req.submit_time)
+            self.metrics.observe(
+                "ttft_prefill_s",
+                req.first_token_time - req.prefill_start_time)
+        self._token[slot] = tok0
+        self._pos[slot] = sp
+        self._bt[slot] = row
+        self._dirty = True
+        if req.done:            # max_new_tokens == 1 or tok0 == eos_id
+            self._finish(slot)
+        return len(part)
 
     # -- slot teardown ----------------------------------------------------
     def _finish(self, slot: int) -> None:
@@ -234,7 +363,24 @@ class ServingEngine:
 
     def _preempt(self, slot: int) -> None:
         req = self.sched.slots[slot]
-        self.alloc.free_seq(req.rid)
+        if req.state is RequestState.PREFILLING and req.prefill_cursor > 0:
+            filled = -(-req.prefill_cursor // self.page_size)
+            if filled < len(self.alloc.pages_of(req.rid)):
+                # mid-prefill victim: keep the pages already holding
+                # computed KV, reclaim only the unfilled tail — the
+                # request requeues AT ITS CHUNK CURSOR and resumes there
+                # on re-admission (not at the prompt start)
+                self.alloc.free_tail(req.rid, keep=filled)
+            else:
+                # every owned page is filled — there is no tail to
+                # reclaim, so holding them would free nothing: full
+                # restart (frees all pages, guaranteed progress for the
+                # grower that triggered the preemption)
+                self.alloc.free_seq(req.rid)
+                req.prefill_cursor = 0
+        else:
+            self.alloc.free_seq(req.rid)
+            req.prefill_cursor = 0      # a decoding victim re-prefills
         self.sched.evict(slot)
         self._park(slot)
         self.metrics.inc("preemptions")
@@ -257,17 +403,32 @@ class ServingEngine:
             return False
 
         def can_hold(req: Request) -> bool:
-            return self.alloc.free_pages >= -(-len(req.prompt)
-                                              // self.page_size)
+            need = -(-len(req.prompt) // self.page_size)
+            if self.prefill_chunk is not None:
+                # a mid-prefill preemptee kept its filled pages
+                need -= len(self.alloc.pages_of(req.rid))
+            return self.alloc.free_pages >= need
 
         admitted = 0
+        prefilled_tokens = 0
         while (self.max_prefills_per_step is None
                or admitted < self.max_prefills_per_step):
             adm = self.sched.admissible(can_hold)
             if adm is None:
                 break
+            if self.prefill_chunk is None:
+                prefilled_tokens += len(adm[1].prompt)   # inline prefill
             self._admit(*adm)
             admitted += 1
+
+        # ≤1 prefill chunk co-scheduled with the decode dispatch
+        # (Sarathi-style): with chunking on, the decode stall this step
+        # is bounded by prefill_chunk tokens, not a whole prompt
+        if self.prefill_chunk is not None:
+            prefilled_tokens = self._dispatch_prefill_chunk()
+        self.metrics.observe("decode_stall_s",
+                             time.perf_counter() - t_begin)
+        self.metrics.observe("step_prefill_tokens", prefilled_tokens)
 
         # allocate-on-decode growth, preempting (youngest first) when dry.
         # Slot order is index order — deterministic. The FIRST step is
@@ -279,8 +440,8 @@ class ServingEngine:
         limits = np.zeros(self.num_slots, np.int32)
         for slot in range(self.num_slots):
             req = self.sched.slots[slot]
-            if req is None:
-                continue
+            if req is None or req.state is not RequestState.ACTIVE:
+                continue            # mid-prefill slots do not decode
             pos = int(self._pos[slot])
             while not self.alloc.ensure(req.rid, pos + 1):
                 victim = self.sched.pick_victim(exclude_slot=slot)
@@ -305,11 +466,18 @@ class ServingEngine:
         # a slot preempted while a LATER slot grew already has its limit
         # computed — zero it (its mirrors are parked; writes go to scratch)
         for slot in range(self.num_slots):
-            if self.sched.slots[slot] is None:
+            r = self.sched.slots[slot]
+            if r is None or r.state is not RequestState.ACTIVE:
                 limits[slot] = 0
 
-        active = self.sched.active
+        active = [(s, r) for s, r in self.sched.active
+                  if r.state is RequestState.ACTIVE]
         if not active:
+            if prefilled_tokens and self.prefill_chunk is not None:
+                # the step did real work (a prefill chunk) even with no
+                # decodable row — count it and keep the loop hot
+                self._steps += 1
+                return True
             return not self.sched.idle
 
         if self._dirty:
@@ -392,10 +560,16 @@ class ServingEngine:
                 return fallback
 
         prefills = sum(n(f, 1) for f in self._prefill_jit.values())
+        chunk = 0
+        if self._chunk_step is not None:
+            chunk = n(self._chunk_step,
+                      1 if self.metrics.counters["prefill_chunks"] else 0)
         return {
             "decode_compiles": n(self._step, 1 if self._steps else 0),
             "prefill_compiles": prefills,
             "prefill_programs": len(self._prefill_jit),
+            # chunked mode: exactly one program for ALL prompt lengths
+            "prefill_chunk_compiles": chunk,
         }
 
 

@@ -103,6 +103,25 @@ class KVPagePool:
             return True
         return self.alloc(seq_id, need) is not None
 
+    def free_tail(self, seq_id, keep: int) -> int:
+        """Free every page of ``seq_id`` past the first ``keep`` — the
+        mid-prefill preemption primitive: the pages already holding
+        computed KV (up to the chunk cursor) stay owned across the
+        eviction, only the unfilled tail returns to the pool. Freed in
+        allocation order (same convention as ``free_seq``) so replay
+        stays deterministic. Returns how many were freed."""
+        pages = self._owned.get(seq_id, [])
+        assert 0 <= keep <= len(pages), (seq_id, keep, len(pages))
+        tail = pages[keep:]
+        for p in tail:
+            assert p not in self._free, f"double free of page {p}"
+            self._free.append(p)
+        if keep:
+            self._owned[seq_id] = pages[:keep]
+        else:
+            self._owned.pop(seq_id, None)
+        return len(tail)
+
     def free_seq(self, seq_id) -> int:
         """Free-on-finish (and on preemption): return every page of
         ``seq_id`` to the free list. Returns how many were freed."""

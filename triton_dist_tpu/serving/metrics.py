@@ -92,15 +92,35 @@ class ServingMetrics:
             "dispatches": 0,
             "host_syncs": 0,
             "tokens_generated": 0,
+            # chunked-prefill dispatches (ISSUE 5): with chunking on,
+            # EVERY prompt token enters pages through a chunk program —
+            # the contiguous-cache converters and the host argmax never
+            # run (tests assert this via prefill_chunks > 0)
+            "prefill_chunks": 0,
         }
         self.hist = {
             "ttft_s": Histogram(),
+            # TTFT split: queue wait (submit → first admission) vs
+            # prefill latency (first admission → first token) — the two
+            # levers chunked prefill trades between
+            "ttft_queue_s": Histogram(),
+            "ttft_prefill_s": Histogram(),
             "tok_latency_s": Histogram(),
             "queue_depth": Histogram(),
             "pool_occupancy": Histogram(),
             "active_slots": Histogram(),
             "step_device_s": Histogram(),
             "step_host_s": Histogram(),
+            # per-chunk dispatch latency (one prefill chunk per step max)
+            "prefill_stall_s": Histogram(),
+            # per-step decode stall: time the step spent on admission +
+            # prefill work before the decode dispatch could launch —
+            # bounded by one chunk when chunking is on, by the whole
+            # prompt (inline prefill) when it is off
+            "decode_stall_s": Histogram(),
+            # prompt tokens prefilled in the step (the token-space stall
+            # bound the simulator regression test asserts: max ≤ chunk)
+            "step_prefill_tokens": Histogram(),
         }
         self._t0 = time.perf_counter()
 

@@ -33,6 +33,7 @@ from collections import deque
 
 class RequestState(enum.Enum):
     QUEUED = "queued"
+    PREFILLING = "prefilling"          # holds a slot + pages, chunk cursor
     ACTIVE = "active"
     FINISHED = "finished"
 
@@ -53,6 +54,15 @@ class Request:
     finish_step: int = -1
     submit_time: float | None = None   # wall clocks for TTFT
     first_token_time: float | None = None
+    # chunked-prefill state (engine's PREFILLING state machine): prompt
+    # tokens whose KV is already in pages. Survives mid-prefill eviction —
+    # the request requeues AT ITS CURSOR (with its filled pages) and
+    # resumes there, not at the prompt start. The TTFT split clocks ride
+    # along: queue time = submit → first admission, prefill time = first
+    # admission → first token.
+    prefill_cursor: int = 0
+    prefill_start_step: int = -1
+    prefill_start_time: float | None = None
 
     @property
     def kv_len(self) -> int:
@@ -146,8 +156,13 @@ class ContinuousBatchingScheduler:
         return best
 
     def evict(self, slot: int) -> Request:
-        """Remove the slot's request and requeue it at the FRONT; its
-        generation restarts from the prompt (see module docstring)."""
+        """Remove the slot's request and requeue it at the FRONT. A
+        decoding request restarts from its prompt (greedy decode is
+        deterministic — the regenerated tokens are bit-identical); a
+        mid-prefill request keeps ``prefill_cursor`` — the ENGINE decides
+        whether the cursor (and the pages behind it) survives or resets
+        (engine._preempt: kept when there is an unfilled page tail to
+        reclaim, reset to 0 otherwise)."""
         req = self.slots[slot]
         assert req is not None
         self.slots[slot] = None
