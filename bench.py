@@ -1116,6 +1116,97 @@ def bench_serving(ctx, i1: int, i2: int, B: int = 1, Hq: int = 32,
     return out
 
 
+def bench_disagg(ctx, num_slots: int = 4, page_size: int = 16,
+                 n_layers: int = 2, prefill_chunk: int = 16) -> dict:
+    """Disaggregated prefill/decode rows (ISSUE 6) vs the colocated
+    ``serving_*`` baselines, from the SAME seeded trace run through both
+    engines:
+
+    - ``disagg_ttft_us`` vs ``disagg_ttft_colocated_us``: time-to-first-
+      token, measured on the PREFILL worker's panel (the decode worker
+      never sees a prompt token).
+    - ``disagg_itl_us`` vs ``disagg_itl_colocated_us``: per-token decode
+      latency from the DECODE worker's panel — in the colocated engine
+      this number carries the co-scheduled chunk stall; disaggregated it
+      cannot (``step_prefill_tokens`` max is pinned 0 by test).
+    - ``disagg_migrate_us_per_page``: page-migration kernel cost
+      (total migrate wall / pages moved) — the price of the handoff the
+      colocated engine does not pay.
+    - ``disagg_decode_stall_us`` vs colocated: host admission work ahead
+      of the decode dispatch.
+
+    Knobs mirror ``scripts/serve_sim.py --disagg``.
+    """
+    from triton_dist_tpu.models.llama import LlamaConfig, init_params
+    from triton_dist_tpu.serving import DisaggServingEngine, ServingEngine
+
+    if len(jax.devices()) < 2:
+        return {"disagg_skipped": "needs >= 2 devices for the role mesh"}
+
+    cfg = LlamaConfig.tiny(n_layers=n_layers)
+    params = init_params(jax.random.key(3), cfg)
+    import numpy as _np
+
+    def _trace():
+        rng = _np.random.RandomState(0)
+        return [([int(t) for t in rng.randint(1, cfg.vocab_size,
+                                              size=int(rng.randint(4, 24)))],
+                 int(rng.randint(8, 24)))
+                for _ in range(3 * num_slots)]
+
+    kw = dict(num_slots=num_slots, page_size=page_size,
+              num_pages=8 * num_slots + 8, pages_per_seq=8,
+              prefill_chunk=prefill_chunk)
+    us = lambda h, k="mean": round((h[k] or 0.0) * 1e6, 1)
+
+    base = ServingEngine(params, cfg, **kw)
+    for p, m in _trace():
+        base.submit(p, m)
+    t0 = time.perf_counter()
+    res = base.run(max_steps=100_000)
+    base_wall = time.perf_counter() - t0
+    assert len(res) == 3 * num_slots
+    snap_b = base.metrics.snapshot()
+
+    eng = DisaggServingEngine(params, cfg, **kw)
+    for p, m in _trace():
+        eng.submit(p, m)
+    t0 = time.perf_counter()
+    res = eng.run(max_steps=100_000)
+    wall = time.perf_counter() - t0
+    assert len(res) == 3 * num_slots
+    snap_p = eng.metrics.snapshot()            # prefill worker's panel
+    snap_d = eng.metrics_decode.snapshot()     # decode worker's panel
+
+    out = {
+        "disagg_ttft_us": us(snap_p["ttft_s"]),
+        "disagg_ttft_colocated_us": us(snap_b["ttft_s"]),
+        "disagg_itl_us": us(snap_d["tok_latency_s"]),
+        "disagg_itl_colocated_us": us(snap_b["tok_latency_s"]),
+        "disagg_decode_stall_us": us(snap_d["decode_stall_s"]),
+        "disagg_decode_stall_colocated_us": us(snap_b["decode_stall_s"]),
+        "disagg_tok_per_s": round(snap_d["tokens_generated"] / wall, 1),
+        "disagg_tok_per_s_colocated": round(
+            snap_b["tokens_generated"] / base_wall, 1),
+        "disagg_pages_migrated": snap_p["pages_migrated"],
+        "disagg_migrate_chunks": snap_p["migrate_chunks"],
+        "disagg_compiles": eng.compile_stats,
+        "disagg_knobs": {"num_slots": num_slots, "page_size": page_size,
+                         "n_layers": n_layers,
+                         "prefill_chunk": prefill_chunk},
+    }
+    mig = snap_p["migrate_s"]
+    if snap_p["pages_migrated"]:
+        out["disagg_migrate_us_per_page"] = round(
+            (mig["mean"] or 0.0) * mig["count"] * 1e6
+            / snap_p["pages_migrated"], 1)
+    # the isolation headline, restated as data: the decode worker
+    # processed ZERO prompt tokens over the whole trace
+    out["disagg_decode_prefill_tokens_max"] = (
+        snap_d["step_prefill_tokens"]["max"])
+    return out
+
+
 # --- EP-dispatch wire model (the DeepEP-comparison analog) -----------------
 #
 # The reference's headline 137 µs dispatch (README.md:55) is 32 H800 ranks,
@@ -1346,6 +1437,15 @@ def main(a2a_primary: bool = False):
         extras.update(bench_serving(ctx, i1=si1, i2=si2, **ssh))
 
     attempt("serving", _serving)
+
+    def _disagg():
+        # disaggregated prefill/decode vs the colocated rows above; the
+        # role mesh is its own 2-rank context (first two devices)
+        dsh = (dict(page_size=8, n_layers=1, prefill_chunk=8)
+               if on_cpu() else {})
+        extras.update(bench_disagg(ctx, **dsh))
+
+    attempt("disagg", _disagg)
 
     def _attn():
         ash = dict(s_loc=256, Hq=4, Hkv=2) if on_cpu() else {}

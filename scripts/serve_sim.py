@@ -53,6 +53,11 @@ p.add_argument("--prefill-chunk", type=int, default=None,
                help="chunked paged prefill: tokens per co-scheduled chunk "
                     "(≤1 chunk per step rides beside the decode dispatch; "
                     "omit for the bucketed inline prefill path)")
+p.add_argument("--disagg", action="store_true",
+               help="disaggregated prefill/decode over a 2-rank role mesh "
+                    "(KV handed off by page migration; needs >= 2 devices; "
+                    "--prefill-chunk defaults to 2*page_size here — chunks "
+                    "ARE the migration unit)")
 args = p.parse_args()
 
 if args.prefill_buckets == "pow2":
@@ -62,14 +67,30 @@ elif args.prefill_buckets == "exact":
 else:
     buckets = tuple(int(b) for b in args.prefill_buckets.split(","))
 
+if args.disagg:
+    # the role mesh needs 2 ranks; on fewer (e.g. plain-CPU jax) fall
+    # back to the 2-device virtual CPU simulator — real chips are kept
+    from triton_dist_tpu.utils.env import force_virtual_cpu_devices  # noqa: E402
+    force_virtual_cpu_devices(2)
+
 cfg = LlamaConfig.tiny(n_layers=args.layers)
 params = init_params(jax.random.PRNGKey(args.seed), cfg)
-eng = ServingEngine(params, cfg, num_slots=args.slots,
-                    page_size=args.page_size, num_pages=args.pages,
-                    pages_per_seq=args.pages_per_seq,
-                    decode_horizon=args.decode_horizon,
-                    prefill_buckets=buckets,
-                    prefill_chunk=args.prefill_chunk)
+if args.disagg:
+    from triton_dist_tpu.serving import DisaggServingEngine  # noqa: E402
+    chunk = args.prefill_chunk or 2 * args.page_size
+    eng = DisaggServingEngine(params, cfg, num_slots=args.slots,
+                              page_size=args.page_size,
+                              num_pages=args.pages,
+                              pages_per_seq=args.pages_per_seq,
+                              decode_horizon=args.decode_horizon,
+                              prefill_chunk=chunk)
+else:
+    eng = ServingEngine(params, cfg, num_slots=args.slots,
+                        page_size=args.page_size, num_pages=args.pages,
+                        pages_per_seq=args.pages_per_seq,
+                        decode_horizon=args.decode_horizon,
+                        prefill_buckets=buckets,
+                        prefill_chunk=args.prefill_chunk)
 
 rng = np.random.RandomState(args.seed)
 max_plen = min(args.pages_per_seq * args.page_size - args.max_new, 24)
@@ -104,17 +125,43 @@ print(json.dumps({"compile_stats": eng.compile_stats}), file=sys.stderr)
 # (per-step decode stall bound, queue-vs-prefill TTFT split)
 snap = eng.metrics.snapshot()
 us = lambda v: None if v is None else round(v * 1e6, 1)
-print(json.dumps({
-    "prefill_chunk": args.prefill_chunk,
-    "prefill_chunks": snap["prefill_chunks"],
-    "prefill_stall_us": {k: us(snap["prefill_stall_s"][k])
-                         for k in ("mean", "p50", "p99", "max")},
-    "decode_stall_us": {k: us(snap["decode_stall_s"][k])
-                        for k in ("mean", "p50", "p99", "max")},
-    "step_prefill_tokens_max": snap["step_prefill_tokens"]["max"],
-    "ttft_queue_us": {k: us(snap["ttft_queue_s"][k])
-                      for k in ("mean", "p99")},
-    "ttft_prefill_us": {k: us(snap["ttft_prefill_s"][k])
-                        for k in ("mean", "p99")},
-}), file=sys.stderr)
-eng.metrics.emit()
+if args.disagg:
+    # two panels: TTFT lives on the prefill worker, ITL/stall on the
+    # decode worker — whose decode stall carries ZERO prefill work (the
+    # step_prefill_tokens_max field is the proof, not a wall clock)
+    snap_d = eng.metrics_decode.snapshot()
+    print(json.dumps({
+        "disagg": True,
+        "prefill_chunks": snap["prefill_chunks"],
+        "pages_migrated": snap["pages_migrated"],
+        "migrate_us": {k: us(snap["migrate_s"][k])
+                       for k in ("mean", "p99", "max")},
+        "migrate_wait_steps_max": snap_d["migrate_wait_steps"]["max"],
+        "decode_stall_us": {k: us(snap_d["decode_stall_s"][k])
+                            for k in ("mean", "p50", "p99", "max")},
+        "decode_step_prefill_tokens_max":
+            snap_d["step_prefill_tokens"]["max"],
+        "itl_us": {k: us(snap_d["tok_latency_s"][k])
+                   for k in ("mean", "p99")},
+        "ttft_queue_us": {k: us(snap["ttft_queue_s"][k])
+                          for k in ("mean", "p99")},
+        "ttft_prefill_us": {k: us(snap["ttft_prefill_s"][k])
+                            for k in ("mean", "p99")},
+    }), file=sys.stderr)
+    eng.metrics.emit()
+    eng.metrics_decode.emit()
+else:
+    print(json.dumps({
+        "prefill_chunk": args.prefill_chunk,
+        "prefill_chunks": snap["prefill_chunks"],
+        "prefill_stall_us": {k: us(snap["prefill_stall_s"][k])
+                             for k in ("mean", "p50", "p99", "max")},
+        "decode_stall_us": {k: us(snap["decode_stall_s"][k])
+                            for k in ("mean", "p50", "p99", "max")},
+        "step_prefill_tokens_max": snap["step_prefill_tokens"]["max"],
+        "ttft_queue_us": {k: us(snap["ttft_queue_s"][k])
+                          for k in ("mean", "p99")},
+        "ttft_prefill_us": {k: us(snap["ttft_prefill_s"][k])
+                            for k in ("mean", "p99")},
+    }), file=sys.stderr)
+    eng.metrics.emit()

@@ -24,6 +24,21 @@ from triton_dist_tpu.utils.env import force_virtual_cpu_devices  # noqa: E402
 _N_DEVICES = int(os.environ.get("TDT_TEST_DEVICES", "12"))
 force_virtual_cpu_devices(_N_DEVICES, skip_if_satisfied=False)
 
+# Per-run XLA compile cache: many tests build fresh engines/kernels whose
+# programs lower to byte-identical HLO (each engine owns its own jax.jit
+# objects, so the trace-level cache cannot share them). A content-keyed
+# persistent cache dedupes those XLA compiles within one suite run — it
+# does NOT affect the compile-count guards, which count trace-cache
+# entries, not XLA compiles. Fresh temp dir per run: nothing persists
+# across runs, so the first run's numbers are every run's numbers. The
+# 0.3 s threshold keeps the flood of tiny eager-op compiles out of the
+# cache (caching those costs more in serialization than it saves).
+import tempfile  # noqa: E402
+
+_cache_dir = tempfile.mkdtemp(prefix="tdt_xla_cache_")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
 assert jax.device_count() == _N_DEVICES, (
     f"expected {_N_DEVICES} virtual CPU devices, got {jax.devices()}"
 )

@@ -15,8 +15,8 @@ from triton_dist_tpu.models.llama import (LlamaConfig, decode_step,
                                           init_kv_cache, init_page_pool,
                                           init_params, prefill)
 from triton_dist_tpu.serving import (ContinuousBatchingScheduler, KVPagePool,
-                                     Request, ServingEngine, cache_to_pages,
-                                     pages_to_cache)
+                                     PageLedgerError, Request, ServingEngine,
+                                     cache_to_pages, pages_to_cache)
 
 pytestmark = pytest.mark.serving
 
@@ -57,6 +57,73 @@ def test_pool_ensure_growth_math():
     assert len(pool.pages_of("s")) == 5        # failed ensure changed nothing
     row = pool.block_table_row("s", pages_per_seq=8)
     assert len(row) == 8 and row[5:] == [0, 0, 0]
+
+
+def test_pool_free_tail_partial_fill_invariants():
+    """The mid-prefill preemption primitive hardened (ISSUE 6): a
+    partially-filled slot keeps exactly its first ``keep`` pages in
+    allocation order, the freed tail is reusable, out-of-range keeps are
+    loud, and a second tail-free of already-freed pages is a detected
+    double free, not silent free-list corruption."""
+    pool = KVPagePool(num_pages=10, page_size=8, reserved=1)
+    got = pool.alloc("s", 6)
+    assert got is not None
+    assert pool.free_tail("s", keep=2) == 4
+    assert pool.pages_of("s") == got[:2]       # filled prefix, exact order
+    assert pool.free_pages == 7
+    assert pool.free_tail("s", keep=2) == 0    # idempotent no-op tail
+    with pytest.raises(PageLedgerError):       # keep > owned: loud
+        pool.free_tail("s", keep=3)
+    with pytest.raises(PageLedgerError):
+        pool.free_tail("s", keep=-1)
+    # keep=0 drops ownership entirely (full-restart preemption)
+    assert pool.free_tail("s", keep=0) == 2
+    assert not pool.holds("s")
+    assert pool.free_pages == 9
+    # double free through either path is a PageLedgerError (an
+    # AssertionError subclass, so it still fails python -O-less asserts)
+    pool2 = KVPagePool(num_pages=6, page_size=8, reserved=1)
+    mine = pool2.alloc("t", 3)
+    pool2._free.append(mine[-1])               # simulate ledger corruption
+    with pytest.raises(PageLedgerError, match="double free"):
+        pool2.free_tail("t", keep=0)
+    pool3 = KVPagePool(num_pages=6, page_size=8, reserved=1)
+    mine = pool3.alloc("u", 2)
+    pool3._free.append(mine[0])
+    with pytest.raises(PageLedgerError, match="double free"):
+        pool3.free_seq("u")
+
+
+def test_pool_scratch_pages_never_migrate():
+    """Migration preconditions (ISSUE 6): reserved scratch pages and
+    foreign pages are refused loudly; owned non-reserved pages pass."""
+    pool = KVPagePool(num_pages=8, page_size=8, reserved=2)
+    a = pool.alloc("a", 3)
+    pool.alloc("b", 2)
+    pool.check_migratable("a", a)              # the happy path
+    with pytest.raises(PageLedgerError, match="scratch"):
+        pool.check_migratable("a", [0])
+    with pytest.raises(PageLedgerError, match="scratch"):
+        pool.check_migratable("a", [1])        # every reserved id, not just 0
+    with pytest.raises(PageLedgerError, match="foreign"):
+        pool.check_migratable("a", pool.pages_of("b")[:1])
+    with pytest.raises(PageLedgerError, match="foreign"):
+        pool.check_migratable("nobody", [a[0]])
+
+
+def test_pool_landed_row_exposes_prefix_only():
+    """Signal-gated block-table patching: a row exposes the landed PREFIX
+    of a sequence's pages — a hole means everything after it stays hidden
+    (pages are positional), and the fill id pads the rest."""
+    pool = KVPagePool(num_pages=10, page_size=8, reserved=1)
+    got = pool.alloc("s", 4)
+    assert pool.landed_row("s", set(), 6) == [0] * 6
+    assert pool.landed_row("s", set(got), 6) == got + [0, 0]
+    # a hole at position 1 hides pages 2 and 3 even though they landed
+    holey = {got[0], got[2], got[3]}
+    assert pool.landed_row("s", holey, 6) == [got[0]] + [0] * 5
+    assert pool.landed_row("s", set(got[:2]), 6, fill=9) == got[:2] + [9] * 4
+    assert pool.landed_row("unknown", {1, 2}, 4) == [0] * 4
 
 
 def test_pool_deterministic_replay():

@@ -14,3 +14,4 @@ from triton_dist_tpu.ops.gemm_reduce_scatter import (  # noqa: F401
 from triton_dist_tpu.ops.autodiff import ag_gemm_diff, gemm_rs_diff  # noqa: F401
 from triton_dist_tpu.ops.ring_attention import (  # noqa: F401
     ring_attention, ring_attention_fwd)
+from triton_dist_tpu.ops.page_migrate import migrate_pages  # noqa: F401

@@ -4,11 +4,18 @@ EP/SP overlap ops (see docs/serving.md).
 - kv_pool    — paged KV page allocator + cache<->pages converters
 - scheduler  — FIFO admission / preemption policy over fixed batch slots
 - engine     — the jitted one-step-per-token decode engine
+- disagg     — disaggregated prefill/decode over the shmem page-migration
+               kernel (signal-gated admission)
 - metrics    — counters + histograms, JSON-lines wire format
 """
 
+from triton_dist_tpu.serving.disagg import (ChunkSignalLedger,
+                                            DisaggServingEngine,
+                                            MigrationSignalTimeout,
+                                            PageMigrationChannel)
 from triton_dist_tpu.serving.engine import ServingEngine
-from triton_dist_tpu.serving.kv_pool import (KVPagePool, cache_to_pages,
+from triton_dist_tpu.serving.kv_pool import (KVPagePool, PageLedgerError,
+                                             cache_to_pages,
                                              page_pool_pspec, pages_to_cache)
 from triton_dist_tpu.serving.metrics import Histogram, ServingMetrics
 from triton_dist_tpu.serving.scheduler import (ContinuousBatchingScheduler,
@@ -16,7 +23,12 @@ from triton_dist_tpu.serving.scheduler import (ContinuousBatchingScheduler,
 
 __all__ = [
     "ServingEngine",
+    "DisaggServingEngine",
+    "PageMigrationChannel",
+    "ChunkSignalLedger",
+    "MigrationSignalTimeout",
     "KVPagePool",
+    "PageLedgerError",
     "page_pool_pspec",
     "cache_to_pages",
     "pages_to_cache",

@@ -1,0 +1,766 @@
+"""Disaggregated prefill/decode serving: KV page migration over the
+one-sided shmem layer (ISSUE 6 tentpole; ROADMAP item 2).
+
+The colocated ``ServingEngine`` time-slices ONE worker between chunked
+prefill and decode, so a long prompt still steals step time from every
+decoding request. This module splits the two roles across a 2-entry mesh
+axis (default ``"role"``) and applies the paper's producer/consumer
+thesis to the handoff:
+
+- role 0, the **prefill worker**, owns a prompt queue and runs
+  ``prefill_chunk_paged`` — at most one chunk per engine step, exactly
+  like the colocated engine. As each chunk FINALIZES pages (a page is
+  final once the cursor passes its last token, or at the final chunk),
+  the migration kernel (``ops.page_migrate``) pushes them with one
+  ``putmem_nbi`` per (layer, page) into the decode worker's pool at
+  pre-reserved destination ids, then fires ONE counted ``signal_op`` per
+  chunk (+n pages). PR 4's chunk cursor and ``KVPagePool.free_tail`` make
+  the chunk the natural migration unit: a mid-prefill preemptee keeps its
+  filled pages AND its already-migrated pages — nothing is re-sent, the
+  resumed prefill migrates only what it newly finalizes.
+- role 1, the **decode worker**, never sees a prompt token. Its
+  ``KVPagePool`` hands out the destination pages at ADMISSION time
+  ("remote reservation" — the prefill worker knows every chunk's
+  destination before it runs), its block-table rows expose only the
+  landed PREFIX of each request's pages (``KVPagePool.landed_row``), and
+  a slot flips to ACTIVE the step the signals covering its prompt pages
+  have all fired — signal-gated admission: no barrier, and the wait path
+  is the in-kernel ``signal_wait_until``/``wait_recv`` chain, not a host
+  round-trip. Only the FIRST TOKEN (one int, argmaxed on the prefill
+  device by the final chunk) rides the host control plane.
+
+Metrics isolation is the point: the decode worker's
+``step_prefill_tokens`` is identically 0 and its per-step stall no
+longer contains prefill work at all — decode ITL is independent of peer
+prompt length (pinned by test in token/step space, where CPU-host noise
+cannot fake it).
+
+Determinism/bit-identity: migration is an exact page copy, the first
+token is computed by the same fused chunk argmax, and decode runs the
+same ``decode_multistep_paged`` program over the same page contents — so
+per-request outputs are bit-identical to the colocated chunked engine,
+including across preemptions on either worker (tests/test_disagg.py).
+
+Topology: one driver process, SPMD over the role axis — every device
+program (chunk, decode, migrate) is one ``shard_map`` program both roles
+enter; the off-role shard runs the same program on PARKED inputs
+(prompt_len 0 / limit 0 rows write only to its own reserved scratch
+page). This is the interpret-mesh/TDT_SERIAL form of the two-process
+deployment (see docs/serving.md for the launch recipe and the
+``MP_BACKEND_NO_MULTIPROC`` caveat).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.models.llama import (LlamaConfig,
+                                          decode_multistep_paged,
+                                          init_page_pool,
+                                          prefill_chunk_paged)
+from triton_dist_tpu.ops.page_migrate import migrate_pages
+from triton_dist_tpu.serving.engine import (mark_prefill_start,
+                                            record_first_token)
+from triton_dist_tpu.serving.kv_pool import KVPagePool, PageLedgerError
+from triton_dist_tpu.serving.metrics import ServingMetrics
+from triton_dist_tpu.serving.scheduler import (ContinuousBatchingScheduler,
+                                               Request, RequestState)
+from triton_dist_tpu.shmem.context import (ShmemContext,
+                                           initialize_distributed)
+
+PREFILL_ROLE = 0
+DECODE_ROLE = 1
+
+
+class MigrationSignalTimeout(RuntimeError):
+    """A completed prefill waited longer than ``migrate_timeout_steps``
+    decode-worker steps for the signals covering its pages. Either the
+    transport dropped a signal/page or a chunk was never sent — the
+    message names the request, the per-chunk expected/landed counts, and
+    the uncovered pages, so the operator can tell which."""
+
+
+class ChunkSignalLedger:
+    """Host mirror of the per-chunk signal protocol.
+
+    The KERNEL is the source of truth — ``landed`` counts come from the
+    migration kernel's consumer-side report, which is ordered after every
+    ``wait_recv`` of the chunk (ops/page_migrate.py) — the ledger only
+    aggregates those reports per (request, chunk) so the scheduler can ask
+    "which pages are covered?" without touching the device. Out-of-order
+    chunk delivery is tolerated by construction: coverage is the union
+    over COMPLETE chunks (landed >= expected), whatever order they
+    completed in. Re-``expect``-ing a chunk (preemption restart re-sends
+    it) resets its count — the pages must land again before they count.
+    """
+
+    def __init__(self):
+        # rid -> {chunk_idx: [expected dst ids (tuple), landed count]}
+        self._chunks: dict[int, dict[int, list]] = {}
+
+    def expect(self, rid: int, chunk_idx: int, dst_ids) -> None:
+        self._chunks.setdefault(rid, {})[chunk_idx] = [
+            tuple(int(p) for p in dst_ids), 0]
+
+    def landed(self, rid: int, chunk_idx: int, count: int) -> None:
+        ent = self._chunks.get(rid, {}).get(chunk_idx)
+        if ent is None:
+            raise KeyError(
+                f"signal for unknown chunk {chunk_idx} of request {rid}")
+        ent[1] += int(count)
+
+    def chunk_complete(self, rid: int, chunk_idx: int) -> bool:
+        ent = self._chunks.get(rid, {}).get(chunk_idx)
+        return ent is not None and ent[1] >= len(ent[0])
+
+    def covered(self, rid: int) -> set[int]:
+        """Page ids whose delivery is fully signalled: the union over
+        complete chunks. A chunk at 2/3 signals covers NOTHING — partial
+        coverage cannot distinguish which pages landed."""
+        out: set[int] = set()
+        for ids, got in self._chunks.get(rid, {}).values():
+            if got >= len(ids):
+                out.update(ids)
+        return out
+
+    def expected(self, rid: int) -> set[int]:
+        out: set[int] = set()
+        for ids, _ in self._chunks.get(rid, {}).values():
+            out.update(ids)
+        return out
+
+    def complete(self, rid: int) -> bool:
+        chunks = self._chunks.get(rid, {})
+        return all(got >= len(ids) for ids, got in chunks.values())
+
+    def reset(self, rid: int) -> None:
+        self._chunks.pop(rid, None)
+
+    def describe(self, rid: int) -> str:
+        chunks = self._chunks.get(rid, {})
+        if not chunks:
+            return "no chunks recorded"
+        return ", ".join(
+            f"chunk {ci}: {got}/{len(ids)} signals (pages {list(ids)})"
+            for ci, (ids, got) in sorted(chunks.items()))
+
+
+class PageMigrationChannel:
+    """The prefill worker's sending half: guards, launches the migration
+    kernel for one chunk's finalized pages, and feeds the ledger from the
+    kernel's consumer-side landed report."""
+
+    def __init__(self, launch, pmax: int, reserved: int,
+                 metrics: ServingMetrics, consumer: int = DECODE_ROLE):
+        self.ledger = ChunkSignalLedger()
+        self._launch = launch          # jitted migrate_pages closure
+        self.pmax = pmax
+        self.reserved = reserved
+        self.metrics = metrics
+        self.consumer = consumer
+
+    def send_chunk(self, rid: int, chunk_idx: int, src_ids, dst_ids,
+                   pool_k, pool_v):
+        """Push one chunk's pages; returns the threaded pools. The id
+        arrays are padded to the compiled ``pmax`` width (one program for
+        every chunk size); padding is never dereferenced by the kernel."""
+        n = len(src_ids)
+        assert n == len(dst_ids), (src_ids, dst_ids)
+        assert 0 < n <= self.pmax, (n, self.pmax)
+        for p in (*src_ids, *dst_ids):
+            if p < self.reserved:
+                raise PageLedgerError(
+                    f"refusing to migrate reserved scratch page {p} "
+                    f"(request {rid}) — scratch is engine-local parking")
+        self.ledger.expect(rid, chunk_idx, dst_ids)
+        src = np.zeros(self.pmax, np.int32)
+        dst = np.zeros(self.pmax, np.int32)
+        src[:n] = src_ids
+        dst[:n] = dst_ids
+        t0 = time.perf_counter()
+        pool_k, pool_v, landed = self._launch(
+            jnp.asarray(src), jnp.asarray(dst),
+            jnp.asarray([n], np.int32), pool_k, pool_v)
+        got = int(np.asarray(landed)[self.consumer])
+        dt = time.perf_counter() - t0
+        self.ledger.landed(rid, chunk_idx, got)
+        self.metrics.inc("migrate_chunks")
+        self.metrics.inc("pages_migrated", got)
+        self.metrics.observe("migrate_s", dt)
+        self.metrics.observe("migrate_pages_per_chunk", got)
+        return pool_k, pool_v
+
+
+class DisaggServingEngine:
+    """Continuous-batching serving with prefill and decode on separate
+    workers, KV handed off by page migration (module docstring).
+
+    ``num_pages``/``page_size`` size EACH role's pool (plus one scratch
+    page per role). ``num_slots`` is the decode batch width;
+    ``num_prefill_slots`` bounds concurrent chunked prefills.
+    ``prefill_chunk`` is mandatory here — chunks ARE the migration unit.
+    ``migrate_timeout_steps`` bounds how many decode-worker steps a
+    completed prefill may wait for its covering signals before
+    ``MigrationSignalTimeout``.
+
+    Request lifecycle: QUEUED (prefill queue) → PREFILLING (prefill slot;
+    decode-side pages reserved; chunks run and migrate) → MIGRATING
+    (prefill done, prefill pages freed, first token in hand; waiting for
+    a decode slot + covering signals) → ACTIVE (decoding) → FINISHED.
+    A decode-side victim loses its pages AND its migrated KV: it requeues
+    at the FRONT of the prefill queue and re-prefills from scratch —
+    greedy determinism regenerates identical tokens. A prefill-side
+    victim (``force_preempt_prefill``) keeps its filled + migrated pages
+    and resumes at its chunk cursor.
+    """
+
+    def __init__(self, params: dict, cfg: LlamaConfig,
+                 ctx: ShmemContext | None = None, axis: str = "role",
+                 num_slots: int = 4, num_prefill_slots: int = 2,
+                 page_size: int = 16, num_pages: int = 64,
+                 pages_per_seq: int = 8, prefill_chunk: int = 16,
+                 decode_horizon: int = 1, eos_id: int | None = None,
+                 ffn=None, migrate_timeout_steps: int = 64,
+                 metrics: ServingMetrics | None = None,
+                 metrics_decode: ServingMetrics | None = None):
+        assert prefill_chunk >= 1 and decode_horizon >= 1
+        if ctx is None:
+            ctx = initialize_distributed(axis_names=(axis,), mesh_shape=(2,))
+        assert ctx.axis_size(axis) == 2, (
+            f"disaggregation needs exactly 2 ranks on axis {axis!r}")
+        self.ctx = ctx
+        self.axis = axis
+        self.params = params
+        self.cfg = cfg
+        self.page_size = page_size
+        self.pages_per_seq = pages_per_seq
+        self.num_slots = num_slots
+        self.prefill_chunk = prefill_chunk
+        self.decode_horizon = decode_horizon
+        self.eos_id = eos_id
+        self.migrate_timeout_steps = migrate_timeout_steps
+        # TTFT lives on the prefill worker's panel, ITL on the decode
+        # worker's — the isolation the disaggregation exists to provide
+        self.metrics = metrics or ServingMetrics()
+        self.metrics_decode = metrics_decode or ServingMetrics()
+
+        # ONE symmetric pool pair: each role owns an identical local
+        # [L, P+1, Hkv, ps, D] shard (id 0 reserved as that role's scratch
+        # page); the migration kernel's remote refs resolve into the peer
+        # shard by construction.
+        ref = init_page_pool(cfg, 1, page_size)      # shape/dtype template
+        local = (cfg.n_layers, num_pages + 1) + ref["k"].shape[2:]
+        self.pool_k = ctx.create_symm_tensor(local, ref["k"].dtype, axis=axis)
+        self.pool_v = ctx.create_symm_tensor(local, ref["v"].dtype, axis=axis)
+        self.alloc_p = KVPagePool(num_pages + 1, page_size, reserved=1)
+        self.alloc_d = KVPagePool(num_pages + 1, page_size, reserved=1)
+        self.sched_p = ContinuousBatchingScheduler(num_prefill_slots)
+        self.sched_d = ContinuousBatchingScheduler(num_slots)
+        self._handoff: deque[Request] = deque()   # MIGRATING, no slot yet
+        self._dslot: dict[int, int] = {}          # rid -> decode slot
+        self._wait_steps: dict[int, int] = {}     # rid -> signal-wait steps
+        self._finished: list[Request] = []
+        self._next_rid = 0
+        self._steps = 0
+
+        # decode-worker slot mirrors (control plane); the [2, B] stacked
+        # device arrays are authoritative between dispatches — row
+        # PREFILL_ROLE is permanently parked (zeros → scratch page)
+        B = num_slots
+        self._token = np.zeros(B, np.int32)
+        self._pos = np.zeros(B, np.int32)
+        self._bt = np.zeros((B, pages_per_seq), np.int32)
+        self._z_row = np.zeros(B, np.int32)
+        self._z_bt = np.zeros((B, pages_per_seq), np.int32)
+        # uploads are placed with the stacked-role sharding up front so the
+        # decode program sees ONE argument signature from the very first
+        # dispatch (host-upload steps and steady-state feedback steps would
+        # otherwise compile two variants — the compile guard pins this)
+        self._up = lambda a: ctx.shard(jnp.asarray(a), P(axis))
+        self._token_dev = self._up(np.stack([self._z_row, self._token]))
+        self._pos_dev = self._up(np.stack([self._z_row, self._pos]))
+        self._bt_dev = self._up(np.stack([self._z_bt, self._bt]))
+        self._dirty = False
+
+        # -- the three device programs (each ONE compiled SPMD program
+        # both roles enter; the off-role shard runs on parked inputs) ----
+        pspec = P(axis)
+
+        def chunk_f(p, toks, start, plen, kp, vp, bt):
+            pages = {"k": kp[0], "v": vp[0]}
+            tok, pages = prefill_chunk_paged(
+                p, toks[0], start[0], plen[0], cfg, pages, bt[0], ffn=ffn)
+            return tok[None], pages["k"][None], pages["v"][None]
+
+        chunk_sm = ctx.shard_map(
+            chunk_f, in_specs=(P(),) + (pspec,) * 6,
+            out_specs=(pspec,) * 3)
+
+        K = decode_horizon
+
+        def dec_f(p, tok, pos, kp, vp, bt, lim):
+            pages = {"k": kp[0], "v": vp[0]}
+            toks, tok2, pos2, pages = decode_multistep_paged(
+                p, tok[0], pos[0], cfg, pages, bt[0], lim[0],
+                horizon=K, eos_id=eos_id, ffn=ffn)
+            return (toks[None], tok2[None], pos2[None],
+                    pages["k"][None], pages["v"][None])
+
+        dec_sm = ctx.shard_map(
+            dec_f, in_specs=(P(),) + (pspec,) * 6,
+            out_specs=(pspec,) * 5)
+
+        def mig_f(src, dst, n, kp, vp):
+            return migrate_pages(ctx, kp, vp, src, dst, n, axis=axis,
+                                 producer=PREFILL_ROLE,
+                                 consumer=DECODE_ROLE)
+
+        if jax.default_backend() == "cpu":   # CPU: donation unsupported
+            self._chunk_step = jax.jit(chunk_sm)
+            self._dec_step = jax.jit(dec_sm)
+            self._migrate = jax.jit(mig_f)
+        else:
+            self._chunk_step = jax.jit(chunk_sm, donate_argnums=(4, 5))
+            self._dec_step = jax.jit(dec_sm, donate_argnums=(3, 4))
+            self._migrate = jax.jit(mig_f, donate_argnums=(3, 4))
+
+        # widest possible per-chunk migration: a C-token chunk can
+        # finalize at most C//ps whole pages plus the straddle page it
+        # completes plus the final chunk's partial last page
+        pmax = prefill_chunk // page_size + 2
+        self.channel = PageMigrationChannel(
+            self._migrate, pmax, reserved=1, metrics=self.metrics,
+            consumer=DECODE_ROLE)
+
+    # -- request intake (prefill worker) ----------------------------------
+    def submit(self, prompt, max_new_tokens: int, rid: int | None = None
+               ) -> int:
+        prompt = tuple(int(t) for t in np.asarray(prompt).reshape(-1))
+        assert prompt and max_new_tokens >= 1
+        total = len(prompt) + max_new_tokens - 1
+        need = -(-total // self.page_size)
+        assert need <= self.pages_per_seq, (
+            f"request needs {need} pages > pages_per_seq "
+            f"{self.pages_per_seq}")
+        assert need <= self.alloc_d.num_pages - self.alloc_d.reserved, (
+            f"request needs {need} pages > decode pool size")
+        if rid is None:
+            rid = self._next_rid
+        self._next_rid = max(self._next_rid, rid + 1)
+        req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
+                      eos_token=self.eos_id, submit_step=self._steps,
+                      submit_time=time.perf_counter())
+        self.sched_p.submit(req)
+        self.metrics.inc("requests_submitted")
+        return rid
+
+    # -- prefill worker ----------------------------------------------------
+    def _can_hold(self, req: Request) -> bool:
+        """Admission needs BOTH sides: prefill pages to compute into (a
+        mid-prefill preemptee kept its filled ones) and the decode-side
+        reservation (kept across prefill preemptions)."""
+        need = -(-len(req.prompt) // self.page_size)
+        need_p = need - len(self.alloc_p.pages_of(req.rid))
+        need_d = need - len(self.alloc_d.pages_of(req.rid))
+        return (self.alloc_p.free_pages >= max(need_p, 0)
+                and self.alloc_d.free_pages >= max(need_d, 0))
+
+    def _admit_prefill(self, slot: int, req: Request) -> None:
+        sp = len(req.prompt)
+        need = -(-sp // self.page_size)
+        have_p = len(self.alloc_p.pages_of(req.rid))
+        if need > have_p:
+            got = self.alloc_p.alloc(req.rid, need - have_p)
+            assert got is not None, "admissible() guaranteed the pages"
+        # remote reservation: the decode worker's pages for this prompt
+        # are fixed NOW, so every later chunk knows its destination ids
+        # without a round-trip — and landed KV survives prefill-side
+        # preemption because the reservation does
+        have_d = len(self.alloc_d.pages_of(req.rid))
+        if need > have_d:
+            got = self.alloc_d.alloc(req.rid, need - have_d)
+            assert got is not None, "admissible() guaranteed the pages"
+        self.sched_p.activate(slot, req)
+        req.state = RequestState.PREFILLING
+        mark_prefill_start(req, self.metrics, self._steps)
+        self.metrics.inc("prefills")
+
+    def _migrate_finalized(self, req: Request, start: int,
+                           cursor_new: int) -> None:
+        """Send exactly the pages this chunk FINALIZED: whole pages whose
+        last token the cursor just passed, plus (on the final chunk) the
+        partial last page. Derived from the cursor, so each page is sent
+        exactly once per prefill attempt and a cursor-resumed preemptee
+        never re-sends what it migrated before the eviction."""
+        ps = self.page_size
+        sp = len(req.prompt)
+        done_before = start // ps
+        done_after = (-(-sp // ps) if cursor_new >= sp
+                      else cursor_new // ps)
+        if done_after <= done_before:
+            return
+        src = self.alloc_p.pages_of(req.rid)[done_before:done_after]
+        dst = self.alloc_d.pages_of(req.rid)[done_before:done_after]
+        self.alloc_p.check_migratable(req.rid, src)
+        self.alloc_d.check_migratable(req.rid, dst)
+        chunk_idx = start // self.prefill_chunk
+        self.pool_k, self.pool_v = self.channel.send_chunk(
+            req.rid, chunk_idx, src, dst, self.pool_k, self.pool_v)
+
+    def _dispatch_prefill_chunk(self) -> int:
+        """At most ONE chunk per step (Sarathi co-scheduling, same policy
+        as the colocated engine): the oldest PREFILLING slot advances one
+        chunk, then its finalized pages migrate. The final chunk frees
+        the prefill-side pages and hands the request off as MIGRATING
+        with its device-argmaxed first token on the host control plane.
+        Returns prompt tokens processed."""
+        slot, req = None, None
+        for i, r in enumerate(self.sched_p.slots):
+            if (r is not None and r.state is RequestState.PREFILLING
+                    and (req is None or r.admitted_seq < req.admitted_seq)):
+                slot, req = i, r
+        if slot is None:
+            return 0
+        C = self.prefill_chunk
+        sp = len(req.prompt)
+        start = req.prefill_cursor
+        part = req.prompt[start:start + C]
+        toks = np.zeros((2, C), np.int32)
+        toks[PREFILL_ROLE, :len(part)] = part
+        starts = np.zeros(2, np.int32)
+        plens = np.zeros(2, np.int32)
+        starts[PREFILL_ROLE] = start
+        plens[PREFILL_ROLE] = sp
+        bt = np.zeros((2, self.pages_per_seq), np.int32)
+        bt[PREFILL_ROLE] = np.asarray(
+            self.alloc_p.block_table_row(req.rid, self.pages_per_seq),
+            np.int32)
+        t0 = time.perf_counter()
+        tok_dev, self.pool_k, self.pool_v = self._chunk_step(
+            self.params, jnp.asarray(toks), jnp.asarray(starts),
+            jnp.asarray(plens), self.pool_k, self.pool_v, jnp.asarray(bt))
+        tok0 = int(np.asarray(tok_dev)[PREFILL_ROLE])   # fence + maybe tok0
+        dt = time.perf_counter() - t0
+        cursor_new = min(start + C, sp)
+        req.prefill_cursor = cursor_new
+        self.metrics.inc("prefill_chunks")
+        self.metrics.observe("prefill_stall_s", dt)
+        self._migrate_finalized(req, start, cursor_new)
+        if cursor_new < sp:
+            return len(part)
+        # prefill complete: the request leaves this worker entirely — its
+        # prefill pages free NOW (the decode copies are the live ones) and
+        # only the first token crosses on the host control plane
+        req.first_token = tok0
+        record_first_token(req, self.metrics, self._steps)
+        self.metrics.inc("tokens_generated")
+        self.metrics.inc("handoffs")
+        self.alloc_p.free_seq(req.rid)
+        self.sched_p.remove(slot)
+        req.state = RequestState.MIGRATING
+        if req.rid not in self._dslot:
+            self._handoff.append(req)
+        return len(part)
+
+    def force_preempt_prefill(self) -> int | None:
+        """Forced mid-prefill preemption on the PREFILL worker (test/ops
+        hook): evict the youngest PREFILLING slot. Filled prefill pages
+        survive via ``free_tail`` (cursor resume), and the decode-side
+        reservation plus already-MIGRATED pages are untouched — the
+        resumed prefill migrates only what it newly finalizes. Returns
+        the evicted slot, or None when nothing is prefilling."""
+        victim = self.sched_p.pick_victim()
+        if victim is None:
+            return None
+        self._preempt_prefill(victim)
+        return victim
+
+    def _preempt_prefill(self, slot: int) -> None:
+        req = self.sched_p.slots[slot]
+        if req.prefill_cursor > 0:
+            filled = -(-req.prefill_cursor // self.page_size)
+            if filled < len(self.alloc_p.pages_of(req.rid)):
+                self.alloc_p.free_tail(req.rid, keep=filled)
+            else:
+                # no unfilled tail to reclaim: full restart. The decode
+                # reservation keeps its ids, so the restarted prefill
+                # re-migrates to the SAME destinations (idempotent —
+                # identical recomputed contents, re-counted signals).
+                self.alloc_p.free_seq(req.rid)
+                req.prefill_cursor = 0
+        else:
+            self.alloc_p.free_seq(req.rid)
+            req.prefill_cursor = 0
+        self.sched_p.evict(slot)
+        self.metrics.inc("preemptions")
+
+    # -- decode worker -----------------------------------------------------
+    def _seat_decode_slots(self) -> None:
+        while self._handoff:
+            slot = self.sched_d.free_slot()
+            if slot is None:
+                return
+            req = self._handoff.popleft()
+            self.sched_d.place(slot, req)
+            self._dslot[req.rid] = slot
+
+    def _check_signal_gate(self, slot: int, covered: set[int]) -> None:
+        """The landmine invariant (ISSUE 6 acceptance): a MIGRATING slot's
+        block-table row may expose ONLY pages whose delivery signal has
+        fired. ``landed_row`` guarantees this by construction; this check
+        makes any future regression loud instead of a silent garbage
+        read."""
+        for p in self._bt[slot]:
+            p = int(p)
+            if p >= self.alloc_d.reserved and p not in covered:
+                raise RuntimeError(
+                    f"signal-gate violation: decode block table exposes "
+                    f"page {p} before its delivery signal fired")
+
+    def _patch_and_admit(self) -> None:
+        """Block-table patching + signal-gated admission, in slot order
+        (deterministic). A MIGRATING slot's row tracks the landed prefix
+        each step; the slot flips to ACTIVE the step its prompt pages are
+        fully covered — the admission gate is the LEDGER (fed only by the
+        kernel's post-wait landed reports), never a host-side clock."""
+        for slot in range(self.num_slots):
+            req = self.sched_d.slots[slot]
+            if req is None or req.state is not RequestState.MIGRATING:
+                continue
+            rid = req.rid
+            covered = self.channel.ledger.covered(rid)
+            row = np.asarray(self.alloc_d.landed_row(
+                rid, covered, self.pages_per_seq), np.int32)
+            if not np.array_equal(row, self._bt[slot]):
+                self._bt[slot] = row
+                self._dirty = True
+            self._check_signal_gate(slot, covered)
+            sp = len(req.prompt)
+            need = set(self.alloc_d.pages_of(rid)[:-(-sp // self.page_size)])
+            if req.first_token is not None and need <= covered:
+                self.metrics_decode.observe(
+                    "migrate_wait_steps", self._wait_steps.pop(rid, 0))
+                req.state = RequestState.ACTIVE
+                req.generated.append(req.first_token)
+                self.metrics_decode.inc("handoffs")
+                self._token[slot] = req.first_token
+                self._pos[slot] = sp
+                self._bt[slot] = np.asarray(self.alloc_d.block_table_row(
+                    rid, self.pages_per_seq), np.int32)
+                self._dirty = True
+                if req.done:      # max_new_tokens == 1 or tok0 == eos_id
+                    self._finish_decode(slot)
+            else:
+                w = self._wait_steps.get(rid, 0) + 1
+                self._wait_steps[rid] = w
+                if w > self.migrate_timeout_steps:
+                    missing = sorted(need - covered)
+                    raise MigrationSignalTimeout(
+                        f"request {rid} waited {w} decode steps for "
+                        f"migration signals covering pages {missing}; "
+                        f"ledger: {self.channel.ledger.describe(rid)}. "
+                        "A signal or page delivery was lost (or a chunk "
+                        "was never sent).")
+
+    def _finish_decode(self, slot: int) -> None:
+        req = self.sched_d.finish(slot)
+        self.alloc_d.free_seq(req.rid)
+        self.channel.ledger.reset(req.rid)
+        self._wait_steps.pop(req.rid, None)
+        del self._dslot[req.rid]
+        req.finish_step = self._steps
+        self._park(slot)
+        self._finished.append(req)
+        self.metrics_decode.inc("requests_finished")
+
+    def _preempt_decode(self, slot: int) -> None:
+        """Decode-side eviction loses the migrated KV with the pages: the
+        victim restarts as a fresh prefill (FRONT of the prefill queue) —
+        determinism regenerates identical tokens. ``remove`` (not
+        ``evict``): the requeue target is the PEER scheduler."""
+        req = self.sched_d.remove(slot)
+        req.state = RequestState.QUEUED
+        req.preemptions += 1
+        req.generated.clear()
+        req.prefill_cursor = 0
+        req.first_token = None
+        self.alloc_d.free_seq(req.rid)
+        self.channel.ledger.reset(req.rid)
+        self._wait_steps.pop(req.rid, None)
+        del self._dslot[req.rid]
+        self.sched_p.submit(req, front=True)
+        self._park(slot)
+        self.metrics_decode.inc("preemptions")
+
+    def _park(self, slot: int) -> None:
+        self._token[slot] = 0
+        self._pos[slot] = 0
+        self._bt[slot] = 0
+        self._dirty = True
+
+    # -- one driver iteration ---------------------------------------------
+    @property
+    def idle(self) -> bool:
+        return (self.sched_p.idle and not self._handoff
+                and all(s is None for s in self.sched_d.slots))
+
+    def step(self) -> bool:
+        """One step of BOTH workers (single-driver SPMD: each device
+        program below is entered by both roles). Returns False when fully
+        idle."""
+        if self.idle:
+            return False
+
+        # ---- prefill worker: admissions + ≤1 chunk + migration ----------
+        while True:
+            adm = self.sched_p.admissible(self._can_hold)
+            if adm is None:
+                break
+            self._admit_prefill(*adm)
+        ptoks = self._dispatch_prefill_chunk()
+        self.metrics.observe("step_prefill_tokens", ptoks)
+
+        # ---- decode worker: seating, patching, gated admission ----------
+        t_d = time.perf_counter()
+        self._seat_decode_slots()
+        self._patch_and_admit()
+
+        limits = np.zeros(self.num_slots, np.int32)
+        for slot in range(self.num_slots):
+            req = self.sched_d.slots[slot]
+            if req is None or req.state is not RequestState.ACTIVE:
+                continue
+            pos = int(self._pos[slot])
+            while not self.alloc_d.ensure(req.rid, pos + 1):
+                victim = self.sched_d.pick_victim(exclude_slot=slot)
+                if victim is None:
+                    raise RuntimeError(
+                        f"decode KV pool too small: request {req.rid} "
+                        "needs a page with no preemptible peer left")
+                self._preempt_decode(victim)
+            want = min(self.decode_horizon, req.remaining)
+            lim = 1
+            while lim < want and self.alloc_d.ensure(req.rid, pos + lim + 1):
+                lim += 1
+            limits[slot] = lim
+            row = np.asarray(self.alloc_d.block_table_row(
+                req.rid, self.pages_per_seq), np.int32)
+            if not np.array_equal(row, self._bt[slot]):
+                self._bt[slot] = row
+                self._dirty = True
+        for slot in range(self.num_slots):
+            r = self.sched_d.slots[slot]
+            if r is None or r.state is not RequestState.ACTIVE:
+                limits[slot] = 0
+        # the decode worker NEVER runs prefill: its per-step stall is pure
+        # control-plane work, independent of any peer prompt length — and
+        # its step_prefill_tokens is identically 0 (both test-pinned)
+        self.metrics_decode.observe("decode_stall_s",
+                                    time.perf_counter() - t_d)
+        self.metrics_decode.observe("step_prefill_tokens", 0)
+
+        active = [(s, r) for s, r in self.sched_d.active
+                  if r.state is RequestState.ACTIVE]
+        if not active:
+            # prefill chunks / inflight migrations still progressed
+            self._steps += 1
+            return True
+
+        if self._dirty:
+            self._token_dev = self._up(np.stack([self._z_row, self._token]))
+            self._pos_dev = self._up(np.stack([self._z_row, self._pos]))
+            self._bt_dev = self._up(np.stack([self._z_bt, self._bt]))
+            self._dirty = False
+            self.metrics_decode.inc("host_syncs")
+
+        lim2 = np.zeros((2, self.num_slots), np.int32)
+        lim2[DECODE_ROLE] = limits
+        t_disp = time.perf_counter()
+        (toks, self._token_dev, self._pos_dev,
+         self.pool_k, self.pool_v) = self._dec_step(
+            self.params, self._token_dev, self._pos_dev,
+            self.pool_k, self.pool_v, self._bt_dev, jnp.asarray(lim2))
+        slab = np.asarray(toks)[DECODE_ROLE]           # [K, B]
+        t_done = time.perf_counter()
+
+        self._steps += 1
+        self.metrics_decode.inc("dispatches")
+        self.metrics_decode.inc("decode_steps", int(limits.max()))
+        self.metrics_decode.observe("queue_depth", len(self._handoff))
+        self.metrics_decode.observe("pool_occupancy",
+                                    self.alloc_d.occupancy())
+        self.metrics_decode.observe("active_slots", len(active))
+
+        n_tokens = 0
+        for slot, req in active:
+            emitted = 0
+            for i in range(int(limits[slot])):
+                req.generated.append(int(slab[i, slot]))
+                emitted += 1
+                self.metrics_decode.inc("tokens_generated")
+                if req.done:
+                    break
+            self._token[slot] = slab[emitted - 1, slot]
+            self._pos[slot] += emitted
+            n_tokens += emitted
+            if req.done:
+                self._finish_decode(slot)
+
+        dev_dt = t_done - t_disp
+        host_dt = (t_disp - t_d) + (time.perf_counter() - t_done)
+        self.metrics_decode.observe("step_device_s", dev_dt)
+        self.metrics_decode.observe("step_host_s", host_dt)
+        per_tok = (dev_dt + host_dt) / max(n_tokens, 1)
+        for _ in range(n_tokens):
+            self.metrics_decode.observe("tok_latency_s", per_tok)
+        return True
+
+    def run(self, max_steps: int | None = None,
+            arrivals=None) -> dict[int, list[int]]:
+        """Drive ``step()`` until idle (or ``max_steps``); same contract
+        as ``ServingEngine.run`` — returns {rid: tokens} for FINISHED
+        requests only."""
+        pending = deque(arrivals or [])
+        i = 0
+        while max_steps is None or i < max_steps:
+            while pending and pending[0][0] <= i:
+                _, prompt, mnt = pending.popleft()
+                self.submit(prompt, mnt)
+            if not self.step() and not pending:
+                break
+            i += 1
+        return {req.rid: list(req.generated) for req in self._finished}
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def compile_stats(self) -> dict:
+        """Each role compiles a BOUNDED program set: one chunk program
+        (prefill worker, every prompt length), one decode program, one
+        migration program (every chunk size ≤ pmax) — no per-prompt-length
+        recompiles anywhere (test-pinned)."""
+        def n(fn, fallback):
+            try:
+                return int(fn._cache_size())
+            except Exception:
+                return fallback
+
+        return {
+            "prefill_chunk_compiles": n(
+                self._chunk_step,
+                1 if self.metrics.counters["prefill_chunks"] else 0),
+            "decode_compiles": n(self._dec_step, 1 if self._steps else 0),
+            "migrate_compiles": n(
+                self._migrate,
+                1 if self.metrics.counters["migrate_chunks"] else 0),
+        }
+
+
+__all__ = ["DisaggServingEngine", "PageMigrationChannel",
+           "ChunkSignalLedger", "MigrationSignalTimeout",
+           "PREFILL_ROLE", "DECODE_ROLE"]

@@ -66,6 +66,35 @@ from triton_dist_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                                Request, RequestState)
 
 
+# -- role-shared bookkeeping helpers ----------------------------------------
+# The colocated engine plays BOTH serving roles; the disaggregated engine
+# (serving/disagg.py) splits them across workers. These module-level
+# helpers are the prefill-role half both share, so TTFT semantics cannot
+# drift between the colocated and disaggregated paths.
+
+def mark_prefill_start(req: Request, metrics: ServingMetrics,
+                       step: int) -> None:
+    """TTFT-split bookkeeping: queue time ends at FIRST admission
+    (re-admissions after preemption keep the original clock)."""
+    if req.prefill_start_time is None:
+        req.prefill_start_step = step
+        req.prefill_start_time = time.perf_counter()
+        metrics.observe("ttft_queue_s",
+                        req.prefill_start_time - req.submit_time)
+
+
+def record_first_token(req: Request, metrics: ServingMetrics,
+                       step: int) -> None:
+    """First-token bookkeeping — TTFT clocks close where the token is
+    COMPUTED (the prefill role), never where it is eventually served."""
+    if req.first_token_time is None:
+        req.first_token_step = step
+        req.first_token_time = time.perf_counter()
+        metrics.observe("ttft_s", req.first_token_time - req.submit_time)
+        metrics.observe("ttft_prefill_s",
+                        req.first_token_time - req.prefill_start_time)
+
+
 class ServingEngine:
     """Continuous-batching serving engine over the paged decode step.
 
@@ -219,13 +248,7 @@ class ServingEngine:
         return self._prefill_jit[key]
 
     def _mark_prefill_start(self, req: Request) -> None:
-        """TTFT-split bookkeeping: queue time ends at FIRST admission
-        (re-admissions after preemption keep the original clock)."""
-        if req.prefill_start_time is None:
-            req.prefill_start_step = self._steps
-            req.prefill_start_time = time.perf_counter()
-            self.metrics.observe("ttft_queue_s",
-                                 req.prefill_start_time - req.submit_time)
+        mark_prefill_start(req, self.metrics, self._steps)
 
     def _admit(self, slot: int, req: Request) -> None:
         if self.prefill_chunk is not None:
@@ -257,14 +280,7 @@ class ServingEngine:
         req.generated.append(tok0)
         self.metrics.inc("prefills")
         self.metrics.inc("tokens_generated")
-        if req.first_token_time is None:
-            req.first_token_step = self._steps
-            req.first_token_time = time.perf_counter()
-            self.metrics.observe("ttft_s",
-                                 req.first_token_time - req.submit_time)
-            self.metrics.observe(
-                "ttft_prefill_s",
-                req.first_token_time - req.prefill_start_time)
+        record_first_token(req, self.metrics, self._steps)
         self._token[slot] = tok0
         self._pos[slot] = sp
         row = self.alloc.block_table_row(req.rid, self.pages_per_seq)
@@ -336,14 +352,7 @@ class ServingEngine:
         req.state = RequestState.ACTIVE
         req.generated.append(tok0)
         self.metrics.inc("tokens_generated")
-        if req.first_token_time is None:
-            req.first_token_step = self._steps
-            req.first_token_time = time.perf_counter()
-            self.metrics.observe("ttft_s",
-                                 req.first_token_time - req.submit_time)
-            self.metrics.observe(
-                "ttft_prefill_s",
-                req.first_token_time - req.prefill_start_time)
+        record_first_token(req, self.metrics, self._steps)
         self._token[slot] = tok0
         self._pos[slot] = sp
         self._bt[slot] = row
@@ -573,4 +582,4 @@ class ServingEngine:
         }
 
 
-__all__ = ["ServingEngine"]
+__all__ = ["ServingEngine", "mark_prefill_start", "record_first_token"]

@@ -33,6 +33,15 @@ import jax
 from jax.sharding import PartitionSpec as P
 
 
+class PageLedgerError(AssertionError):
+    """Page-accounting corruption: double free, freeing a foreign page, or
+    migrating a reserved/scratch page. Raised EXPLICITLY (not via bare
+    ``assert``) so detection survives ``python -O`` — silent ledger
+    corruption would let two sequences share a page and scribble over each
+    other's KV. Subclasses ``AssertionError`` because the ledger checks
+    started life as asserts and callers/tests catch them as such."""
+
+
 def page_pool_pspec(axis: str | None) -> P:
     """PartitionSpec for the [L, P, Hkv, page_size, D] pool arrays: pages
     sharded over ``axis`` (the SP-cache analog — its S dim becomes the
@@ -111,10 +120,15 @@ class KVPagePool:
         allocation order (same convention as ``free_seq``) so replay
         stays deterministic. Returns how many were freed."""
         pages = self._owned.get(seq_id, [])
-        assert 0 <= keep <= len(pages), (seq_id, keep, len(pages))
+        if not 0 <= keep <= len(pages):
+            raise PageLedgerError(
+                f"free_tail(keep={keep}) out of range for seq {seq_id!r} "
+                f"owning {len(pages)} pages")
         tail = pages[keep:]
         for p in tail:
-            assert p not in self._free, f"double free of page {p}"
+            if p in self._free:
+                raise PageLedgerError(
+                    f"double free of page {p} (seq {seq_id!r})")
             self._free.append(p)
         if keep:
             self._owned[seq_id] = pages[:keep]
@@ -127,9 +141,51 @@ class KVPagePool:
         ``seq_id`` to the free list. Returns how many were freed."""
         pages = self._owned.pop(seq_id, [])
         for p in pages:
-            assert p not in self._free, f"double free of page {p}"
+            if p in self._free:
+                raise PageLedgerError(
+                    f"double free of page {p} (seq {seq_id!r})")
             self._free.append(p)
         return len(pages)
+
+    # -- migration support (disaggregated serving, ISSUE 6) ---------------
+    def check_migratable(self, seq_id, page_ids) -> None:
+        """Migration precondition: every id in ``page_ids`` must be owned
+        by ``seq_id`` and non-reserved. The scratch page(s) are
+        engine-local parking — inactive rows WRITE to them every dispatch,
+        so shipping one to a peer pool would plant live-mutating garbage
+        there. Raises ``PageLedgerError`` (loud, not silent corruption)."""
+        owned = set(self._owned.get(seq_id, ()))
+        for p in page_ids:
+            if p < self.reserved:
+                raise PageLedgerError(
+                    f"page {p} is a reserved scratch page — scratch pages "
+                    f"are never migrated (seq {seq_id!r})")
+            if p not in owned:
+                raise PageLedgerError(
+                    f"page {p} is not owned by seq {seq_id!r} — refusing "
+                    "to migrate a foreign page")
+
+    def landed_row(self, seq_id, covered, pages_per_seq: int,
+                   fill: int = 0) -> list[int]:
+        """Block-table row exposing only the LANDED PREFIX of ``seq_id``'s
+        pages. Pages are positional (page i holds tokens
+        ``[i*page_size, (i+1)*page_size)``), so a page is usable only when
+        it AND every page before it are in ``covered`` — the set of ids
+        whose delivery signals have fired (``ChunkSignalLedger.covered``).
+        Entries past the prefix are ``fill`` (the scratch page): the
+        decode worker can never dereference a page whose signal has not
+        fired. This is the block-table-patching half of signal-gated
+        admission (serving/disagg.py)."""
+        row: list[int] = []
+        for p in self._owned.get(seq_id, []):
+            if p not in covered:
+                break
+            row.append(p)
+        if len(row) > pages_per_seq:
+            raise PageLedgerError(
+                f"seq {seq_id!r} landed {len(row)} pages > pages_per_seq "
+                f"{pages_per_seq}")
+        return row + [fill] * (pages_per_seq - len(row))
 
     def block_table_row(self, seq_id, pages_per_seq: int,
                         fill: int = 0) -> list[int]:
@@ -181,5 +237,5 @@ def pages_to_cache(pages: jax.Array, block_table: jax.Array) -> jax.Array:
     return g.reshape(L, B, Hkv, n_pages * ps, D)
 
 
-__all__ = ["KVPagePool", "page_pool_pspec", "cache_to_pages",
-           "pages_to_cache"]
+__all__ = ["KVPagePool", "PageLedgerError", "page_pool_pspec",
+           "cache_to_pages", "pages_to_cache"]

@@ -97,6 +97,13 @@ class ServingMetrics:
             # the contiguous-cache converters and the host argmax never
             # run (tests assert this via prefill_chunks > 0)
             "prefill_chunks": 0,
+            # disaggregated serving (ISSUE 6): pages pushed over the
+            # one-sided shmem layer, migration kernel launches (one per
+            # finished chunk with at least one finalized page), and
+            # completed prefill→decode handoffs
+            "pages_migrated": 0,
+            "migrate_chunks": 0,
+            "handoffs": 0,
         }
         self.hist = {
             "ttft_s": Histogram(),
@@ -121,6 +128,13 @@ class ServingMetrics:
             # prompt tokens prefilled in the step (the token-space stall
             # bound the simulator regression test asserts: max ≤ chunk)
             "step_prefill_tokens": Histogram(),
+            # disaggregated serving (ISSUE 6): per-chunk migration launch
+            # latency (s), pages per migrated chunk, and how many decode-
+            # worker steps a completed prefill waited for its covering
+            # signals (0 = admitted the very step the last chunk landed)
+            "migrate_s": Histogram(),
+            "migrate_pages_per_chunk": Histogram(),
+            "migrate_wait_steps": Histogram(),
         }
         self._t0 = time.perf_counter()
 

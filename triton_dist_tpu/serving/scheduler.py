@@ -34,6 +34,11 @@ from collections import deque
 class RequestState(enum.Enum):
     QUEUED = "queued"
     PREFILLING = "prefilling"          # holds a slot + pages, chunk cursor
+    # disaggregated handoff (ISSUE 6): prefill is DONE (first token known,
+    # prefill-side pages freed) but the request sits on the decode worker
+    # waiting for the signals covering its migrated pages to fire —
+    # signal-gated admission flips it to ACTIVE, never the host clock
+    MIGRATING = "migrating"
     ACTIVE = "active"
     FINISHED = "finished"
 
@@ -63,6 +68,13 @@ class Request:
     prefill_cursor: int = 0
     prefill_start_step: int = -1
     prefill_start_time: float | None = None
+    # disaggregated handoff (ISSUE 6): the first token rides the HOST
+    # control plane from the prefill worker (it was argmaxed on the
+    # prefill device by the final chunk); everything bulky — the KV pages
+    # — moves device-to-device through the migration kernel instead.
+    # None until the final prefill chunk lands; reset on decode-side
+    # preemption (full re-prefill recomputes it bit-identically).
+    first_token: int | None = None
 
     @property
     def kv_len(self) -> int:
@@ -140,6 +152,32 @@ class ContinuousBatchingScheduler:
         req.admitted_seq = self._admit_ticket
         self._admit_ticket += 1
         self.slots[slot] = req
+
+    # -- disaggregated handoff (ISSUE 6) ----------------------------------
+    def place(self, slot: int, req: Request) -> None:
+        """Seat a request arriving from the PEER role's scheduler (the
+        decode worker seating a prefilling/migrating request). Unlike
+        ``activate`` it does not touch the queue and does not change
+        ``req.state`` — the disagg engine drives the PREFILLING →
+        MIGRATING → ACTIVE handoff states itself — but it DOES take an
+        admission ticket so victim ordering stays uniform across
+        colocated and handed-off requests."""
+        assert self.slots[slot] is None
+        req.admitted_seq = self._admit_ticket
+        self._admit_ticket += 1
+        self.slots[slot] = req
+
+    def remove(self, slot: int) -> Request:
+        """Unseat WITHOUT requeue — the other half of the handoff verbs:
+        a completed prefill leaves the prefill scheduler through here (it
+        continues on the DECODE worker, not in this queue), and a decode-
+        side victim is routed back to the PREFILL role's queue by the
+        engine. State/cursor/requeue policy is entirely the caller's
+        (contrast ``evict``, which requeues locally)."""
+        req = self.slots[slot]
+        assert req is not None
+        self.slots[slot] = None
+        return req
 
     # -- preemption -------------------------------------------------------
     def pick_victim(self, exclude_slot: int | None = None) -> int | None:
