@@ -1207,6 +1207,80 @@ def bench_disagg(ctx, num_slots: int = 4, page_size: int = 16,
     return out
 
 
+def bench_chaos(ctx, num_slots: int = 4, page_size: int = 16,
+                n_layers: int = 2, prefill_chunk: int = 16) -> dict:
+    """Recovery-ladder cost rows (ISSUE 7): the same seeded disagg trace
+    replayed under two seeded fault schedules —
+
+    - ``chaos_recovery_us``: mean TTFT of requests that lost at least one
+      migration signal and were saved by the RETRY rung (deadline expiry
+      → re-issued ``migrate_pages`` send), under a drop-heavy plan.
+    - ``chaos_degraded_ttft_us``: mean TTFT of requests rescued by
+      decode-local re-prefill after the peer went DEAD mid-trace — the
+      worst-case rung short of failure.
+    - the fault/retry/degradation counts behind both, so a regression in
+      the ladder shows up as a count shift even when CPU wall noise
+      drowns the latencies.
+
+    Token streams under both schedules are asserted bit-identical to the
+    fault-free run — these rows price recovery, they must not change
+    output.
+    """
+    from triton_dist_tpu.models.llama import LlamaConfig, init_params
+    from triton_dist_tpu.serving import DisaggServingEngine
+    from triton_dist_tpu.shmem import FaultPlan
+
+    if len(jax.devices()) < 2:
+        return {"chaos_skipped": "needs >= 2 devices for the role mesh"}
+
+    cfg = LlamaConfig.tiny(n_layers=n_layers)
+    params = init_params(jax.random.key(3), cfg)
+    import numpy as _np
+
+    def _trace():
+        rng = _np.random.RandomState(5)
+        return [([int(t) for t in rng.randint(1, cfg.vocab_size,
+                                              size=int(rng.randint(4, 24)))],
+                 int(rng.randint(4, 12)))
+                for _ in range(3 * num_slots)]
+
+    kw = dict(num_slots=num_slots, page_size=page_size,
+              num_pages=8 * num_slots + 8, pages_per_seq=8,
+              prefill_chunk=prefill_chunk)
+    us = lambda h, k="mean": round((h[k] or 0.0) * 1e6, 1)
+
+    def _run(plan, **ekw):
+        eng = DisaggServingEngine(params, cfg, fault_plan=plan,
+                                  **kw, **ekw)
+        for p, m in _trace():
+            eng.submit(p, m)
+        res = eng.run(max_steps=100_000)
+        assert not eng.failed, [str(r.failure) for r in eng.failed]
+        return eng, res
+
+    _, gold = _run(None)
+    drop, res_drop = _run(FaultPlan(seed=9, p_drop=0.4),
+                          signal_deadline_steps=4, max_retries=6)
+    dead, res_dead = _run(FaultPlan(seed=9, dead_peer_after=8),
+                          signal_deadline_steps=2, max_retries=1)
+    for res in (res_drop, res_dead):
+        assert res == gold, "recovery changed tokens — ladder regression"
+    snap_drop = drop.metrics_decode.snapshot()
+    snap_dead = dead.metrics_decode.snapshot()
+    return {
+        "chaos_recovery_us": us(snap_drop["recovered_ttft_s"]),
+        "chaos_recovered_requests": snap_drop["recovered_ttft_s"]["count"],
+        "chaos_retries": snap_drop["retries"],
+        "chaos_faults_injected":
+            drop.metrics.snapshot()["faults_injected"],
+        "chaos_degraded_ttft_us": us(snap_dead["degraded_ttft_s"]),
+        "chaos_degradations": snap_dead["degradations"],
+        "chaos_knobs": {"num_slots": num_slots, "page_size": page_size,
+                        "n_layers": n_layers,
+                        "prefill_chunk": prefill_chunk},
+    }
+
+
 # --- EP-dispatch wire model (the DeepEP-comparison analog) -----------------
 #
 # The reference's headline 137 µs dispatch (README.md:55) is 32 H800 ranks,
@@ -1446,6 +1520,14 @@ def main(a2a_primary: bool = False):
         extras.update(bench_disagg(ctx, **dsh))
 
     attempt("disagg", _disagg)
+
+    def _chaos():
+        # recovery-ladder cost under seeded fault schedules (ISSUE 7)
+        csh = (dict(page_size=8, n_layers=1, prefill_chunk=8)
+               if on_cpu() else {})
+        extras.update(bench_chaos(ctx, **csh))
+
+    attempt("chaos", _chaos)
 
     def _attn():
         ash = dict(s_loc=256, Hq=4, Hkv=2) if on_cpu() else {}

@@ -58,7 +58,17 @@ p.add_argument("--disagg", action="store_true",
                     "(KV handed off by page migration; needs >= 2 devices; "
                     "--prefill-chunk defaults to 2*page_size here — chunks "
                     "ARE the migration unit)")
+p.add_argument("--chaos", default=None, metavar="SPEC",
+               help="seeded fault injection on the migration signal plane "
+                    "(implies --disagg): a bare integer seed (default "
+                    "drop/delay probabilities) or a FaultPlan spec like "
+                    "'seed=3,drop=0.2,dup=0.05,delay=0.3,dead=40,"
+                    "rids=1|4|7'. Replays are bit-deterministic per spec; "
+                    "a chaos summary line (retries / degradations / "
+                    "failures / recovery latencies) is printed to stderr")
 args = p.parse_args()
+if args.chaos is not None:
+    args.disagg = True
 
 if args.prefill_buckets == "pow2":
     buckets = "pow2"
@@ -77,13 +87,18 @@ cfg = LlamaConfig.tiny(n_layers=args.layers)
 params = init_params(jax.random.PRNGKey(args.seed), cfg)
 if args.disagg:
     from triton_dist_tpu.serving import DisaggServingEngine  # noqa: E402
+    from triton_dist_tpu.shmem import FaultPlan  # noqa: E402
+    plan = FaultPlan.from_spec(args.chaos) if args.chaos else None
     chunk = args.prefill_chunk or 2 * args.page_size
     eng = DisaggServingEngine(params, cfg, num_slots=args.slots,
                               page_size=args.page_size,
                               num_pages=args.pages,
                               pages_per_seq=args.pages_per_seq,
                               decode_horizon=args.decode_horizon,
-                              prefill_chunk=chunk)
+                              prefill_chunk=chunk,
+                              fault_plan=plan)
+    if plan is not None:
+        print(json.dumps({"chaos": plan.describe()}), file=sys.stderr)
 else:
     eng = ServingEngine(params, cfg, num_slots=args.slots,
                         page_size=args.page_size, num_pages=args.pages,
@@ -103,13 +118,20 @@ for i in range(args.sim):
                      prompt, mnt))
 
 results = eng.run(max_steps=200_000, arrivals=arrivals)
-# run() returns FINISHED requests only — anything submitted but absent
-# ran out of steps
-unfinished = sorted(set(range(args.sim)) - set(results))
+# run() returns FINISHED requests only. Under --chaos a request may
+# instead have FAILED (typed, per-request — the ladder ran dry); those
+# are accounted for, not "unfinished". Anything else absent ran out of
+# steps — a real error.
+failed = {r.rid: r for r in getattr(eng, "failed", [])}
+unfinished = sorted(set(range(args.sim)) - set(results) - set(failed))
 if unfinished:
     print(json.dumps({"error": "unfinished requests", "rids": unfinished}),
           file=sys.stderr)
     sys.exit(1)
+for rid in sorted(failed):
+    print(json.dumps({"failed_rid": rid,
+                      "reason": type(failed[rid].failure).__name__,
+                      "detail": str(failed[rid].failure)}), file=sys.stderr)
 
 if args.tokens:
     for req in sorted(eng._finished, key=lambda r: r.rid):
@@ -148,6 +170,20 @@ if args.disagg:
         "ttft_prefill_us": {k: us(snap["ttft_prefill_s"][k])
                             for k in ("mean", "p99")},
     }), file=sys.stderr)
+    if args.chaos is not None:
+        # the chaos summary: what the ladder absorbed and what it cost
+        print(json.dumps({
+            "chaos_summary": True,
+            "faults_injected": snap["faults_injected"],
+            "stale_signals": snap["stale_signals"],
+            "retries": snap_d["retries"],
+            "degradations": snap_d["degradations"],
+            "failed_requests": snap_d["failed_requests"],
+            "recovered_ttft_us": {k: us(snap_d["recovered_ttft_s"][k])
+                                  for k in ("mean", "p99")},
+            "degraded_ttft_us": {k: us(snap_d["degraded_ttft_s"][k])
+                                 for k in ("mean", "p99")},
+        }), file=sys.stderr)
     eng.metrics.emit()
     eng.metrics_decode.emit()
 else:

@@ -41,10 +41,14 @@ def _force_compiled_env():
     introspection; persistent compile cache amortizes reruns."""
     saved = {k: os.environ.get(k) for k in
              ("TDT_FORCE_COMPILED", "TPU_ACCELERATOR_TYPE",
-              "TPU_WORKER_HOSTNAMES")}
+              "TPU_WORKER_HOSTNAMES", "TPU_SKIP_MDS_QUERY")}
     os.environ["TDT_FORCE_COMPILED"] = "1"
     os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-8")
     os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+    # Off-GCE there is no metadata server; libtpu's probe retries for
+    # ~7 minutes before giving up (measured 433s of fixture setup).
+    # Everything the MDS would provide is already pinned above.
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "true")
     saved_cache_dir = jax.config.jax_compilation_cache_dir
     jax.config.update("jax_compilation_cache_dir", "/tmp/tdt_topo_cache")
     yield

@@ -18,6 +18,7 @@ from triton_dist_tpu.ops import migrate_pages
 from triton_dist_tpu.serving import (ChunkSignalLedger, DisaggServingEngine,
                                      MigrationSignalTimeout, PageLedgerError,
                                      PageMigrationChannel, ServingEngine)
+from triton_dist_tpu.shmem import FaultPlan
 from triton_dist_tpu.serving.disagg import DECODE_ROLE
 from triton_dist_tpu.serving.metrics import ServingMetrics
 from triton_dist_tpu.serving.scheduler import RequestState
@@ -153,8 +154,11 @@ def test_migrate_pages_exact_copy(role_ctx):
     dst = jnp.array([2, 6, 4, 7], jnp.int32)
     pool_k, pool_v, landed = migrate_pages(
         ctx, pool_k, pool_v, src, dst, jnp.array([3], jnp.int32),
-        axis="role")
-    assert int(np.asarray(landed)[DECODE_ROLE]) == 3
+        axis="role", tag=5)
+    # landed report rows are (count, echoed generation tag) per role —
+    # the tag is what lets the ledger discard stale re-sent deliveries
+    assert int(np.asarray(landed)[DECODE_ROLE, 0]) == 3
+    assert int(np.asarray(landed)[DECODE_ROLE, 1]) == 5
     hk, hv = np.asarray(pool_k), np.asarray(pool_v)
     for s, d in [(3, 2), (5, 6), (1, 4)]:
         assert (hk[1, :, d] == 100 + s).all()
@@ -243,47 +247,59 @@ def test_disagg_bit_identical_under_prefill_preemption(tiny_model, role_ctx,
 # ---------------------------------------------------------------------------
 
 @pytest.mark.quick
-def test_lost_signal_times_out_descriptively(tiny_model, role_ctx,
-                                             monkeypatch):
-    """TDT_SERIAL lost-signal drill: the pages physically migrate but one
-    chunk's signal count never reaches the ledger. Admission must stay
-    gated on SIGNALS (not on any side channel), the slot must never go
-    ACTIVE, and the timeout must name the request, the missing pages and
-    the per-chunk counts."""
+def test_lost_signal_fails_request_not_engine(tiny_model, role_ctx,
+                                              monkeypatch):
+    """TDT_SERIAL lost-signal drill, ISSUE-7 contract: every signal for
+    ONE request is dropped (scoped FaultPlan) and degradation is off, so
+    after the retry rungs run dry THAT request fails with a typed,
+    ledger-dumping reason — while the un-faulted neighbor finishes
+    normally in the SAME run. The old whole-engine
+    MigrationSignalTimeout raise is gone: the engine never dies for a
+    transport fault."""
     monkeypatch.setenv("TDT_SERIAL", "1")
     cfg, params = tiny_model
-    eng = _disagg(params, cfg, role_ctx, migrate_timeout_steps=6)
+    plan = FaultPlan(seed=3, p_drop=1.0, rids=(0,))
+    eng = _disagg(params, cfg, role_ctx, fault_plan=plan,
+                  signal_deadline_steps=2, max_retries=1,
+                  allow_degradation=False)
     prompt = list(range(1, 13))                # 12 tokens: 2 chunks, 2 pages
-    rid = eng.submit(prompt, 4)
+    rid = eng.submit(prompt, 4)                # rid 0 — the faulted one
+    rid_ok = eng.submit(list(range(20, 29)), 3)
     req = eng.sched_p.queue[0]
 
-    real_landed = eng.channel.ledger.landed
-
-    def lossy(r, ci, count):
-        if r == rid and ci == 0:
-            return                             # the signal evaporates
-        real_landed(r, ci, count)
-
-    monkeypatch.setattr(eng.channel.ledger, "landed", lossy)
-    with pytest.raises(MigrationSignalTimeout) as exc:
-        eng.run(max_steps=200)
-    msg = str(exc.value)
+    res = eng.run(max_steps=400)               # must NOT raise
+    assert rid not in res and rid_ok in res
+    assert len(res[rid_ok]) == 3               # the neighbor was untouched
+    assert [r.rid for r in eng.failed] == [rid]
+    assert req.state is RequestState.FAILED
+    assert isinstance(req.failure, MigrationSignalTimeout)
+    msg = str(req.failure)
     assert f"request {rid}" in msg
     assert "chunk 0: 0/" in msg                # per-chunk count in the report
-    assert req.state is RequestState.MIGRATING  # never admitted
+    assert "missing" in msg                    # ledger dump rode along
     assert req.generated == []                 # not one token decoded
+    assert eng.metrics_decode.counters["failed_requests"] == 1
+    assert eng.metrics_decode.counters["retries"] >= 1
+    assert eng.metrics.counters["faults_injected"] >= 2
+    # failure released every page on both sides
+    assert eng.alloc_p.used_pages == 0 and eng.alloc_d.used_pages == 0
+    eng.alloc_p.check(); eng.alloc_d.check(eng.channel.ledger)
 
 
 @pytest.mark.quick
 def test_unsent_chunk_landmine(tiny_model, role_ctx, monkeypatch):
-    """The landmine (ISSUE 6 acceptance): a chunk that is never SENT at
-    all. The decode-side block table must never expose the unlanded pages
-    (the signal gate would raise if it did), the slot never activates,
-    and the timeout says a chunk may never have been sent."""
+    """The landmine (ISSUE 6 acceptance, ISSUE 7 failure domain): a chunk
+    that is never SENT at all. The decode-side block table must never
+    expose the unlanded pages (the signal gate would raise if it did),
+    the retry rung must recognize there is nothing to re-send (the ledger
+    has no incomplete chunk), and with degradation off the request fails
+    typed, saying a chunk may never have been sent."""
     cfg, params = tiny_model
-    eng = _disagg(params, cfg, role_ctx, migrate_timeout_steps=6)
+    eng = _disagg(params, cfg, role_ctx, signal_deadline_steps=4,
+                  max_retries=2, allow_degradation=False)
     prompt = list(range(1, 13))
     rid = eng.submit(prompt, 4)
+    req = eng.sched_p.queue[0]
     real_send = eng.channel.send_chunk
 
     def dropping(r, ci, src, dst, pk, pv):
@@ -292,13 +308,16 @@ def test_unsent_chunk_landmine(tiny_model, role_ctx, monkeypatch):
         return real_send(r, ci, src, dst, pk, pv)
 
     monkeypatch.setattr(eng.channel, "send_chunk", dropping)
-    with pytest.raises(MigrationSignalTimeout, match="never been sent|never sent"):
-        eng.run(max_steps=200)
-    # the gate held: only landed pages ever reached the block-table row
-    slot = eng._dslot[rid]
-    covered = eng.channel.ledger.covered(rid)
-    for p in eng._bt[slot]:
-        assert int(p) < eng.alloc_d.reserved or int(p) in covered
+    res = eng.run(max_steps=400)               # per-request failure, no raise
+    assert res == {}
+    assert req.state is RequestState.FAILED
+    assert isinstance(req.failure, MigrationSignalTimeout)
+    assert "never sent" in str(req.failure)
+    # no retries counted: the ledger had no incomplete chunk to re-send,
+    # so the ladder skipped straight past the retry rung
+    assert eng.metrics_decode.counters["retries"] == 0
+    assert eng.metrics_decode.counters["failed_requests"] == 1
+    assert eng.alloc_p.used_pages == 0 and eng.alloc_d.used_pages == 0
 
 
 # ---------------------------------------------------------------------------

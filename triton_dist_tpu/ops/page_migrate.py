@@ -66,12 +66,20 @@ from triton_dist_tpu.utils import default_interpret
 
 def _migrate_kernel(axis, mesh_axes, producer, consumer, n_layers,
                     interpreting,
-                    n_ref, src_ref, dst_ref, kpool, vpool,
+                    n_ref, src_ref, dst_ref, tag_ref, kpool, vpool,
                     kpool_out, vpool_out, landed_ref,
                     send_k, recv_k, send_v, recv_v, chunk_sem):
     """Both roles run this SPMD; ``producer``/``consumer`` are role indices
     along ``axis``. Pools are the [L*P, Hkv, ps, D] page-flattened local
     shards of the symmetric pool (aliased through as outputs).
+
+    ``tag_ref`` is the send's attempt/generation tag (ISSUE 7): the
+    landed report echoes it next to the count, so the host ledger can
+    tell a report from THIS attempt apart from a delayed one belonging
+    to an earlier attempt of the same chunk — retry re-sends bump the
+    tag, and stale reports are discarded instead of double-counted. The
+    echo is grounded here, in the same report that is ordered after the
+    delivery waits, not in host bookkeeping.
 
     All pool traffic goes through the OUTPUT refs: on hardware the alias
     makes them the same buffer, and the generic interpreter only carries
@@ -83,7 +91,8 @@ def _migrate_kernel(axis, mesh_axes, producer, consumer, n_layers,
     pages = kpool.shape[0] // n_layers
     pmax = src_ref.shape[0]
     n = n_ref[0]
-    landed_ref[0] = 0
+    landed_ref[0, 0] = 0
+    landed_ref[0, 1] = tag_ref[0]
 
     if interpreting:
         # -- symmetric interpret path (module docstring) ------------------
@@ -123,7 +132,7 @@ def _migrate_kernel(axis, mesh_axes, producer, consumer, n_layers,
                                   recv_v.at[l, i])
         # ordered after every delivery wait — the consumer-side read of
         # this count is the admission gate's ground truth
-        landed_ref[0] = n
+        landed_ref[0, 0] = n
         return
 
     # -- compiled path: the full one-sided protocol -----------------------
@@ -163,7 +172,7 @@ def _migrate_kernel(axis, mesh_axes, producer, consumer, n_layers,
                         pltpu.make_async_copy(vpool.at[l * pages + s],
                                               vpool.at[l * pages + s],
                                               send_v.at[l, i]).wait()
-        landed_ref[0] = n             # producer-side report: pages pushed
+        landed_ref[0, 0] = n          # producer-side report: pages pushed
 
     @pl.when(me == consumer)
     def _():
@@ -179,13 +188,13 @@ def _migrate_kernel(axis, mesh_axes, producer, consumer, n_layers,
                     shd.wait_recv(vpool.at[l * pages + d], recv_v.at[l, i])
         # ordered after the waits: this count is only ever observed when
         # every covered page has physically landed
-        landed_ref[0] = n
+        landed_ref[0, 0] = n
 
 
 def migrate_pages(ctx: ShmemContext, pool_k: jax.Array, pool_v: jax.Array,
                   src_ids: jax.Array, dst_ids: jax.Array, n_pages: jax.Array,
                   axis: str | None = None, producer: int = 0,
-                  consumer: int = 1):
+                  consumer: int = 1, tag: jax.Array | int = 0):
     """Collective chunk migration over the role axis.
 
     ``pool_k``/``pool_v``: symmetric pools from ``create_symm_tensor`` —
@@ -197,17 +206,20 @@ def migrate_pages(ctx: ShmemContext, pool_k: jax.Array, pool_v: jax.Array,
     ``src_ids``/``dst_ids``: ``[pmax]`` int32, replicated — producer-local
     source page ids and consumer-side destination ids, valid up to
     ``n_pages`` (``[1]`` int32). Entries past ``n_pages`` are never
-    dereferenced, so pad with anything in range.
+    dereferenced, so pad with anything in range. ``tag`` is the attempt/
+    generation stamp echoed back in the landed report (see
+    ``_migrate_kernel``; 0 for first sends, bumped per retry).
 
-    Returns ``(pool_k, pool_v, landed [n_roles] int32)`` — pools aliased
-    in place, ``landed[consumer]`` the kernel-reported delivered-page
-    count (the signal ledger's ground truth). BOTH roles must enter this
-    call (it is one SPMD program, like every collective in ops/)."""
+    Returns ``(pool_k, pool_v, landed [n_roles, 2] int32)`` — pools
+    aliased in place, ``landed[consumer] == (count, tag)``: the kernel-
+    reported delivered-page count (the signal ledger's ground truth)
+    plus the echoed attempt tag. BOTH roles must enter this call (it is
+    one SPMD program, like every collective in ops/)."""
     axis = axis or ctx.axis_names[0]
     mesh_axes = ctx.axis_names
     interp = default_interpret()
 
-    def f(n, src, dst, kp, vp):
+    def f(n, src, dst, tg, kp, vp):
         L = kp.shape[1]
         flat = lambda a: a.reshape((a.shape[1] * a.shape[2],) + a.shape[3:])
         kpl, vpl = flat(kp), flat(vp)
@@ -219,13 +231,13 @@ def migrate_pages(ctx: ShmemContext, pool_k: jax.Array, pool_v: jax.Array,
             kernel,
             out_shape=(jax.ShapeDtypeStruct(kpl.shape, kpl.dtype),
                        jax.ShapeDtypeStruct(vpl.shape, vpl.dtype),
-                       jax.ShapeDtypeStruct((1,), jnp.int32)),
-            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 3
+                       jax.ShapeDtypeStruct((1, 2), jnp.int32)),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 4
             + [pl.BlockSpec(memory_space=pl.ANY)] * 2,
             out_specs=(pl.BlockSpec(memory_space=pl.ANY),
                        pl.BlockSpec(memory_space=pl.ANY),
                        pl.BlockSpec(memory_space=pltpu.SMEM)),
-            input_output_aliases={3: 0, 4: 1},
+            input_output_aliases={4: 0, 5: 1},
             scratch_shapes=[pltpu.SemaphoreType.DMA((L, pmax)),
                             pltpu.SemaphoreType.DMA((L, pmax)),
                             pltpu.SemaphoreType.DMA((L, pmax)),
@@ -235,14 +247,15 @@ def migrate_pages(ctx: ShmemContext, pool_k: jax.Array, pool_v: jax.Array,
                 has_side_effects=True,
                 collective_id=collective_id_for(f"page_migrate_{axis}")),
             interpret=interp,
-        )(n, src, dst, kpl, vpl)
+        )(n, src, dst, tg, kpl, vpl)
         return ko.reshape(kp.shape), vo.reshape(vp.shape), landed
 
-    sm = ctx.shard_map(f, in_specs=(P(), P(), P(), P(axis), P(axis)),
-                       out_specs=(P(axis), P(axis), P(axis)))
+    sm = ctx.shard_map(f, in_specs=(P(), P(), P(), P(), P(axis), P(axis)),
+                       out_specs=(P(axis), P(axis), P(axis, None)))
     return sm(jnp.asarray(n_pages, jnp.int32).reshape(1),
               jnp.asarray(src_ids, jnp.int32),
-              jnp.asarray(dst_ids, jnp.int32), pool_k, pool_v)
+              jnp.asarray(dst_ids, jnp.int32),
+              jnp.asarray(tag, jnp.int32).reshape(1), pool_k, pool_v)
 
 
 __all__ = ["migrate_pages"]

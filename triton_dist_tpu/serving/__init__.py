@@ -5,14 +5,21 @@ EP/SP overlap ops (see docs/serving.md).
 - scheduler  — FIFO admission / preemption policy over fixed batch slots
 - engine     — the jitted one-step-per-token decode engine
 - disagg     — disaggregated prefill/decode over the shmem page-migration
-               kernel (signal-gated admission)
+               kernel (signal-gated admission + the ISSUE-7 recovery
+               ladder: deadline → retry/backoff → local re-prefill →
+               typed per-request failure)
+- deadline   — Deadline/Backoff helpers + EngineStallError (the global
+               progress watchdog both engines share)
 - metrics    — counters + histograms, JSON-lines wire format
 """
 
+from triton_dist_tpu.serving.deadline import (Backoff, Deadline,
+                                              EngineStallError)
 from triton_dist_tpu.serving.disagg import (ChunkSignalLedger,
                                             DisaggServingEngine,
                                             MigrationSignalTimeout,
-                                            PageMigrationChannel)
+                                            PageMigrationChannel,
+                                            SignalProtocolError)
 from triton_dist_tpu.serving.engine import ServingEngine
 from triton_dist_tpu.serving.kv_pool import (KVPagePool, PageLedgerError,
                                              cache_to_pages,
@@ -27,6 +34,10 @@ __all__ = [
     "PageMigrationChannel",
     "ChunkSignalLedger",
     "MigrationSignalTimeout",
+    "SignalProtocolError",
+    "Deadline",
+    "Backoff",
+    "EngineStallError",
     "KVPagePool",
     "PageLedgerError",
     "page_pool_pspec",

@@ -65,12 +65,15 @@ from triton_dist_tpu.models.llama import (LlamaConfig,
                                           init_page_pool,
                                           prefill_chunk_paged)
 from triton_dist_tpu.ops.page_migrate import migrate_pages
+from triton_dist_tpu.serving.deadline import (Backoff, Deadline,
+                                              EngineStallError)
 from triton_dist_tpu.serving.engine import (mark_prefill_start,
                                             record_first_token)
 from triton_dist_tpu.serving.kv_pool import KVPagePool, PageLedgerError
 from triton_dist_tpu.serving.metrics import ServingMetrics
 from triton_dist_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                                Request, RequestState)
+from triton_dist_tpu.shmem import faults
 from triton_dist_tpu.shmem.context import (ShmemContext,
                                            initialize_distributed)
 
@@ -79,11 +82,23 @@ DECODE_ROLE = 1
 
 
 class MigrationSignalTimeout(RuntimeError):
-    """A completed prefill waited longer than ``migrate_timeout_steps``
-    decode-worker steps for the signals covering its pages. Either the
-    transport dropped a signal/page or a chunk was never sent — the
-    message names the request, the per-chunk expected/landed counts, and
-    the uncovered pages, so the operator can tell which."""
+    """A completed prefill's covering signals never arrived within the
+    whole recovery ladder's budget (deadline + every retry rung). Either
+    the transport dropped signals/pages repeatedly, the peer is dead, or
+    a chunk was never sent — the message names the request, the per-chunk
+    expected/landed counts and covered/missing pages (the ledger dump),
+    so the operator can tell which. Since ISSUE 7 this is a PER-REQUEST
+    failure reason (``Request.failure``), not an engine-wide crash."""
+
+
+class SignalProtocolError(RuntimeError):
+    """Over-signal: a chunk's landed count exceeded the number of pages
+    the chunk was ever expected to deliver. A duplicated (or forged)
+    signal increment is a protocol violation — before ISSUE 7 it
+    silently inflated the count and could expose pages whose delivery
+    was never actually confirmed; now it poisons exactly the affected
+    request (degrade or fail), never the engine. Carries the ledger
+    dump."""
 
 
 class ChunkSignalLedger:
@@ -96,24 +111,47 @@ class ChunkSignalLedger:
     "which pages are covered?" without touching the device. Out-of-order
     chunk delivery is tolerated by construction: coverage is the union
     over COMPLETE chunks (landed >= expected), whatever order they
-    completed in. Re-``expect``-ing a chunk (preemption restart re-sends
-    it) resets its count — the pages must land again before they count.
+    completed in. Re-``expect``-ing a chunk (preemption restart or a
+    deadline-triggered retry re-sends it) resets its count AND bumps its
+    generation — the pages must land again before they count, and a
+    report stamped with an older generation (a delayed delivery from a
+    superseded attempt) is discarded as stale rather than double-counted.
+    Over-signal (landed > expected within one generation) raises
+    ``SignalProtocolError`` — a duplicate increment must never silently
+    widen coverage.
     """
 
     def __init__(self):
-        # rid -> {chunk_idx: [expected dst ids (tuple), landed count]}
+        # rid -> {chunk_idx: [expected dst ids (tuple), landed count,
+        #                     src ids (tuple, retry source), generation]}
         self._chunks: dict[int, dict[int, list]] = {}
 
-    def expect(self, rid: int, chunk_idx: int, dst_ids) -> None:
+    def expect(self, rid: int, chunk_idx: int, dst_ids,
+               src_ids=(), generation: int = 0) -> None:
         self._chunks.setdefault(rid, {})[chunk_idx] = [
-            tuple(int(p) for p in dst_ids), 0]
+            tuple(int(p) for p in dst_ids), 0,
+            tuple(int(p) for p in src_ids), int(generation)]
 
-    def landed(self, rid: int, chunk_idx: int, count: int) -> None:
+    def landed(self, rid: int, chunk_idx: int, count: int,
+               generation: int = 0) -> bool:
+        """Feed one kernel-reported landed count. Returns False (and
+        counts nothing) when ``generation`` is stale — the chunk has been
+        re-armed by a retry since this report's send was issued. Raises
+        ``SignalProtocolError`` on over-signal."""
         ent = self._chunks.get(rid, {}).get(chunk_idx)
         if ent is None:
             raise KeyError(
                 f"signal for unknown chunk {chunk_idx} of request {rid}")
+        if int(generation) != ent[3]:
+            return False
         ent[1] += int(count)
+        if ent[1] > len(ent[0]):
+            raise SignalProtocolError(
+                f"over-signal on chunk {chunk_idx} of request {rid}: "
+                f"{ent[1]} landed signals for {len(ent[0])} expected pages "
+                f"(generation {ent[3]}) — a signal increment was "
+                f"duplicated or forged. Ledger: {self.describe(rid)}")
+        return True
 
     def chunk_complete(self, rid: int, chunk_idx: int) -> bool:
         ent = self._chunks.get(rid, {}).get(chunk_idx)
@@ -124,52 +162,116 @@ class ChunkSignalLedger:
         complete chunks. A chunk at 2/3 signals covers NOTHING — partial
         coverage cannot distinguish which pages landed."""
         out: set[int] = set()
-        for ids, got in self._chunks.get(rid, {}).values():
+        for ids, got, *_ in self._chunks.get(rid, {}).values():
             if got >= len(ids):
                 out.update(ids)
         return out
 
     def expected(self, rid: int) -> set[int]:
         out: set[int] = set()
-        for ids, _ in self._chunks.get(rid, {}).values():
+        for ids, *_ in self._chunks.get(rid, {}).values():
             out.update(ids)
         return out
 
     def complete(self, rid: int) -> bool:
         chunks = self._chunks.get(rid, {})
-        return all(got >= len(ids) for ids, got in chunks.values())
+        return all(got >= len(ids) for ids, got, *_ in chunks.values())
+
+    def incomplete_chunks(self, rid: int) -> list[tuple[int, tuple, tuple]]:
+        """(chunk_idx, src_ids, dst_ids) of every chunk still short of
+        full coverage — the retry work list. Chunks whose send recorded
+        no source ids (pre-retention sends) are still listed; the caller
+        decides whether their sources survive."""
+        return [(ci, ent[2], ent[0])
+                for ci, ent in sorted(self._chunks.get(rid, {}).items())
+                if ent[1] < len(ent[0])]
+
+    def generation(self, rid: int, chunk_idx: int) -> int | None:
+        ent = self._chunks.get(rid, {}).get(chunk_idx)
+        return None if ent is None else ent[3]
+
+    def rids(self):
+        return list(self._chunks.keys())
+
+    def chunk_items(self, rid: int):
+        """(chunk_idx, expected dst ids, landed count) triples — the
+        audit interface ``KVPagePool.check(ledger=...)`` consumes."""
+        return [(ci, ent[0], ent[1])
+                for ci, ent in sorted(self._chunks.get(rid, {}).items())]
 
     def reset(self, rid: int) -> None:
         self._chunks.pop(rid, None)
 
     def describe(self, rid: int) -> str:
+        """The ledger dump (ISSUE 7 satellite): per-chunk expected vs
+        landed counts plus which pages are covered/missing — every typed
+        failure reason embeds this, so a field report is actionable
+        without a debugger."""
         chunks = self._chunks.get(rid, {})
         if not chunks:
             return "no chunks recorded"
-        return ", ".join(
-            f"chunk {ci}: {got}/{len(ids)} signals (pages {list(ids)})"
-            for ci, (ids, got) in sorted(chunks.items()))
+        per_chunk = ", ".join(
+            f"chunk {ci}: {got}/{len(ids)} signals gen {gen} "
+            f"(pages {list(ids)})"
+            for ci, (ids, got, _src, gen) in sorted(chunks.items()))
+        covered = self.covered(rid)
+        missing = sorted(self.expected(rid) - covered)
+        return (f"{per_chunk}; covered pages {sorted(covered)}, "
+                f"missing {missing}")
 
 
 class PageMigrationChannel:
     """The prefill worker's sending half: guards, launches the migration
     kernel for one chunk's finalized pages, and feeds the ledger from the
-    kernel's consumer-side landed report."""
+    kernel's consumer-side landed report.
+
+    Fault injection (ISSUE 7) is consulted HERE, per send event — this is
+    the host-tier twin of the trace-time device hooks: on CPU the
+    interpret-mode kernel elides the remote ``signal_op`` (delivery rides
+    the DMA recv semaphores), so the only place a CPU chaos test can
+    observe a lost/duplicated/late *signal* is the report path between
+    the kernel and the ledger. A drop loses the landed report (the pages
+    may well be there — the protocol must not believe it until a signal
+    says so), a dup doubles the counted increment, a delay buffers the
+    report for k engine steps (delivered by ``tick``), and a dead peer
+    suppresses the launch entirely — nothing lands, nothing reports.
+    Every attempt of every chunk gets a monotonically increasing attempt
+    number, stamped into the kernel send as its generation tag and
+    echoed back in the landed report (ops/page_migrate.py)."""
 
     def __init__(self, launch, pmax: int, reserved: int,
-                 metrics: ServingMetrics, consumer: int = DECODE_ROLE):
+                 metrics: ServingMetrics, consumer: int = DECODE_ROLE,
+                 plan: "faults.FaultPlan | None" = None, clock=None):
         self.ledger = ChunkSignalLedger()
         self._launch = launch          # jitted migrate_pages closure
         self.pmax = pmax
         self.reserved = reserved
         self.metrics = metrics
         self.consumer = consumer
+        self.plan = plan
+        self._clock = clock or (lambda: 0)   # engine-step supplier
+        self._attempt: dict[tuple[int, int], int] = {}
+        # delayed landed reports: (deliver_at_step, rid, chunk, count, gen)
+        self._delayed: list[tuple[int, int, int, int, int]] = []
+
+    def _active_plan(self):
+        return self.plan if self.plan is not None else faults.active_plan()
+
+    def forget(self, rid: int) -> None:
+        """Drop attempt counters for a request leaving the system
+        (finished/failed). Its ledger entries are reset separately; any
+        still-buffered delayed report for it is delivered to a missing
+        entry and discarded as stale."""
+        for key in [k for k in self._attempt if k[0] == rid]:
+            del self._attempt[key]
 
     def send_chunk(self, rid: int, chunk_idx: int, src_ids, dst_ids,
                    pool_k, pool_v):
         """Push one chunk's pages; returns the threaded pools. The id
         arrays are padded to the compiled ``pmax`` width (one program for
-        every chunk size); padding is never dereferenced by the kernel."""
+        every chunk size); padding is never dereferenced by the kernel.
+        Re-sending the same chunk (preemption restart or deadline retry)
+        bumps its attempt number/generation."""
         n = len(src_ids)
         assert n == len(dst_ids), (src_ids, dst_ids)
         assert 0 < n <= self.pmax, (n, self.pmax)
@@ -178,7 +280,18 @@ class PageMigrationChannel:
                 raise PageLedgerError(
                     f"refusing to migrate reserved scratch page {p} "
                     f"(request {rid}) — scratch is engine-local parking")
-        self.ledger.expect(rid, chunk_idx, dst_ids)
+        attempt = self._attempt.get((rid, chunk_idx), -1) + 1
+        self._attempt[(rid, chunk_idx)] = attempt
+        self.ledger.expect(rid, chunk_idx, dst_ids, src_ids=src_ids,
+                           generation=attempt)
+        plan = self._active_plan()
+        now = self._clock()
+        if plan is not None and plan.peer_dead(now):
+            # dead link: the launch never happens — no pages move, no
+            # report arrives, and the ledger stays at 0/n until the
+            # consumer-side deadline walks the recovery ladder
+            self.metrics.inc("faults_injected")
+            return pool_k, pool_v
         src = np.zeros(self.pmax, np.int32)
         dst = np.zeros(self.pmax, np.int32)
         src[:n] = src_ids
@@ -186,15 +299,62 @@ class PageMigrationChannel:
         t0 = time.perf_counter()
         pool_k, pool_v, landed = self._launch(
             jnp.asarray(src), jnp.asarray(dst),
-            jnp.asarray([n], np.int32), pool_k, pool_v)
-        got = int(np.asarray(landed)[self.consumer])
+            jnp.asarray([n], np.int32), jnp.asarray([attempt], np.int32),
+            pool_k, pool_v)
+        row = np.asarray(landed)[self.consumer]
+        got, echoed = int(row[0]), int(row[1])
+        assert echoed == attempt, (
+            f"migrate kernel echoed tag {echoed} for send attempt "
+            f"{attempt} (rid {rid} chunk {chunk_idx})")
         dt = time.perf_counter() - t0
-        self.ledger.landed(rid, chunk_idx, got)
         self.metrics.inc("migrate_chunks")
-        self.metrics.inc("pages_migrated", got)
         self.metrics.observe("migrate_s", dt)
-        self.metrics.observe("migrate_pages_per_chunk", got)
+        action, k = (("ok", 0) if plan is None
+                     else plan.signal_action(rid, chunk_idx, attempt))
+        if action == "drop":
+            # the signal never arrives: pages moved, the protocol must
+            # not (and does not) believe it
+            self.metrics.inc("faults_injected")
+            return pool_k, pool_v
+        if action == "delay":
+            self.metrics.inc("faults_injected")
+            self._delayed.append((now + k, rid, chunk_idx, got, attempt))
+            return pool_k, pool_v
+        if action == "dup":
+            self.metrics.inc("faults_injected")
+            got *= 2                   # duplicated increment — over-signal
+        if self.ledger.landed(rid, chunk_idx, got, generation=attempt):
+            self.metrics.inc("pages_migrated", min(got, n))
+            self.metrics.observe("migrate_pages_per_chunk", min(got, n))
         return pool_k, pool_v
+
+    def tick(self, now: int) -> list[tuple[int, Exception]]:
+        """Deliver delayed landed reports whose time has come. Returns
+        the (rid, error) pairs of any report that tripped a protocol
+        error on delivery — the engine routes those into the affected
+        request's failure domain. Reports for unknown chunks (the
+        request finished/failed/was re-armed meanwhile) and stale
+        generations are discarded and counted as ``stale_signals``."""
+        if not self._delayed:
+            return []
+        due = [d for d in self._delayed if d[0] <= now]
+        self._delayed = [d for d in self._delayed if d[0] > now]
+        poisoned: list[tuple[int, Exception]] = []
+        for _, rid, chunk_idx, got, gen in due:
+            try:
+                fresh = self.ledger.landed(rid, chunk_idx, got,
+                                           generation=gen)
+            except KeyError:
+                fresh = False
+            except SignalProtocolError as e:
+                poisoned.append((rid, e))
+                continue
+            if fresh:
+                self.metrics.inc("pages_migrated", got)
+                self.metrics.observe("migrate_pages_per_chunk", got)
+            else:
+                self.metrics.inc("stale_signals")
+        return poisoned
 
 
 class DisaggServingEngine:
@@ -205,14 +365,36 @@ class DisaggServingEngine:
     page per role). ``num_slots`` is the decode batch width;
     ``num_prefill_slots`` bounds concurrent chunked prefills.
     ``prefill_chunk`` is mandatory here — chunks ARE the migration unit.
-    ``migrate_timeout_steps`` bounds how many decode-worker steps a
-    completed prefill may wait for its covering signals before
-    ``MigrationSignalTimeout``.
+
+    Recovery ladder (ISSUE 7): a MIGRATING request's wait for covering
+    signals runs against a ``Deadline`` of ``signal_deadline_steps``
+    decode-worker steps. On expiry the engine RETRIES — re-issues the
+    ``migrate_pages`` send for every incomplete chunk (the prefill worker
+    RETAINS its source pages through MIGRATING precisely so the bytes
+    still exist to re-send) — with exponential backoff over at most
+    ``max_retries`` rungs. When the rungs run dry (or the sources are
+    gone, or a chunk was never sent, or the ledger detected over-signal)
+    the request DEGRADES: the decode worker re-prefills the prompt
+    locally into its own reserved pages using the same compiled chunk
+    program (real inputs in the DECODE_ROLE row — the PR-6 preemption
+    fallback run in place, without bouncing through the possibly-dead
+    peer), up to ``max_degradations`` times. Only with
+    ``allow_degradation=False`` (a decode worker genuinely unable to
+    prefill) or the degradation budget spent does the request become
+    ``FAILED`` — with a typed reason carrying the ledger dump — while
+    the engine and every other request keep running. ``engine.run`` adds
+    a global progress watchdog (``stall_deadline_steps``, auto-sized
+    above the whole ladder budget) raising ``EngineStallError`` so no
+    residual bug can ever present as a hang. ``fault_plan`` injects a
+    seeded :class:`~triton_dist_tpu.shmem.faults.FaultPlan` into the
+    migration channel (tests/test_chaos.py drives this).
 
     Request lifecycle: QUEUED (prefill queue) → PREFILLING (prefill slot;
     decode-side pages reserved; chunks run and migrate) → MIGRATING
-    (prefill done, prefill pages freed, first token in hand; waiting for
-    a decode slot + covering signals) → ACTIVE (decoding) → FINISHED.
+    (prefill done, first token in hand, prefill-side pages RETAINED as
+    the retry source; waiting for a decode slot + covering signals) →
+    ACTIVE (decoding; prefill-side pages released on the flip) →
+    FINISHED, with the FAILED terminal only at the bottom of the ladder.
     A decode-side victim loses its pages AND its migrated KV: it requeues
     at the FRONT of the prefill queue and re-prefills from scratch —
     greedy determinism regenerates identical tokens. A prefill-side
@@ -226,10 +408,16 @@ class DisaggServingEngine:
                  page_size: int = 16, num_pages: int = 64,
                  pages_per_seq: int = 8, prefill_chunk: int = 16,
                  decode_horizon: int = 1, eos_id: int | None = None,
-                 ffn=None, migrate_timeout_steps: int = 64,
+                 ffn=None, signal_deadline_steps: int = 16,
+                 max_retries: int = 3, allow_degradation: bool = True,
+                 max_degradations: int = 1,
+                 stall_deadline_steps: int | None = None,
+                 wall_deadline_s: float | None = None,
+                 fault_plan: "faults.FaultPlan | None" = None,
                  metrics: ServingMetrics | None = None,
                  metrics_decode: ServingMetrics | None = None):
         assert prefill_chunk >= 1 and decode_horizon >= 1
+        assert signal_deadline_steps >= 1 and max_retries >= 0
         if ctx is None:
             ctx = initialize_distributed(axis_names=(axis,), mesh_shape=(2,))
         assert ctx.axis_size(axis) == 2, (
@@ -244,7 +432,17 @@ class DisaggServingEngine:
         self.prefill_chunk = prefill_chunk
         self.decode_horizon = decode_horizon
         self.eos_id = eos_id
-        self.migrate_timeout_steps = migrate_timeout_steps
+        self.signal_deadline_steps = signal_deadline_steps
+        self.max_retries = max_retries
+        self.allow_degradation = allow_degradation
+        self.max_degradations = max_degradations
+        self.wall_deadline_s = wall_deadline_s
+        # the whole ladder's worst-case wait for ONE request: the initial
+        # deadline plus every backoff rung — the stall watchdog must sit
+        # safely above it, or legitimate ladder waits would trip it
+        ladder = signal_deadline_steps * (2 ** (max_retries + 1) - 1)
+        self._stall_steps = (stall_deadline_steps if stall_deadline_steps
+                             is not None else max(256, 4 * ladder))
         # TTFT lives on the prefill worker's panel, ITL on the decode
         # worker's — the isolation the disaggregation exists to provide
         self.metrics = metrics or ServingMetrics()
@@ -265,7 +463,15 @@ class DisaggServingEngine:
         self._handoff: deque[Request] = deque()   # MIGRATING, no slot yet
         self._dslot: dict[int, int] = {}          # rid -> decode slot
         self._wait_steps: dict[int, int] = {}     # rid -> signal-wait steps
+        # recovery ladder state (ISSUE 7): per-MIGRATING-request deadline
+        # + backoff; requests whose ledger tripped a protocol error
+        # (poisoned coverage — degrade/fail on sight, never retry); rids
+        # currently re-prefilling LOCALLY on the decode worker
+        self._recovery: dict[int, tuple[Deadline, Backoff]] = {}
+        self._poisoned: dict[int, Exception] = {}
+        self._local_prefill: set[int] = set()
         self._finished: list[Request] = []
+        self._failed: list[Request] = []
         self._next_rid = 0
         self._steps = 0
 
@@ -316,10 +522,10 @@ class DisaggServingEngine:
             dec_f, in_specs=(P(),) + (pspec,) * 6,
             out_specs=(pspec,) * 5)
 
-        def mig_f(src, dst, n, kp, vp):
+        def mig_f(src, dst, n, tag, kp, vp):
             return migrate_pages(ctx, kp, vp, src, dst, n, axis=axis,
                                  producer=PREFILL_ROLE,
-                                 consumer=DECODE_ROLE)
+                                 consumer=DECODE_ROLE, tag=tag)
 
         if jax.default_backend() == "cpu":   # CPU: donation unsupported
             self._chunk_step = jax.jit(chunk_sm)
@@ -328,15 +534,17 @@ class DisaggServingEngine:
         else:
             self._chunk_step = jax.jit(chunk_sm, donate_argnums=(4, 5))
             self._dec_step = jax.jit(dec_sm, donate_argnums=(3, 4))
-            self._migrate = jax.jit(mig_f, donate_argnums=(3, 4))
+            self._migrate = jax.jit(mig_f, donate_argnums=(4, 5))
 
         # widest possible per-chunk migration: a C-token chunk can
         # finalize at most C//ps whole pages plus the straddle page it
-        # completes plus the final chunk's partial last page
-        pmax = prefill_chunk // page_size + 2
+        # completes plus the final chunk's partial last page — and a
+        # RETRY may need to re-send a whole prompt's pages in one call
+        pmax = max(prefill_chunk // page_size + 2, pages_per_seq)
         self.channel = PageMigrationChannel(
             self._migrate, pmax, reserved=1, metrics=self.metrics,
-            consumer=DECODE_ROLE)
+            consumer=DECODE_ROLE, plan=fault_plan,
+            clock=lambda: self._steps)
 
     # -- request intake (prefill worker) ----------------------------------
     def submit(self, prompt, max_new_tokens: int, rid: int | None = None
@@ -413,60 +621,137 @@ class DisaggServingEngine:
         self.pool_k, self.pool_v = self.channel.send_chunk(
             req.rid, chunk_idx, src, dst, self.pool_k, self.pool_v)
 
-    def _dispatch_prefill_chunk(self) -> int:
-        """At most ONE chunk per step (Sarathi co-scheduling, same policy
-        as the colocated engine): the oldest PREFILLING slot advances one
-        chunk, then its finalized pages migrate. The final chunk frees
-        the prefill-side pages and hands the request off as MIGRATING
-        with its device-argmaxed first token on the host control plane.
-        Returns prompt tokens processed."""
-        slot, req = None, None
+    def _oldest_local_prefill(self) -> tuple[int, Request] | None:
+        """Oldest (by admission ticket) degraded request re-prefilling
+        locally on the decode worker — the DECODE_ROLE row's candidate
+        for this step's chunk dispatch."""
+        best = None
+        for rid in self._local_prefill:
+            slot = self._dslot[rid]
+            r = self.sched_d.slots[slot]
+            if r is None:
+                continue
+            if best is None or r.admitted_seq < best[1].admitted_seq:
+                best = (slot, r)
+        return best
+
+    def _dispatch_chunks(self) -> int:
+        """At most ONE chunk per WORKER per step (Sarathi co-scheduling,
+        same policy as the colocated engine), in a single dispatch of the
+        role-symmetric chunk program: the PREFILL_ROLE row advances the
+        oldest PREFILLING prefill slot; the DECODE_ROLE row — parked in
+        healthy operation — carries a DEGRADED request's local re-prefill
+        chunk (ISSUE 7): same compiled program, real tokens/block-table
+        in the decode row, writing straight into the decode worker's own
+        reserved pages. That is what makes degradation free of new
+        compiles AND free of the possibly-dead peer.
+
+        The prefill row's final chunk hands the request off as MIGRATING
+        with its device-argmaxed first token on the host control plane;
+        its prefill-side pages are RETAINED (the retry source) until the
+        decode side confirms coverage. The decode row's final chunk flips
+        its request straight to ACTIVE — the KV and first token were
+        recomputed locally, no signals to wait for. Returns PREFILL-row
+        prompt tokens processed (the decode row's tokens are accounted
+        separately as degraded_prefill_tokens — the decode worker's
+        step_prefill_tokens isolation invariant only covers healthy
+        operation)."""
+        slot_p, req_p = None, None
         for i, r in enumerate(self.sched_p.slots):
             if (r is not None and r.state is RequestState.PREFILLING
-                    and (req is None or r.admitted_seq < req.admitted_seq)):
-                slot, req = i, r
-        if slot is None:
+                    and (req_p is None
+                         or r.admitted_seq < req_p.admitted_seq)):
+                slot_p, req_p = i, r
+        local = self._oldest_local_prefill()
+        if slot_p is None and local is None:
             return 0
         C = self.prefill_chunk
-        sp = len(req.prompt)
-        start = req.prefill_cursor
-        part = req.prompt[start:start + C]
         toks = np.zeros((2, C), np.int32)
-        toks[PREFILL_ROLE, :len(part)] = part
         starts = np.zeros(2, np.int32)
         plens = np.zeros(2, np.int32)
-        starts[PREFILL_ROLE] = start
-        plens[PREFILL_ROLE] = sp
         bt = np.zeros((2, self.pages_per_seq), np.int32)
-        bt[PREFILL_ROLE] = np.asarray(
-            self.alloc_p.block_table_row(req.rid, self.pages_per_seq),
-            np.int32)
+        if req_p is not None:
+            part = req_p.prompt[req_p.prefill_cursor:
+                                req_p.prefill_cursor + C]
+            toks[PREFILL_ROLE, :len(part)] = part
+            starts[PREFILL_ROLE] = req_p.prefill_cursor
+            plens[PREFILL_ROLE] = len(req_p.prompt)
+            bt[PREFILL_ROLE] = np.asarray(self.alloc_p.block_table_row(
+                req_p.rid, self.pages_per_seq), np.int32)
+        if local is not None:
+            slot_d, req_d = local
+            part_d = req_d.prompt[req_d.prefill_cursor:
+                                  req_d.prefill_cursor + C]
+            toks[DECODE_ROLE, :len(part_d)] = part_d
+            starts[DECODE_ROLE] = req_d.prefill_cursor
+            plens[DECODE_ROLE] = len(req_d.prompt)
+            bt[DECODE_ROLE] = np.asarray(self.alloc_d.block_table_row(
+                req_d.rid, self.pages_per_seq), np.int32)
         t0 = time.perf_counter()
         tok_dev, self.pool_k, self.pool_v = self._chunk_step(
             self.params, jnp.asarray(toks), jnp.asarray(starts),
             jnp.asarray(plens), self.pool_k, self.pool_v, jnp.asarray(bt))
-        tok0 = int(np.asarray(tok_dev)[PREFILL_ROLE])   # fence + maybe tok0
+        tok_np = np.asarray(tok_dev)                    # fence + maybe toks
         dt = time.perf_counter() - t0
-        cursor_new = min(start + C, sp)
-        req.prefill_cursor = cursor_new
-        self.metrics.inc("prefill_chunks")
-        self.metrics.observe("prefill_stall_s", dt)
-        self._migrate_finalized(req, start, cursor_new)
-        if cursor_new < sp:
-            return len(part)
-        # prefill complete: the request leaves this worker entirely — its
-        # prefill pages free NOW (the decode copies are the live ones) and
-        # only the first token crosses on the host control plane
-        req.first_token = tok0
-        record_first_token(req, self.metrics, self._steps)
-        self.metrics.inc("tokens_generated")
-        self.metrics.inc("handoffs")
-        self.alloc_p.free_seq(req.rid)
-        self.sched_p.remove(slot)
-        req.state = RequestState.MIGRATING
-        if req.rid not in self._dslot:
-            self._handoff.append(req)
-        return len(part)
+
+        ptoks = 0
+        if req_p is not None:
+            sp = len(req_p.prompt)
+            start = req_p.prefill_cursor
+            ptoks = min(C, sp - start)
+            cursor_new = min(start + C, sp)
+            req_p.prefill_cursor = cursor_new
+            self.metrics.inc("prefill_chunks")
+            self.metrics.observe("prefill_stall_s", dt)
+            try:
+                self._migrate_finalized(req_p, start, cursor_new)
+            except SignalProtocolError as e:
+                self._poison(slot_p, req_p, e)
+            if req_p.state is RequestState.PREFILLING and cursor_new >= sp:
+                # prefill complete: the request leaves this worker's
+                # SCHEDULER, but its pages stay owned — they are the
+                # retry source until the decode side confirms coverage
+                # (released on the ACTIVE flip / degradation / failure)
+                req_p.first_token = int(tok_np[PREFILL_ROLE])
+                record_first_token(req_p, self.metrics, self._steps)
+                self.metrics.inc("tokens_generated")
+                self.metrics.inc("handoffs")
+                self.sched_p.remove(slot_p)
+                req_p.state = RequestState.MIGRATING
+                if req_p.rid not in self._dslot:
+                    self._handoff.append(req_p)
+
+        if local is not None:
+            sp_d = len(req_d.prompt)
+            start_d = req_d.prefill_cursor
+            req_d.prefill_cursor = min(start_d + C, sp_d)
+            self.metrics_decode.observe("degraded_prefill_tokens",
+                                        min(C, sp_d - start_d))
+            if req_d.prefill_cursor >= sp_d:
+                self._complete_local_prefill(slot_d, req_d,
+                                             int(tok_np[DECODE_ROLE]))
+        return ptoks
+
+    def _complete_local_prefill(self, slot: int, req: Request,
+                                tok0: int) -> None:
+        """A degraded request's local re-prefill finished: flip straight
+        to ACTIVE. The first token was recomputed by the same fused chunk
+        argmax (bit-identical to the remote one by greedy determinism);
+        no handoff is counted — this request never completed one."""
+        rid = req.rid
+        self._local_prefill.discard(rid)
+        self.metrics_decode.observe(
+            "degraded_ttft_s", time.perf_counter() - req.submit_time)
+        req.state = RequestState.ACTIVE
+        req.generated.append(tok0)
+        self.metrics_decode.inc("tokens_generated")
+        self._token[slot] = tok0
+        self._pos[slot] = len(req.prompt)
+        self._bt[slot] = np.asarray(self.alloc_d.block_table_row(
+            rid, self.pages_per_seq), np.int32)
+        self._dirty = True
+        if req.done:
+            self._finish_decode(slot)
 
     def force_preempt_prefill(self) -> int | None:
         """Forced mid-prefill preemption on the PREFILL worker (test/ops
@@ -528,12 +813,24 @@ class DisaggServingEngine:
         (deterministic). A MIGRATING slot's row tracks the landed prefix
         each step; the slot flips to ACTIVE the step its prompt pages are
         fully covered — the admission gate is the LEDGER (fed only by the
-        kernel's post-wait landed reports), never a host-side clock."""
+        kernel's post-wait landed reports), never a host-side clock.
+
+        The wait is DEADLINED (ISSUE 7): expiry walks the recovery
+        ladder — re-send the incomplete chunks with exponential backoff,
+        then degrade to decode-local re-prefill, then (and only then)
+        fail THIS request with a typed reason. The engine never raises
+        out of here for a transport fault."""
         for slot in range(self.num_slots):
             req = self.sched_d.slots[slot]
             if req is None or req.state is not RequestState.MIGRATING:
                 continue
             rid = req.rid
+            if rid in self._poisoned:
+                # coverage was voided by a protocol error: nothing the
+                # ledger says about this request can be trusted, so the
+                # retry rungs are skipped entirely
+                self._degrade_or_fail(slot, req, self._poisoned.pop(rid))
+                continue
             covered = self.channel.ledger.covered(rid)
             row = np.asarray(self.alloc_d.landed_row(
                 rid, covered, self.pages_per_seq), np.int32)
@@ -546,6 +843,16 @@ class DisaggServingEngine:
             if req.first_token is not None and need <= covered:
                 self.metrics_decode.observe(
                     "migrate_wait_steps", self._wait_steps.pop(rid, 0))
+                if req.retries:
+                    # the ladder's retry rung earned this handoff
+                    self.metrics_decode.observe(
+                        "recovered_ttft_s",
+                        time.perf_counter() - req.submit_time)
+                self._recovery.pop(rid, None)
+                if self.alloc_p.holds(rid):
+                    # coverage confirmed: the retry source has served its
+                    # purpose — release the prefill-side copies
+                    self.alloc_p.free_seq(rid)
                 req.state = RequestState.ACTIVE
                 req.generated.append(req.first_token)
                 self.metrics_decode.inc("handoffs")
@@ -556,23 +863,155 @@ class DisaggServingEngine:
                 self._dirty = True
                 if req.done:      # max_new_tokens == 1 or tok0 == eos_id
                     self._finish_decode(slot)
-            else:
-                w = self._wait_steps.get(rid, 0) + 1
-                self._wait_steps[rid] = w
-                if w > self.migrate_timeout_steps:
-                    missing = sorted(need - covered)
-                    raise MigrationSignalTimeout(
-                        f"request {rid} waited {w} decode steps for "
-                        f"migration signals covering pages {missing}; "
-                        f"ledger: {self.channel.ledger.describe(rid)}. "
-                        "A signal or page delivery was lost (or a chunk "
-                        "was never sent).")
+                continue
+            self._wait_steps[rid] = self._wait_steps.get(rid, 0) + 1
+            rec = self._recovery.get(rid)
+            if rec is None:
+                rec = (Deadline(self.signal_deadline_steps, self._steps,
+                                wall_s=self.wall_deadline_s),
+                       Backoff(self.signal_deadline_steps,
+                               max_retries=self.max_retries))
+                self._recovery[rid] = rec
+            deadline, backoff = rec
+            if not deadline.expired(self._steps):
+                continue
+            budget = backoff.next_budget()
+            retried = False
+            if budget is not None:
+                try:
+                    retried = self._retry_migration(req)
+                except SignalProtocolError as e:
+                    self._degrade_or_fail(slot, req, e)
+                    continue
+            if retried:
+                deadline.rearm(budget, self._steps)
+                continue
+            missing = sorted(need - covered)
+            self._degrade_or_fail(slot, req, MigrationSignalTimeout(
+                f"request {rid} waited {self._wait_steps.get(rid, 0)} "
+                f"decode steps (deadline {self.signal_deadline_steps}, "
+                f"{backoff.attempt} retry rung(s) spent) for migration "
+                f"signals covering pages {missing}; ledger: "
+                f"{self.channel.ledger.describe(rid)}. A signal or page "
+                "delivery was lost (or a chunk was never sent)."))
+
+    # -- recovery ladder (ISSUE 7) ----------------------------------------
+    def _retry_migration(self, req: Request) -> bool:
+        """Rung 1: re-issue the ``migrate_pages`` send for every chunk
+        still short of coverage. Possible only while the prefill-side
+        source pages survive (they are retained through MIGRATING for
+        exactly this) and every missing page belongs to a chunk that WAS
+        sent — an unsent chunk or freed sources cannot be retried, the
+        caller moves straight down the ladder. Returns True when a
+        re-send was actually issued."""
+        rid = req.rid
+        if not self.alloc_p.holds(rid):
+            return False
+        incomplete = self.channel.ledger.incomplete_chunks(rid)
+        if not incomplete:
+            # complete per-chunk coverage yet an uncovered needed page:
+            # some chunk was never sent at all — re-sending fixes nothing
+            return False
+        src_owned = set(self.alloc_p.pages_of(rid))
+        for _, src_ids, _ in incomplete:
+            if not src_ids or not set(src_ids) <= src_owned:
+                return False
+        for ci, src_ids, dst_ids in incomplete:
+            self.pool_k, self.pool_v = self.channel.send_chunk(
+                rid, ci, list(src_ids), list(dst_ids),
+                self.pool_k, self.pool_v)
+        req.retries += 1
+        self.metrics_decode.inc("retries")
+        return True
+
+    def _degrade_or_fail(self, slot: int, req: Request,
+                         exc: Exception) -> None:
+        """Rung 2 vs the terminal: local re-prefill while the degradation
+        budget and capability allow, typed per-request failure after."""
+        if (self.allow_degradation
+                and req.degradations < self.max_degradations):
+            self._degrade(slot, req)
+        else:
+            self._fail_decode(slot, req, exc)
+
+    def _degrade(self, slot: int, req: Request) -> None:
+        """Rung 2: decode-local re-prefill (the PR-6 preemption fallback
+        run IN PLACE). The request keeps its decode slot and its decode-
+        side page reservation; the prompt KV is recomputed by the same
+        compiled chunk program with real inputs in the DECODE_ROLE row
+        (``_dispatch_chunks``), so the possibly-dead peer is out of the
+        loop entirely. All migrated coverage is voided — the locally
+        computed pages are the only ones trusted from here on."""
+        rid = req.rid
+        req.degradations += 1
+        self.metrics_decode.inc("degradations")
+        self.channel.ledger.reset(rid)
+        self._recovery.pop(rid, None)
+        self._wait_steps.pop(rid, None)
+        self._poisoned.pop(rid, None)
+        if self.alloc_p.holds(rid):
+            self.alloc_p.free_seq(rid)   # source copies are useless now
+        req.state = RequestState.PREFILLING
+        req.prefill_cursor = 0
+        self._local_prefill.add(rid)
+        self._park(slot)
+
+    def _fail_decode(self, slot: int, req: Request, exc: Exception) -> None:
+        """The ladder's terminal: THIS request fails, typed, with the
+        ledger dump riding on ``exc`` — the engine and every other
+        request keep running (per-request failure domain)."""
+        rid = req.rid
+        self.sched_d.remove(slot)
+        req.state = RequestState.FAILED
+        req.failure = exc
+        if self.alloc_p.holds(rid):
+            self.alloc_p.free_seq(rid)
+        self.alloc_d.free_seq(rid)
+        self.channel.ledger.reset(rid)
+        self.channel.forget(rid)
+        self._recovery.pop(rid, None)
+        self._wait_steps.pop(rid, None)
+        self._poisoned.pop(rid, None)
+        self._local_prefill.discard(rid)
+        del self._dslot[rid]
+        self._park(slot)
+        self._failed.append(req)
+        self.metrics_decode.inc("failed_requests")
+
+    def _poison(self, slot: int, req: Request, exc: Exception) -> None:
+        """A protocol error surfaced while the request still sits on the
+        PREFILL worker: void all coverage now; the ladder's degrade/fail
+        decision runs when (if) the request reaches a decode slot —
+        unless degradation is impossible, in which case it fails right
+        here rather than limping through a doomed migration."""
+        rid = req.rid
+        self.channel.ledger.reset(rid)
+        if (self.allow_degradation
+                and req.degradations < self.max_degradations):
+            self._poisoned[rid] = exc
+            return
+        self.sched_p.remove(slot)
+        req.state = RequestState.FAILED
+        req.failure = exc
+        if self.alloc_p.holds(rid):
+            self.alloc_p.free_seq(rid)
+        if self.alloc_d.holds(rid):
+            self.alloc_d.free_seq(rid)
+        self.channel.forget(rid)
+        self._failed.append(req)
+        self.metrics_decode.inc("failed_requests")
 
     def _finish_decode(self, slot: int) -> None:
         req = self.sched_d.finish(slot)
         self.alloc_d.free_seq(req.rid)
+        if self.alloc_p.holds(req.rid):
+            self.alloc_p.free_seq(req.rid)
         self.channel.ledger.reset(req.rid)
+        self.channel.forget(req.rid)
+        self._recovery.pop(req.rid, None)
         self._wait_steps.pop(req.rid, None)
+        self._poisoned.pop(req.rid, None)
+        self._local_prefill.discard(req.rid)
         del self._dslot[req.rid]
         req.finish_step = self._steps
         self._park(slot)
@@ -583,7 +1022,10 @@ class DisaggServingEngine:
         """Decode-side eviction loses the migrated KV with the pages: the
         victim restarts as a fresh prefill (FRONT of the prefill queue) —
         determinism regenerates identical tokens. ``remove`` (not
-        ``evict``): the requeue target is the PEER scheduler."""
+        ``evict``): the requeue target is the PEER scheduler. A MIGRATING
+        victim also drops its retained prefill-side retry source and any
+        in-flight recovery state; a locally-re-prefilling victim rejoins
+        the normal remote pipeline."""
         req = self.sched_d.remove(slot)
         req.state = RequestState.QUEUED
         req.preemptions += 1
@@ -591,8 +1033,13 @@ class DisaggServingEngine:
         req.prefill_cursor = 0
         req.first_token = None
         self.alloc_d.free_seq(req.rid)
+        if self.alloc_p.holds(req.rid):
+            self.alloc_p.free_seq(req.rid)
         self.channel.ledger.reset(req.rid)
+        self._recovery.pop(req.rid, None)
         self._wait_steps.pop(req.rid, None)
+        self._poisoned.pop(req.rid, None)
+        self._local_prefill.discard(req.rid)
         del self._dslot[req.rid]
         self.sched_p.submit(req, front=True)
         self._park(slot)
@@ -623,11 +1070,17 @@ class DisaggServingEngine:
             if adm is None:
                 break
             self._admit_prefill(*adm)
-        ptoks = self._dispatch_prefill_chunk()
+        ptoks = self._dispatch_chunks()
         self.metrics.observe("step_prefill_tokens", ptoks)
 
         # ---- decode worker: seating, patching, gated admission ----------
         t_d = time.perf_counter()
+        # deliver any fault-delayed landed reports BEFORE gating, so a
+        # late signal can still admit this step; a report that arrives
+        # poisoned (over-signal) voids its request's coverage instead of
+        # crashing the engine — the ladder decides its fate at seat time
+        for rid, exc in self.channel.tick(self._steps):
+            self._poisoned.setdefault(rid, exc)
         self._seat_decode_slots()
         self._patch_and_admit()
 
@@ -725,9 +1178,18 @@ class DisaggServingEngine:
             arrivals=None) -> dict[int, list[int]]:
         """Drive ``step()`` until idle (or ``max_steps``); same contract
         as ``ServingEngine.run`` — returns {rid: tokens} for FINISHED
-        requests only."""
+        requests only (``failed`` exposes the casualties).
+
+        A global progress WATCHDOG (ISSUE 7) backstops the per-request
+        ladder: if no externally visible progress marker moves for
+        ``_stall_steps`` consecutive non-idle steps — longer than any
+        legitimate full-ladder wait — the engine raises
+        ``EngineStallError`` with a state dump. Chaos runs assert this
+        never fires: every fault path must END somewhere (handoff,
+        degradation, or typed failure), not spin."""
         pending = deque(arrivals or [])
         i = 0
+        marker, since = self._progress_marker(), 0
         while max_steps is None or i < max_steps:
             while pending and pending[0][0] <= i:
                 _, prompt, mnt = pending.popleft()
@@ -735,7 +1197,50 @@ class DisaggServingEngine:
             if not self.step() and not pending:
                 break
             i += 1
+            m = self._progress_marker()
+            if m != marker:
+                marker, since = m, 0
+            else:
+                since += 1
+                if since >= self._stall_steps and not self.idle:
+                    raise EngineStallError(self._stall_report(since))
         return {req.rid: list(req.generated) for req in self._finished}
+
+    def _progress_marker(self) -> tuple:
+        """Anything that moves when the engine is making real progress:
+        tokens, chunks, migrations, and every rung of the ladder
+        (retries/degradations/failures count as progress — they bound a
+        wait, they don't extend it)."""
+        c, d = self.metrics.counters, self.metrics_decode.counters
+        return (c["prefill_chunks"], c["pages_migrated"], c["migrate_chunks"],
+                d["tokens_generated"], d["handoffs"], d["retries"],
+                d["degradations"], d["failed_requests"], d["preemptions"],
+                len(self._finished), len(self._failed),
+                self.metrics_decode.hist["degraded_prefill_tokens"].count)
+
+    def _stall_report(self, since: int) -> str:
+        rows = []
+        for name, sched in (("prefill", self.sched_p),
+                            ("decode", self.sched_d)):
+            for slot, req in sched.active:
+                rows.append(
+                    f"{name}[{slot}]: rid={req.rid} {req.state.value} "
+                    f"cursor={req.prefill_cursor} retries={req.retries} "
+                    f"degradations={req.degradations}")
+        return (f"engine made no progress for {since} steps "
+                f"(stall deadline {self._stall_steps}, step {self._steps}); "
+                f"queues: prefill={self.sched_p.queue_depth} "
+                f"handoff={len(self._handoff)} "
+                f"local_prefill={sorted(self._local_prefill)} "
+                f"recovering={sorted(self._recovery)} "
+                f"poisoned={sorted(self._poisoned)}; slots: "
+                + ("; ".join(rows) if rows else "<none>"))
+
+    @property
+    def failed(self) -> list[Request]:
+        """Requests the recovery ladder could not save, in failure order;
+        each carries its typed reason in ``req.failure``."""
+        return list(self._failed)
 
     # -- introspection ----------------------------------------------------
     @property
@@ -763,4 +1268,4 @@ class DisaggServingEngine:
 
 __all__ = ["DisaggServingEngine", "PageMigrationChannel",
            "ChunkSignalLedger", "MigrationSignalTimeout",
-           "PREFILL_ROLE", "DECODE_ROLE"]
+           "SignalProtocolError", "PREFILL_ROLE", "DECODE_ROLE"]

@@ -60,6 +60,7 @@ from triton_dist_tpu.models.llama import (LlamaConfig,
                                           decode_multistep_paged,
                                           init_kv_cache, init_page_pool,
                                           prefill, prefill_chunk_paged)
+from triton_dist_tpu.serving.deadline import EngineStallError
 from triton_dist_tpu.serving.kv_pool import KVPagePool, cache_to_pages
 from triton_dist_tpu.serving.metrics import ServingMetrics
 from triton_dist_tpu.serving.scheduler import (ContinuousBatchingScheduler,
@@ -136,9 +137,11 @@ class ServingEngine:
                  decode_horizon: int = 1,
                  prefill_buckets="pow2",
                  eos_id: int | None = None,
-                 prefill_chunk: int | None = None):
+                 prefill_chunk: int | None = None,
+                 stall_deadline_steps: int = 256):
         assert decode_horizon >= 1
         assert prefill_chunk is None or prefill_chunk >= 1
+        assert stall_deadline_steps >= 1
         self.params = params
         self.cfg = cfg
         self.page_size = page_size
@@ -148,6 +151,7 @@ class ServingEngine:
         self.metrics = metrics or ServingMetrics()
         self.decode_horizon = decode_horizon
         self.eos_id = eos_id
+        self._stall_steps = stall_deadline_steps
         if prefill_buckets is not None and prefill_buckets != "pow2":
             prefill_buckets = tuple(sorted(int(b) for b in prefill_buckets))
             assert prefill_buckets, "bucket list must be non-empty"
@@ -543,9 +547,16 @@ class ServingEngine:
         an optional iterable of (step_index, prompt, max_new_tokens)
         sorted by step — the synthetic-trace replay hook serve_sim uses.
         Returns {rid: generated tokens} for FINISHED requests only — a
-        truncated run (``max_steps`` hit) simply omits the unfinished."""
+        truncated run (``max_steps`` hit) simply omits the unfinished.
+
+        A progress watchdog (ISSUE 7, shared with the disagg engine)
+        deadlines the whole drive loop: ``stall_deadline_steps``
+        consecutive non-idle steps with no counter movement raise
+        ``EngineStallError`` instead of spinning forever — the colocated
+        engine has no migration ladder, so ANY stall here is a bug."""
         pending = deque(arrivals or [])
         i = 0
+        marker, since = self._progress_marker(), 0
         while max_steps is None or i < max_steps:
             while pending and pending[0][0] <= i:
                 _, prompt, mnt = pending.popleft()
@@ -553,7 +564,28 @@ class ServingEngine:
             if not self.step() and not pending:
                 break
             i += 1
+            m = self._progress_marker()
+            if m != marker:
+                marker, since = m, 0
+            else:
+                since += 1
+                if since >= self._stall_steps and not self.sched.idle:
+                    active = "; ".join(
+                        f"[{s}] rid={r.rid} {r.state.value} "
+                        f"cursor={r.prefill_cursor}"
+                        for s, r in self.sched.active)
+                    raise EngineStallError(
+                        f"engine made no progress for {since} steps "
+                        f"(stall deadline {self._stall_steps}); queue="
+                        f"{self.sched.queue_depth}, slots: "
+                        f"{active or '<none>'}")
         return {req.rid: list(req.generated) for req in self._finished}
+
+    def _progress_marker(self) -> tuple:
+        c = self.metrics.counters
+        return (c["tokens_generated"], c["prefills"], c["prefill_chunks"],
+                c["preemptions"], c["requests_finished"],
+                len(self._finished))
 
     # -- introspection ----------------------------------------------------
     @property

@@ -187,6 +187,71 @@ class KVPagePool:
                 f"{pages_per_seq}")
         return row + [fill] * (pages_per_seq - len(row))
 
+    def check(self, ledger=None) -> None:
+        """Full-invariant audit (ISSUE 7): verify the free-list and
+        ownership map are mutually consistent, and — given the migration
+        ``ChunkSignalLedger`` — that signal accounting agrees with page
+        ownership. Cheap enough to run after every chaos schedule; raises
+        ``PageLedgerError`` with the first violation found.
+
+        Invariants:
+        - every free id is in range ``[reserved, num_pages)`` and listed
+          exactly once;
+        - every owned id is in range, owned by exactly ONE sequence, and
+          not simultaneously free;
+        - free + owned together account for every non-reserved page
+          (count conservation — no leaked, no conjured pages);
+        - (with ``ledger``) every page a chunk expects to land for a
+          sequence is owned by that sequence here, landed never exceeds
+          expected per chunk, and the covered set never exceeds the
+          sequence's allocation (landed prefix <= allocated).
+        """
+        owner: dict[int, object] = {}
+        for sid, pages in self._owned.items():
+            for p in pages:
+                if not (self.reserved <= p < self.num_pages):
+                    raise PageLedgerError(
+                        f"seq {sid!r} owns out-of-range page {p}")
+                if p in owner:
+                    raise PageLedgerError(
+                        f"page {p} owned twice: seq {owner[p]!r} and "
+                        f"seq {sid!r}")
+                owner[p] = sid
+        free = set(self._free)
+        if len(free) != len(self._free):
+            raise PageLedgerError("duplicate ids on the free list")
+        for p in free:
+            if not (self.reserved <= p < self.num_pages):
+                raise PageLedgerError(f"out-of-range page {p} on free list")
+            if p in owner:
+                raise PageLedgerError(
+                    f"page {p} is both free and owned by seq {owner[p]!r}")
+        total = len(free) + len(owner)
+        if total != self.num_pages - self.reserved:
+            raise PageLedgerError(
+                f"page conservation violated: {len(free)} free + "
+                f"{len(owner)} owned != {self.num_pages - self.reserved} "
+                "non-reserved pages")
+        if ledger is None:
+            return
+        for sid in ledger.rids():
+            owned = set(self._owned.get(sid, ()))
+            covered = ledger.covered(sid)
+            if not covered <= owned:
+                raise PageLedgerError(
+                    f"seq {sid!r}: ledger covers pages "
+                    f"{sorted(covered - owned)} this pool never allocated "
+                    "to it (landed prefix exceeds allocation)")
+            for chunk_idx, dst_ids, landed in ledger.chunk_items(sid):
+                if landed > len(dst_ids):
+                    raise PageLedgerError(
+                        f"seq {sid!r} chunk {chunk_idx}: landed {landed} > "
+                        f"expected {len(dst_ids)}")
+                if not set(dst_ids) <= owned:
+                    raise PageLedgerError(
+                        f"seq {sid!r} chunk {chunk_idx}: expects pages "
+                        f"{sorted(set(dst_ids) - owned)} not owned here")
+
     def block_table_row(self, seq_id, pages_per_seq: int,
                         fill: int = 0) -> list[int]:
         """Fixed-width block-table row for the kernel: owned pages then

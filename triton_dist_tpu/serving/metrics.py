@@ -104,6 +104,19 @@ class ServingMetrics:
             "pages_migrated": 0,
             "migrate_chunks": 0,
             "handoffs": 0,
+            # robustness ladder (ISSUE 7): signal-deadline expiries that
+            # re-issued a chunk's migrate send, requests rescued by
+            # decode-local re-prefill after retries ran out, requests
+            # that exhausted the whole ladder and were failed (typed,
+            # per-request — the engine keeps running), landed reports
+            # discarded because their generation tag was stale (they
+            # arrived after a retry re-armed the chunk), and host-tier
+            # fault-plan injections actually applied to this engine
+            "retries": 0,
+            "degradations": 0,
+            "failed_requests": 0,
+            "stale_signals": 0,
+            "faults_injected": 0,
         }
         self.hist = {
             "ttft_s": Histogram(),
@@ -135,6 +148,17 @@ class ServingMetrics:
             "migrate_s": Histogram(),
             "migrate_pages_per_chunk": Histogram(),
             "migrate_wait_steps": Histogram(),
+            # robustness ladder (ISSUE 7): TTFT of requests that needed
+            # at least one retry but still handed off (recovered), TTFT
+            # of requests rescued by decode-local re-prefill (degraded;
+            # measured at local prefill completion), and prompt tokens
+            # re-prefilled locally per degraded chunk — kept OUT of
+            # step_prefill_tokens so the decode-cadence isolation
+            # invariant (max == 0 on the decode panel in fault-free
+            # runs) stays pinned
+            "recovered_ttft_s": Histogram(),
+            "degraded_ttft_s": Histogram(),
+            "degraded_prefill_tokens": Histogram(),
         }
         self._t0 = time.perf_counter()
 

@@ -41,6 +41,12 @@ class RequestState(enum.Enum):
     MIGRATING = "migrating"
     ACTIVE = "active"
     FINISHED = "finished"
+    # per-request failure domain (ISSUE 7): the recovery ladder (deadline
+    # -> bounded retry -> local re-prefill degradation) ran dry for THIS
+    # request. Its pages are freed, ``failure`` carries the typed reason
+    # (with the ledger dump), and the engine keeps serving everyone else —
+    # a failed request never takes the engine down with it.
+    FAILED = "failed"
 
 
 @dataclasses.dataclass
@@ -75,6 +81,14 @@ class Request:
     # None until the final prefill chunk lands; reset on decode-side
     # preemption (full re-prefill recomputes it bit-identically).
     first_token: int | None = None
+    # recovery ladder bookkeeping (ISSUE 7): how many times this request's
+    # migration was re-sent after a signal deadline expired, how many
+    # times it fell back to decode-local re-prefill, and — terminal —
+    # the typed exception that FAILED it (None while alive). The per-
+    # request twins of the engine-level retries/degradations counters.
+    retries: int = 0
+    degradations: int = 0
+    failure: Exception | None = None
 
     @property
     def kv_len(self) -> int:

@@ -4,3 +4,8 @@ from triton_dist_tpu.shmem.context import (  # noqa: F401
     get_default_context,
 )
 from triton_dist_tpu.shmem import device  # noqa: F401
+from triton_dist_tpu.shmem.faults import (  # noqa: F401
+    FaultPlan,
+    active_plan,
+    use_plan,
+)

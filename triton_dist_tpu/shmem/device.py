@@ -44,6 +44,8 @@ from jax import lax
 from jax.experimental import pallas as pl  # noqa: F401  (re-exported for kernels)
 from jax.experimental.pallas import tpu as pltpu
 
+from . import faults
+
 
 # -- producer-delay fuzzing --------------------------------------------------
 
@@ -66,12 +68,18 @@ def producer_noise(src_ref) -> None:
     busywork that widens producer/consumer timing windows so missing waits
     surface in interpret mode (pair with ``TDT_DETECT_RACES=1``). A no-op
     (zero emitted ops) when unset; debug knob only — it emits real DMAs if
-    enabled on hardware."""
+    enabled on hardware.
+
+    An active :class:`~triton_dist_tpu.shmem.faults.FaultPlan` with
+    ``device_put_delay=k`` adds ``k`` flat extra trips on top — the
+    "delay a put by extra noise trips" fault of the protocol matrix."""
     trips = _noise_trips()
-    if not trips:
+    plan = faults.active_plan()
+    extra = plan.device_put_delay if plan is not None else 0
+    if not trips and not extra:
         return
     k = next(_NOISE_SITE) % 3 + 1
-    for _ in range(trips * k):
+    for _ in range(trips * k + extra):
         pltpu.sync_copy(src_ref, src_ref)
 
 
@@ -184,7 +192,16 @@ def putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, pe,):
     receiving device's ``recv_sem`` (same scratch slot) is signaled by the
     DMA engine when the data has fully landed — this gives the
     "putmem_signal" delivery guarantee for free.
+
+    An active FaultPlan with ``device_peer_dead`` swallows the put: the
+    DMA never starts, the returned descriptor is already "complete" at
+    source, and nothing ever arrives at the peer — the consumer's
+    ``wait_recv`` hangs exactly like a dead link would (host-side
+    deadlines are what bound that hang; see docs/robustness.md).
     """
+    plan = faults.active_plan()
+    if plan is not None and plan.device_peer_dead:
+        return _COMPLETED_DMA
     producer_noise(src_ref)
     rdma = pltpu.make_async_remote_copy(
         src_ref=src_ref,
@@ -215,7 +232,16 @@ def signal_op(sem_ref, inc, pe=None):
     """Atomically add ``inc`` to (possibly remote) semaphore. Analog of
     ``libshmem_device.signal_op(..., NVSHMEM_SIGNAL_ADD)``
     (low_latency_all_to_all.py:96-117 uses the SET form with call_count;
-    on TPU the counting form is native and protocols count arrivals)."""
+    on TPU the counting form is native and protocols count arrivals).
+
+    An active FaultPlan may drop the signal (nothing emitted — the
+    consumer's counted wait starves) or duplicate it (doubled increment —
+    the over-signal poison the ledger layer must detect)."""
+    plan = faults.active_plan()
+    if plan is not None:
+        inc = plan.device_signal_inc(inc)
+        if inc is None:
+            return
     if pe is None:
         pltpu.semaphore_signal(sem_ref, inc=inc)
     else:

@@ -1,0 +1,235 @@
+"""Seeded fault injection for the one-sided signal plane (ISSUE 7).
+
+The paper's correctness story is one-sided puts plus counted signal/wait
+pairs; everything above it (the migration channel, signal-gated
+admission, the serving engines) is correct *when nothing goes wrong*. A
+``FaultPlan`` is the adversary: a deterministic, seeded schedule of
+signal drops, duplicated increments, delayed deliveries and dead peers
+that the signal plane's hooks consult — so the recovery machinery in
+``serving/`` can be driven through every failure mode of the protocol
+matrix (docs/robustness.md) and every run replays bit-identically from
+its seed.
+
+Two consultation tiers, matching where faults physically occur:
+
+- **device tier** (trace-time, like ``TDT_SERIAL``/``TDT_NOISE``): the
+  ``shmem.device`` hooks consult the ACTIVE plan while a kernel is being
+  traced. ``producer_noise`` widens producer/consumer timing windows by
+  ``device_put_delay`` extra self-copy trips, ``signal_op`` can drop or
+  duplicate its increment (``device_drop_signals`` /
+  ``device_dup_signals``), and ``putmem_nbi`` can swallow the put
+  entirely (``device_peer_dead`` — the DMA never leaves the source).
+  These are blunt by design: they poison EVERY kernel traced while the
+  plan is active, exactly like the serial/noise debug switches, and are
+  meant for kernel-level drills and hang bisection (a dropped device
+  signal SHOULD hang the consumer — the host-side deadlines are what
+  turn that hang into a typed failure).
+- **host tier** (per-event): the serving tier's migration channel asks
+  the plan one question per chunk-send attempt —
+  ``signal_action(rid, chunk_idx, attempt)`` — and one per step —
+  ``peer_dead(step)``. Decisions are a pure function of
+  ``(seed, kind, rid, chunk_idx, attempt)`` via keyed hashing (no
+  sequential RNG state), so a schedule is independent of event arrival
+  order and replayable from the seed alone; a retried attempt re-rolls
+  its own fate, which is what lets a bounded-retry ladder actually
+  recover from a ``p_drop < 1`` plan.
+
+Activation is scoped like the other trace-time debug knobs: pass a plan
+to the engine / use the ``use_plan`` context manager for programmatic
+scope, or set ``TDT_FAULTS="seed=3,drop=0.2,dup=0.05,delay=0.3,dead=40"``
+in the environment (read at consult time).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import zlib
+
+_ACTIVE: "FaultPlan | None" = None
+_ENV_CACHE: tuple[str, "FaultPlan | None"] | None = None
+
+
+def _uniform(seed: int, *key) -> float:
+    """Deterministic uniform in [0, 1) keyed by the event identity.
+
+    crc32 of the printed key — not cryptographic, but stable across
+    runs/platforms/python versions (unlike ``hash()``), cheap, and
+    independent draws per (kind, rid, chunk, attempt) coordinate."""
+    h = zlib.crc32(repr((seed,) + key).encode("utf-8"))
+    return (h & 0xFFFFFFFF) / 2.0 ** 32
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """One seeded, replayable fault schedule. Frozen: a plan carries no
+    mutable state — every decision is recomputable, which is what makes
+    "replay the schedule from its seed" a one-liner.
+
+    Host-tier knobs (per chunk-send attempt):
+
+    - ``p_drop``: probability the attempt's signal/landed report is lost
+      in flight (the pages may have landed; the announcement did not).
+    - ``p_dup``: probability the signal increment is duplicated — the
+      over-signal protocol violation ``ChunkSignalLedger`` must detect.
+    - ``p_delay`` / ``max_delay_steps``: probability the landed report is
+      delivered late, and the (deterministic, per-event) lateness in
+      engine steps. A delayed report can arrive after a retry bumped the
+      chunk's generation — the ledger discards it as stale.
+    - ``dead_peer_after``: engine step from which the transport to the
+      peer is dead — puts and signals all vanish (``None`` = never).
+    - ``rids``: optionally scope every host-tier fault to these request
+      ids (targeted drills); ``None`` faults everything.
+
+    Device-tier knobs (trace-time, see module docstring):
+    ``device_put_delay``, ``device_drop_signals``, ``device_dup_signals``,
+    ``device_peer_dead``.
+    """
+
+    seed: int = 0
+    p_drop: float = 0.0
+    p_dup: float = 0.0
+    p_delay: float = 0.0
+    max_delay_steps: int = 8
+    dead_peer_after: int | None = None
+    rids: tuple[int, ...] | None = None
+    # device tier (trace-time)
+    device_put_delay: int = 0
+    device_drop_signals: bool = False
+    device_dup_signals: bool = False
+    device_peer_dead: bool = False
+
+    # -- host tier ---------------------------------------------------------
+    def _scoped(self, rid) -> bool:
+        return self.rids is None or rid in self.rids
+
+    def peer_dead(self, step: int) -> bool:
+        """Transport to the peer is dead at ``step`` (nothing sent from
+        here on arrives — puts, signals, retries alike)."""
+        return (self.dead_peer_after is not None
+                and step >= self.dead_peer_after)
+
+    def signal_action(self, rid, chunk_idx: int, attempt: int
+                      ) -> tuple[str, int]:
+        """Fate of one chunk-send attempt's signal:
+        ``("ok", 0)``, ``("drop", 0)``, ``("dup", 0)`` or
+        ``("delay", k)`` with ``k >= 1`` engine steps of lateness.
+        Each attempt re-rolls independently (keyed by ``attempt``), so
+        retry CAN succeed where the first send faulted."""
+        if not self._scoped(rid):
+            return ("ok", 0)
+        if _uniform(self.seed, "drop", rid, chunk_idx, attempt) < self.p_drop:
+            return ("drop", 0)
+        if _uniform(self.seed, "dup", rid, chunk_idx, attempt) < self.p_dup:
+            return ("dup", 0)
+        if _uniform(self.seed, "delay", rid, chunk_idx,
+                    attempt) < self.p_delay:
+            k = 1 + int(_uniform(self.seed, "delay_k", rid, chunk_idx,
+                                 attempt) * self.max_delay_steps)
+            return ("delay", k)
+        return ("ok", 0)
+
+    # -- device tier -------------------------------------------------------
+    def device_signal_inc(self, inc):
+        """What ``signal_op`` should emit under this plan: ``None`` to
+        drop the signal entirely, a doubled increment for a duplicate,
+        or ``inc`` unchanged."""
+        if self.device_drop_signals:
+            return None
+        if self.device_dup_signals:
+            return inc * 2
+        return inc
+
+    # -- bookkeeping -------------------------------------------------------
+    @property
+    def any_host_faults(self) -> bool:
+        return (self.p_drop > 0 or self.p_dup > 0 or self.p_delay > 0
+                or self.dead_peer_after is not None)
+
+    def describe(self) -> str:
+        on = [f"seed={self.seed}"]
+        for k in ("p_drop", "p_dup", "p_delay"):
+            v = getattr(self, k)
+            if v:
+                on.append(f"{k}={v}")
+        if self.dead_peer_after is not None:
+            on.append(f"dead_peer_after={self.dead_peer_after}")
+        if self.rids is not None:
+            on.append(f"rids={list(self.rids)}")
+        for k in ("device_put_delay", "device_drop_signals",
+                  "device_dup_signals", "device_peer_dead"):
+            v = getattr(self, k)
+            if v:
+                on.append(f"{k}={v}")
+        return "FaultPlan(" + ", ".join(on) + ")"
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        """Parse the compact env/CLI form: either a bare integer seed
+        (default probabilities: drop 0.15, delay 0.25) or a
+        comma-separated ``k=v`` list — ``seed=3,drop=0.2,dup=0.05,``
+        ``delay=0.3,delay_max=6,dead=40,rids=1|4|7``."""
+        spec = spec.strip()
+        if not spec:
+            raise ValueError("empty fault spec")
+        try:
+            return cls(seed=int(spec), p_drop=0.15, p_delay=0.25)
+        except ValueError:
+            pass
+        keys = {"seed": ("seed", int), "drop": ("p_drop", float),
+                "dup": ("p_dup", float), "delay": ("p_delay", float),
+                "delay_max": ("max_delay_steps", int),
+                "dead": ("dead_peer_after", int),
+                "put_delay": ("device_put_delay", int)}
+        kw = {}
+        for part in spec.split(","):
+            k, _, v = part.partition("=")
+            k = k.strip()
+            if k == "rids":
+                kw["rids"] = tuple(int(r) for r in v.split("|"))
+            elif k in keys:
+                name, cast = keys[k]
+                kw[name] = cast(v)
+            else:
+                raise ValueError(f"unknown fault-spec key {k!r} in {spec!r}")
+        return cls(**kw)
+
+
+# -- activation scoping ------------------------------------------------------
+
+def activate(plan: FaultPlan | None) -> FaultPlan | None:
+    """Install ``plan`` as the process-wide active plan (``None`` clears).
+    Returns the previous plan so callers can restore it."""
+    global _ACTIVE
+    prev, _ACTIVE = _ACTIVE, plan
+    return prev
+
+
+@contextlib.contextmanager
+def use_plan(plan: FaultPlan):
+    """Scope a plan to a ``with`` block (the programmatic twin of the
+    ``TDT_FAULTS`` env knob)."""
+    prev = activate(plan)
+    try:
+        yield plan
+    finally:
+        activate(prev)
+
+
+def active_plan() -> FaultPlan | None:
+    """The plan the hooks should consult right now: the programmatically
+    activated one, else one parsed from ``TDT_FAULTS`` (cached per env
+    value — consulted at trace time like ``TDT_SERIAL``), else None."""
+    if _ACTIVE is not None:
+        return _ACTIVE
+    global _ENV_CACHE
+    spec = os.environ.get("TDT_FAULTS")
+    if not spec:
+        return None
+    if _ENV_CACHE is None or _ENV_CACHE[0] != spec:
+        _ENV_CACHE = (spec, FaultPlan.from_spec(spec))
+    return _ENV_CACHE[1]
+
+
+__all__ = ["FaultPlan", "activate", "use_plan", "active_plan"]
