@@ -1281,6 +1281,96 @@ def bench_chaos(ctx, num_slots: int = 4, page_size: int = 16,
     }
 
 
+def bench_serving_sharded(ctx, num_requests: int = 24, num_slots: int = 4,
+                          page_size: int = 8, num_pages: int = 24,
+                          pages_per_seq: int = 4, prefill_chunk: int = 8,
+                          decode_horizon: int = 1,
+                          flagship: bool = False) -> dict:
+    """Sharded serving rows (ISSUE 8): the EP MoE config served end to end
+    through ``ShardedServingEngine`` over a MESH-SIZE SWEEP —
+    ``serving_tok_per_s`` / ``serving_step_us`` per mesh shape, from the
+    same seeded trace every shape replays bit-identically (asserted; a
+    sweep that changed tokens would be pricing a broken engine).
+
+    On the CPU interpret mesh the sweep runs the micro MoE shape at
+    1x1x1 / 1x1x2 / 1x2x2 (TPxSPxEP). With ``flagship=True`` and >= 8
+    real devices it serves ``MoEConfig.deepseek_infer()`` on the 2x2x2
+    mesh instead — the reference's A2A benchmark shape through the whole
+    runtime. The wire dtype is PINNED to fp8 (e4m3) for the sweep:
+    ``"auto"`` resolves per rank count from the wire-fit model, so the
+    1x1x1 golden could legitimately skip the quant round trip that the
+    multi-rank shapes take — pinning keeps every shape on the identical
+    per-row quant/dequant fold and makes the bitwise assertion fair
+    (same caveat docs/serving.md spells out for the trace tests).
+
+    Knobs mirror ``scripts/serve_sim.py --mesh/--model moe``.
+    """
+    from triton_dist_tpu.models.llama import LlamaConfig
+    from triton_dist_tpu.models.moe import MoEConfig, init_moe_params
+    from triton_dist_tpu.serving import ShardedServingEngine, serving_mesh
+    import numpy as _np
+
+    n_dev = len(jax.devices())
+    if flagship and n_dev >= 8:
+        cfg = MoEConfig.deepseek_infer()
+        meshes = [(1, 1, 1), (2, 2, 2)]
+    else:
+        cfg = MoEConfig(base=LlamaConfig(vocab_size=128, d_model=128,
+                                         n_layers=1, n_heads=4,
+                                         n_kv_heads=2, d_ff=128,
+                                         max_seq_len=128,
+                                         dtype=jnp.float32),
+                        num_experts=4, topk=2, moe_d_ff=64)
+        meshes = [m for m in [(1, 1, 1), (1, 1, 2), (1, 2, 2)]
+                  if m[0] * m[1] * m[2] <= n_dev]
+    params = init_moe_params(jax.random.key(3), cfg)
+
+    def _trace():
+        rng = _np.random.RandomState(0)
+        return [(i // 2,
+                 [int(t) for t in rng.randint(1, cfg.base.vocab_size,
+                                              size=int(rng.randint(4, 17)))],
+                 int(rng.randint(2, 8)))
+                for i in range(num_requests)]
+
+    rows, golden = {}, None
+    for tp, sp, ep in meshes:
+        eng = ShardedServingEngine(
+            params, cfg, serving_mesh(tp, sp, ep), num_slots=num_slots,
+            page_size=page_size, num_pages=num_pages,
+            pages_per_seq=pages_per_seq, decode_horizon=decode_horizon,
+            prefill_chunk=prefill_chunk, wire_dtype=jnp.float8_e4m3fn)
+        t0 = time.perf_counter()
+        res = eng.run(max_steps=100_000, arrivals=_trace())
+        wall = time.perf_counter() - t0
+        assert len(res) == num_requests
+        if golden is None:
+            golden = res
+        else:
+            assert res == golden, (
+                f"mesh {tp}x{sp}x{ep} changed tokens — the bitwise "
+                "cross-mesh contract broke")
+        snap = eng.metrics.snapshot()
+        rows[eng.mesh_desc] = {
+            "serving_tok_per_s": round(snap["tokens_generated"] / wall, 1),
+            "serving_step_us": round(
+                (snap["step_device_s"]["mean"] or 0.0) * 1e6, 1),
+            "dispatches": snap["dispatches"],
+            "digest_checks": snap["digest_checks"],
+            "compiles": eng.compile_stats,
+        }
+    return {
+        "serving_sharded": rows,
+        "serving_sharded_wire": eng.wire_dtype,
+        "serving_sharded_knobs": {
+            "model": "deepseek_infer" if flagship and n_dev >= 8
+            else "micro_moe",
+            "num_requests": num_requests, "num_slots": num_slots,
+            "page_size": page_size, "prefill_chunk": prefill_chunk,
+            "decode_horizon": decode_horizon},
+    }
+
+
 # --- EP-dispatch wire model (the DeepEP-comparison analog) -----------------
 #
 # The reference's headline 137 µs dispatch (README.md:55) is 32 H800 ranks,
@@ -1528,6 +1618,17 @@ def main(a2a_primary: bool = False):
         extras.update(bench_chaos(ctx, **csh))
 
     attempt("chaos", _chaos)
+
+    def _serving_sharded():
+        # whole-engine mesh-size sweep for the EP MoE config (ISSUE 8);
+        # the CPU simulator runs the micro shape on interpret meshes up
+        # to 1x2x2, real hardware with >= 8 chips serves deepseek_infer
+        # on the 2x2x2 mesh
+        extras.update(bench_serving_sharded(
+            ctx, flagship=not on_cpu(),
+            **(dict(num_requests=24) if on_cpu() else {})))
+
+    attempt("serving_sharded", _serving_sharded)
 
     def _attn():
         ash = dict(s_loc=256, Hq=4, Hkv=2) if on_cpu() else {}

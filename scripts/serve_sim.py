@@ -7,10 +7,14 @@ matching knobs (--slots/--page-size/--layers mirror bench_serving's).
 
     python scripts/serve_sim.py --sim 50
     python scripts/serve_sim.py --sim 20 --slots 8 --pages 12  # preempts
+    python scripts/serve_sim.py --sim 20 --model moe --mesh 1x2x2
 
 A deliberately small --pages forces preemption-by-eviction; the replay is
 bit-deterministic (same seed => same tokens, same metrics counters), which
-is also how tests/test_serving.py pins the trace down.
+is also how tests/test_serving.py pins the trace down. ``--mesh TPxSPxEP``
+serves the MoE model through ``ShardedServingEngine`` under shard_map
+(docs/serving.md "Sharded serving"); the replay stays bit-identical across
+mesh shapes when --wire is pinned (``auto`` resolves per rank count).
 """
 import argparse
 import json
@@ -58,6 +62,20 @@ p.add_argument("--disagg", action="store_true",
                     "(KV handed off by page migration; needs >= 2 devices; "
                     "--prefill-chunk defaults to 2*page_size here — chunks "
                     "ARE the migration unit)")
+p.add_argument("--model", choices=("llama", "moe"), default="llama",
+               help="'moe' serves MoEConfig.tiny through the sharded "
+                    "engine (EP MoE FFN; defaults --mesh to 1x1x1)")
+p.add_argument("--mesh", default=None, metavar="TPxSPxEP",
+               help="serve under shard_map on this TP/SP/EP mesh, e.g. "
+                    "2x2x2 (implies --model moe; spins up tp*sp*ep "
+                    "virtual CPU devices when hardware has fewer; "
+                    "--prefill-chunk defaults to 8 — the sharded engine "
+                    "REQUIRES the chunked path)")
+p.add_argument("--wire", choices=("auto", "fp8", "none"), default="auto",
+               help="A2A wire dtype for --mesh: 'auto' (wire-fit driven, "
+                    "resolves PER RANK COUNT), 'fp8' (pinned e4m3 — use "
+                    "this when comparing tokens across mesh shapes), "
+                    "'none' (full-width wire)")
 p.add_argument("--chaos", default=None, metavar="SPEC",
                help="seeded fault injection on the migration signal plane "
                     "(implies --disagg): a bare integer seed (default "
@@ -69,6 +87,15 @@ p.add_argument("--chaos", default=None, metavar="SPEC",
 args = p.parse_args()
 if args.chaos is not None:
     args.disagg = True
+if args.mesh is not None:
+    args.model = "moe"
+elif args.model == "moe":
+    args.mesh = "1x1x1"
+if args.mesh is not None and args.disagg:
+    # the SP-sharded pool owns page placement; disaggregation's page
+    # migration is a different (single-axis) pool contract — refused,
+    # see docs/serving.md "Sharded serving"
+    p.error("--mesh and --disagg are mutually exclusive")
 
 if args.prefill_buckets == "pow2":
     buckets = "pow2"
@@ -82,10 +109,36 @@ if args.disagg:
     # back to the 2-device virtual CPU simulator — real chips are kept
     from triton_dist_tpu.utils.env import force_virtual_cpu_devices  # noqa: E402
     force_virtual_cpu_devices(2)
+elif args.mesh is not None:
+    tp, sp, ep = (int(d) for d in args.mesh.lower().split("x"))
+    from triton_dist_tpu.utils.env import force_virtual_cpu_devices  # noqa: E402
+    force_virtual_cpu_devices(tp * sp * ep)
 
-cfg = LlamaConfig.tiny(n_layers=args.layers)
-params = init_params(jax.random.PRNGKey(args.seed), cfg)
-if args.disagg:
+if args.model == "moe":
+    from triton_dist_tpu.models.moe import MoEConfig, init_moe_params  # noqa: E402
+    cfg = MoEConfig.tiny(n_layers=args.layers)
+    params = init_moe_params(jax.random.PRNGKey(args.seed), cfg)
+    vocab = cfg.base.vocab_size
+else:
+    cfg = LlamaConfig.tiny(n_layers=args.layers)
+    params = init_params(jax.random.PRNGKey(args.seed), cfg)
+    vocab = cfg.vocab_size
+if args.mesh is not None:
+    import jax.numpy as jnp  # noqa: E402
+
+    from triton_dist_tpu.serving import ShardedServingEngine, serving_mesh  # noqa: E402
+    wire = {"auto": "auto", "fp8": jnp.float8_e4m3fn, "none": None}[args.wire]
+    eng = ShardedServingEngine(params, cfg, serving_mesh(tp, sp, ep),
+                               num_slots=args.slots,
+                               page_size=args.page_size,
+                               num_pages=args.pages,
+                               pages_per_seq=args.pages_per_seq,
+                               decode_horizon=args.decode_horizon,
+                               prefill_chunk=args.prefill_chunk or 8,
+                               wire_dtype=wire)
+    print(json.dumps({"mesh": eng.mesh_desc, "wire": eng.wire_dtype}),
+          file=sys.stderr)
+elif args.disagg:
     from triton_dist_tpu.serving import DisaggServingEngine  # noqa: E402
     from triton_dist_tpu.shmem import FaultPlan  # noqa: E402
     plan = FaultPlan.from_spec(args.chaos) if args.chaos else None
@@ -113,7 +166,7 @@ arrivals = []
 for i in range(args.sim):
     plen = int(rng.randint(3, max(4, max_plen)))
     mnt = int(rng.randint(2, max(3, args.max_new + 1)))
-    prompt = rng.randint(1, cfg.vocab_size, size=plen).tolist()
+    prompt = rng.randint(1, vocab, size=plen).tolist()
     arrivals.append((i * args.arrive_every // max(args.arrive_every, 1),
                      prompt, mnt))
 
@@ -187,6 +240,10 @@ if args.disagg:
     eng.metrics.emit()
     eng.metrics_decode.emit()
 else:
+    if args.mesh is not None:
+        # the replicated-decision guard's coverage for this replay
+        print(json.dumps({"digest_checks": snap["digest_checks"]}),
+              file=sys.stderr)
     print(json.dumps({
         "prefill_chunk": args.prefill_chunk,
         "prefill_chunks": snap["prefill_chunks"],

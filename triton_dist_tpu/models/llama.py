@@ -416,7 +416,8 @@ def decode_step_paged(params: dict, token: jax.Array, pos: jax.Array,
                       cfg: LlamaConfig, pages: dict,
                       block_table: jax.Array, ffn=None,
                       active: jax.Array | None = None,
-                      sample: bool = False) -> tuple[jax.Array, dict]:
+                      sample: bool = False, attn_io=None,
+                      linear=None) -> tuple[jax.Array, dict]:
     """One-token decode over the paged KV pool — the continuous-batching
     twin of ``decode_step``. Differences that make it a serving hot loop:
 
@@ -442,10 +443,19 @@ def decode_step_paged(params: dict, token: jax.Array, pos: jax.Array,
     slot mask the scanned multi-token loop uses for rows done mid-scan.
     ``sample=True`` fuses greedy sampling: the first return value is the
     on-device argmax ``next_token`` [B] int32 instead of the [B, vocab]
-    logits, so a serving host only ever downloads a token slab."""
+    logits, so a serving host only ever downloads a token slab.
+
+    ``attn_io(q, k, v, kp, vp, bt, pos, kv_len, active) -> (attn, kp, vp)``
+    overrides the KV-write + paged-attention pair (the SP serving path
+    plugs ``ops.flash_decode.sp_paged_attend_write`` here — the pool
+    arrays then stay sharded on their page dim). ``linear(h, w, name)``
+    overrides every dense projection (wq/wk/wv/wo/lm_head — the TP
+    serving path plugs ``ops.allgather_gemm.tp_column_linear``). Either
+    hook unrolls the layer loop like ``ffn`` does."""
     from triton_dist_tpu.ops.flash_decode import (gqa_decode_paged,
                                                   paged_kv_write)
 
+    lin = linear or (lambda h, w, name: h @ w)
     B = token.shape[0]
     Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     x = params["embed"][token].astype(cfg.dtype)          # [B, D]
@@ -455,15 +465,19 @@ def decode_step_paged(params: dict, token: jax.Array, pos: jax.Array,
     def body(x, layer):
         p, kp, vp = layer
         h = rmsnorm(x, p["attn_norm"], cfg.norm_eps)
-        q = rope((h @ p["wq"]).reshape(B, 1, Hq, Dh), positions,
+        q = rope(lin(h, p["wq"], "wq").reshape(B, 1, Hq, Dh), positions,
                  cfg.rope_theta)[:, 0]                     # [B, Hq, Dh]
-        k = rope((h @ p["wk"]).reshape(B, 1, Hkv, Dh), positions,
+        k = rope(lin(h, p["wk"], "wk").reshape(B, 1, Hkv, Dh), positions,
                  cfg.rope_theta)[:, 0]                     # [B, Hkv, Dh]
-        v = (h @ p["wv"]).reshape(B, 1, Hkv, Dh)[:, 0]
-        kp, vp = paged_kv_write(kp, vp, k, v, block_table, pos,
-                                active=active)
-        attn, _lse = gqa_decode_paged(q, kp, vp, block_table, kv_len)
-        x = x + attn.reshape(B, Hq * Dh) @ p["wo"]
+        v = lin(h, p["wv"], "wv").reshape(B, 1, Hkv, Dh)[:, 0]
+        if attn_io is None:
+            kp, vp = paged_kv_write(kp, vp, k, v, block_table, pos,
+                                    active=active)
+            attn, _lse = gqa_decode_paged(q, kp, vp, block_table, kv_len)
+        else:
+            attn, kp, vp = attn_io(q, k, v, kp, vp, block_table, pos,
+                                   kv_len, active)
+        x = x + lin(attn.reshape(B, Hq * Dh), p["wo"], "wo")
         h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps)
         if ffn is None:
             ff = (jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)
@@ -474,7 +488,7 @@ def decode_step_paged(params: dict, token: jax.Array, pos: jax.Array,
         x = x + ff.astype(x.dtype)
         return x, (kp, vp)
 
-    if ffn is None:
+    if ffn is None and attn_io is None and linear is None:
         x, (ks, vs) = lax.scan(body, x, (params["blocks"], pages["k"],
                                          pages["v"]))
     else:
@@ -486,7 +500,7 @@ def decode_step_paged(params: dict, token: jax.Array, pos: jax.Array,
             vs_l.append(vp)
         ks, vs = jnp.stack(ks_l), jnp.stack(vs_l)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = lin(x, params["lm_head"], "lm_head").astype(jnp.float32)
     if sample:
         return jnp.argmax(logits, -1).astype(jnp.int32), {"k": ks, "v": vs}
     return logits, {"k": ks, "v": vs}
@@ -495,7 +509,8 @@ def decode_step_paged(params: dict, token: jax.Array, pos: jax.Array,
 def prefill_chunk_paged(params: dict, tokens: jax.Array, start: jax.Array,
                         prompt_len: jax.Array, cfg: LlamaConfig,
                         pages: dict, block_table: jax.Array,
-                        ffn=None) -> tuple[jax.Array, dict]:
+                        ffn=None, attn_io=None,
+                        linear=None) -> tuple[jax.Array, dict]:
     """Prefill one fixed-size chunk of a prompt DIRECTLY into the page
     pool — the admission half of the serving hot loop (ISSUE 5 tentpole).
 
@@ -536,10 +551,14 @@ def prefill_chunk_paged(params: dict, tokens: jax.Array, start: jax.Array,
     ``ffn(h, p) -> [C, D]`` overrides the per-layer FFN exactly as in
     ``decode_step_paged`` (the MoE serving hook); with a custom ``ffn``
     the layer loop unrolls in Python for the same backend reasons.
+    ``attn_io``/``linear`` hook the KV-write+attention pair and the dense
+    projections exactly as in ``decode_step_paged`` (the chunk's C rows
+    play the batch-row role; ``active`` is the padded-tail mask).
     """
     from triton_dist_tpu.ops.flash_decode import (gqa_decode_paged,
                                                   paged_kv_write)
 
+    lin = linear or (lambda h, w, name: h @ w)
     C = tokens.shape[0]
     Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     idx = start.astype(jnp.int32) + jnp.arange(C, dtype=jnp.int32)   # [C]
@@ -555,14 +574,17 @@ def prefill_chunk_paged(params: dict, tokens: jax.Array, start: jax.Array,
     def body(x, layer):
         p, kp, vp = layer
         h = rmsnorm(x, p["attn_norm"], cfg.norm_eps)
-        q = rope((h @ p["wq"]).reshape(C, 1, Hq, Dh), positions,
+        q = rope(lin(h, p["wq"], "wq").reshape(C, 1, Hq, Dh), positions,
                  cfg.rope_theta)[:, 0]                    # [C, Hq, Dh]
-        k = rope((h @ p["wk"]).reshape(C, 1, Hkv, Dh), positions,
+        k = rope(lin(h, p["wk"], "wk").reshape(C, 1, Hkv, Dh), positions,
                  cfg.rope_theta)[:, 0]
-        v = (h @ p["wv"]).reshape(C, 1, Hkv, Dh)[:, 0]
-        kp, vp = paged_kv_write(kp, vp, k, v, bt, pos, active=valid)
-        attn, _lse = gqa_decode_paged(q, kp, vp, bt, kv_len)
-        x = x + attn.reshape(C, Hq * Dh) @ p["wo"]
+        v = lin(h, p["wv"], "wv").reshape(C, 1, Hkv, Dh)[:, 0]
+        if attn_io is None:
+            kp, vp = paged_kv_write(kp, vp, k, v, bt, pos, active=valid)
+            attn, _lse = gqa_decode_paged(q, kp, vp, bt, kv_len)
+        else:
+            attn, kp, vp = attn_io(q, k, v, kp, vp, bt, pos, kv_len, valid)
+        x = x + lin(attn.reshape(C, Hq * Dh), p["wo"], "wo")
         h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps)
         if ffn is None:
             ff = (jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)
@@ -573,7 +595,7 @@ def prefill_chunk_paged(params: dict, tokens: jax.Array, start: jax.Array,
         x = x + ff.astype(x.dtype)
         return x, (kp, vp)
 
-    if ffn is None:
+    if ffn is None and attn_io is None and linear is None:
         x, (ks, vs) = lax.scan(body, x, (params["blocks"], pages["k"],
                                          pages["v"]))
     else:
@@ -590,7 +612,7 @@ def prefill_chunk_paged(params: dict, tokens: jax.Array, start: jax.Array,
     last = jnp.clip(prompt_len - 1 - start, 0, C - 1).astype(jnp.int32)
     h_last = lax.dynamic_slice_in_dim(x, last, 1)                    # [1, D]
     h_last = rmsnorm(h_last, params["final_norm"], cfg.norm_eps)
-    logits = (h_last @ params["lm_head"]).astype(jnp.float32)
+    logits = lin(h_last, params["lm_head"], "lm_head").astype(jnp.float32)
     tok = jnp.argmax(logits[0], -1).astype(jnp.int32)
     return tok, {"k": ks, "v": vs}
 
@@ -599,7 +621,7 @@ def decode_multistep_paged(params: dict, token: jax.Array, pos: jax.Array,
                            cfg: LlamaConfig, pages: dict,
                            block_table: jax.Array, limit: jax.Array,
                            horizon: int, eos_id: int | None = None,
-                           ffn=None
+                           ffn=None, attn_io=None, linear=None
                            ) -> tuple[jax.Array, jax.Array, jax.Array, dict]:
     """Device-resident multi-token decode: ``horizon`` fused sampled steps
     (``decode_step_paged(..., sample=True)``) chained under one trace, so
@@ -634,7 +656,8 @@ def decode_multistep_paged(params: dict, token: jax.Array, pos: jax.Array,
         act = jnp.logical_and(i < limit, ~stopped)         # [B] bool
         nxt, pages_c = decode_step_paged(params, tok, pos_c, cfg, pages_c,
                                          block_table, ffn=ffn, active=act,
-                                         sample=True)
+                                         sample=True, attn_io=attn_io,
+                                         linear=linear)
         tok = jnp.where(act, nxt, tok)
         pos_c = jnp.where(act, pos_c + 1, pos_c)
         if eos_id is not None:
@@ -642,7 +665,7 @@ def decode_multistep_paged(params: dict, token: jax.Array, pos: jax.Array,
                                      jnp.logical_and(act, nxt == eos_id))
         return (tok, pos_c, stopped, pages_c), nxt
 
-    if ffn is None and horizon > 1:
+    if ffn is None and attn_io is None and linear is None and horizon > 1:
         (token, pos, _, pages), toks = lax.scan(
             one, (token, pos, stopped0, pages),
             jnp.arange(horizon, dtype=jnp.int32))

@@ -446,5 +446,55 @@ def create_ag_gemm_context(ctx: ShmemContext, m_local: int, k: int,
     return AgGemmContext(ctx=ctx, axis=axis, ws=ws)
 
 
+def tp_column_linear(ctx: ShmemContext, h: jax.Array, w: jax.Array,
+                     axis: str = "tp", impl: str = "xla",
+                     cfg: GemmConfig | None = None) -> jax.Array:
+    """Tensor-parallel column-sharded linear for the serving hot loop:
+    ``h @ w`` with ``w`` [K, N] column-sharded P(None, axis) inside the op's
+    own shard_map region, output allgathered back to replicated.
+
+    ``impl="xla"`` (default): each rank computes ``h @ w_local`` over the
+    FULL contraction dim — the identical dot a single device runs on its
+    column slice — then the tiled last-dim allgather concatenates the
+    column blocks. Column-split + concat is bitwise equal to the unsplit
+    matmul (no cross-rank reduction anywhere), which is what lets the
+    sharded serving trace stay bit-identical to the n=1 golden.
+
+    ``impl="ag_gemm"`` routes through the Pallas AllGather-GEMM overlap
+    kernel instead (``h`` row-sharded P(axis) on the wire; needs
+    rows % n == 0 and (rows/n) % cfg.block_m == 0): the throughput path
+    for real weights, numerically ALLCLOSE but not bit-pinned — the f32
+    accumulator tiling differs from the XLA dot, so it is excluded from
+    the bit-exact trace contract (docs/serving.md).
+
+    ``gemm_rs`` is deliberately NOT offered here: its reduce-scatter sums
+    partial products across ranks in rank-varying order, which breaks the
+    bitwise cross-mesh-size contract serving pins.
+    """
+    n = ctx.axis_size(axis)
+    if n == 1:
+        return h @ w
+    assert w.shape[1] % n == 0, (
+        f"out dim {w.shape[1]} not divisible by |{axis}|={n}")
+    if impl == "xla":
+        def body(h, w_l):
+            return lax.all_gather(h @ w_l, axis, axis=1, tiled=True)
+        return ctx.shard_map(body, in_specs=(P(), P(None, axis)),
+                             out_specs=P())(h, w)
+    if impl == "gemm_rs":
+        raise ValueError(
+            "tp_column_linear refuses impl='gemm_rs': its reduce-scatter "
+            "sums partial products in rank-varying order, which breaks the "
+            "bitwise cross-mesh-size trace contract serving pins "
+            "(docs/serving.md). Use 'xla' (bitwise) or 'ag_gemm' "
+            "(allclose-only overlap).")
+    assert impl == "ag_gemm", f"unknown tp_column_linear impl {impl!r}"
+    c = ag_gemm(ctx, h, w, axis=axis, cfg=cfg)     # [M, N] P(None, axis)
+    return ctx.shard_map(
+        lambda c_l: lax.all_gather(c_l, axis, axis=1, tiled=True),
+        in_specs=P(None, axis), out_specs=P())(c)
+
+
 __all__ = ["ag_gemm", "ag_gemm_ws", "create_ag_gemm_workspace",
-           "create_ag_gemm_context", "AgGemmContext", "GemmConfig"]
+           "create_ag_gemm_context", "AgGemmContext", "GemmConfig",
+           "tp_column_linear"]

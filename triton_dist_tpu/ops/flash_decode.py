@@ -499,5 +499,77 @@ def sp_gqa_flash_decode(ctx: ShmemContext, q: jax.Array, k_cache: jax.Array,
     return smc(g)
 
 
+def sp_paged_attend_write(ctx: ShmemContext, q: jax.Array,
+                          k_new: jax.Array, v_new: jax.Array,
+                          k_pages: jax.Array, v_pages: jax.Array,
+                          block_table: jax.Array, pos: jax.Array,
+                          kv_len: jax.Array, axis: str = "sp",
+                          active: jax.Array | None = None):
+    """Sequence-parallel paged write + paged GQA decode attention: the page
+    pool is sharded over ``axis`` on the PAGE dim (``page_pool_pspec``),
+    rank r owning pages ``[r*Pl, (r+1)*Pl)``.
+
+    Per rank: scatter the new (k, v) rows that land on LOCALLY-owned pages
+    (non-local rows drop via an out-of-bounds index with ``mode="drop"`` —
+    every row is written by exactly one rank), then allgather the pool
+    shards back to the full pool and run the replicated ``gqa_decode_paged``
+    walk over it. The allgather is a pure concatenation in page order, so
+    the gathered pool — and therefore the attention output — is BITWISE
+    identical to the single-device ``paged_kv_write`` + ``gqa_decode_paged``
+    composition at any mesh size (tests/test_sharded_serving.py pins this).
+    The write bandwidth is what shards; attention reads stay replicated —
+    the regime where pool residency, not attention FLOPs, is the scaling
+    limit (one new KV row per slot per step).
+
+    ``active`` parks masked-off rows on the scratch page (page 0, rank 0's
+    shard) exactly like ``paged_kv_write``. q [B, Hq, D]; k/v_new
+    [B, Hkv, D]; k/v_pages [P, Hkv, page_size, D] GLOBAL views sharded
+    P(axis); pos/kv_len [B]. Returns (attn [B, Hq, D], k_pages, v_pages)
+    with the pools still P(axis)-sharded.
+    """
+    n = ctx.axis_size(axis)
+    if n == 1:
+        kp, vp = paged_kv_write(k_pages, v_pages, k_new, v_new,
+                                block_table, pos, active=active)
+        out, _ = gqa_decode_paged(q, kp, vp, block_table, kv_len)
+        return out, kp, vp
+
+    assert k_pages.shape[0] % n == 0, (
+        f"pool pages {k_pages.shape[0]} not divisible by |{axis}|={n} — "
+        "pad the pool to a multiple of the SP axis (the sharded engine "
+        "does this; the allocator never hands out the padding pages)")
+    has_active = active is not None
+
+    def body(kp_l, vp_l, q, kn, vn, bt, pos, kv_lens, *act):
+        r = lax.axis_index(axis)
+        p_local = kp_l.shape[0]
+        page_size = kp_l.shape[2]
+        rows = jnp.arange(pos.shape[0])
+        page = bt[rows, pos // page_size]                   # [B] global ids
+        if has_active:
+            page = jnp.where(act[0], page, 0)
+        loc = page - r * p_local
+        ok = (loc >= 0) & (loc < p_local)
+        idx = jnp.where(ok, loc, p_local)    # OOB sentinel → dropped write
+        slot = pos % page_size
+        kp_l = kp_l.at[idx, :, slot].set(kn, mode="drop")
+        vp_l = vp_l.at[idx, :, slot].set(vn, mode="drop")
+        # tiled page-dim allgather = exact concatenation of the shards
+        kf = lax.all_gather(kp_l, axis, axis=0, tiled=True)
+        vf = lax.all_gather(vp_l, axis, axis=0, tiled=True)
+        out, _ = gqa_decode_paged(q, kf, vf, bt, kv_lens)
+        return out, kp_l, vp_l
+
+    sm = ctx.shard_map(
+        body,
+        in_specs=(P(axis), P(axis)) + (P(),) * (6 + int(has_active)),
+        out_specs=(P(), P(axis), P(axis)))
+    args = (k_pages, v_pages, q, k_new, v_new, block_table, pos, kv_len)
+    if has_active:
+        args += (active,)
+    return sm(*args)
+
+
 __all__ = ["gqa_decode_partial", "gqa_decode_paged", "paged_kv_write",
-           "decode_combine", "ll_ag_merge", "sp_gqa_flash_decode"]
+           "decode_combine", "ll_ag_merge", "sp_gqa_flash_decode",
+           "sp_paged_attend_write"]

@@ -4,6 +4,9 @@ EP/SP overlap ops (see docs/serving.md).
 - kv_pool    — paged KV page allocator + cache<->pages converters
 - scheduler  — FIFO admission / preemption policy over fixed batch slots
 - engine     — the jitted one-step-per-token decode engine
+- sharded    — the engine on a TP/SP/EP mesh (SP-sharded page pool, TP
+               projections, EP MoE FFN through the overlap kernels, with
+               the replicated-decision digest guard)
 - disagg     — disaggregated prefill/decode over the shmem page-migration
                kernel (signal-gated admission + the ISSUE-7 recovery
                ladder: deadline → retry/backoff → local re-prefill →
@@ -27,9 +30,17 @@ from triton_dist_tpu.serving.kv_pool import (KVPagePool, PageLedgerError,
 from triton_dist_tpu.serving.metrics import Histogram, ServingMetrics
 from triton_dist_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                                Request, RequestState)
+from triton_dist_tpu.serving.sharded import (MESH_AXES,
+                                             ReplicatedDecisionError,
+                                             ShardedServingEngine,
+                                             serving_mesh)
 
 __all__ = [
     "ServingEngine",
+    "ShardedServingEngine",
+    "ReplicatedDecisionError",
+    "serving_mesh",
+    "MESH_AXES",
     "DisaggServingEngine",
     "PageMigrationChannel",
     "ChunkSignalLedger",

@@ -138,7 +138,8 @@ class ServingEngine:
                  prefill_buckets="pow2",
                  eos_id: int | None = None,
                  prefill_chunk: int | None = None,
-                 stall_deadline_steps: int = 256):
+                 stall_deadline_steps: int = 256,
+                 ffn_chunk=None, attn_io=None, linear=None):
         assert decode_horizon >= 1
         assert prefill_chunk is None or prefill_chunk >= 1
         assert stall_deadline_steps >= 1
@@ -169,19 +170,44 @@ class ServingEngine:
         self._token = np.zeros(num_slots, np.int32)
         self._pos = np.zeros(num_slots, np.int32)
         self._bt = np.zeros((num_slots, pages_per_seq), np.int32)
-        self._token_dev = jnp.asarray(self._token)
-        self._pos_dev = jnp.asarray(self._pos)
-        self._bt_dev = jnp.asarray(self._bt)
+        self._sync_mirrors()
         self._dirty = False                 # mirrors diverged from device
 
+        # hooked paths (attn_io/linear — the sharded engine's SP attention
+        # and TP projections; ffn_chunk — a chunk-row-count FFN distinct
+        # from the decode one, needed when the FFN is shape-specialized
+        # like the EP a2a dispatch) ride only through the CHUNKED admit
+        # path: the bucketed inline prefill has no hook plumbing
+        assert (attn_io is None and linear is None) or \
+            prefill_chunk is not None, (
+            "attn_io/linear hooks need prefill_chunk set — the bucketed "
+            "inline prefill path does not thread them")
         K = decode_horizon
-        step = lambda p, t, pos, pages, bt, lim: decode_multistep_paged(  # noqa: E731
-            p, t, pos, cfg, pages, bt, lim, horizon=K, eos_id=eos_id,
-            ffn=ffn)
+
+        def step(p, t, pos, pages, bt, lim):
+            return decode_multistep_paged(
+                p, t, pos, cfg, pages, bt, lim, horizon=K, eos_id=eos_id,
+                ffn=ffn, attn_io=attn_io, linear=linear)
+        # pool-output sharding pin (sharded engine sets _pool_out_sharding
+        # BEFORE calling super().__init__): without it, GSPMD may choose a
+        # different output sharding for the pool than the committed SP
+        # input sharding (the a2a's all_to_all regions perturb the
+        # propagation; an internal with_sharding_constraint loses too) and
+        # the SECOND dispatch recompiles against the flipped signature —
+        # breaking the one-program-per-path contract ``compile_stats``
+        # pins. out_shardings at the jit boundary always wins.
+        ps = getattr(self, "_pool_out_sharding", None)
+        # the fed-back token/pos carries are pinned replicated for the
+        # same reason (their initial host uploads are committed to the
+        # matching sharding by the sharded engine)
+        rep = None if ps is None else \
+            jax.sharding.NamedSharding(ps.mesh, jax.sharding.PartitionSpec())
+        step_kw = {} if ps is None else {
+            "out_shardings": (None, rep, rep, {"k": ps, "v": ps})}
         if jax.default_backend() == "cpu":
-            self._step = jax.jit(step)      # CPU: donation unsupported
+            self._step = jax.jit(step, **step_kw)  # CPU: no donation
         else:
-            self._step = jax.jit(step, donate_argnums=(3,))
+            self._step = jax.jit(step, donate_argnums=(3,), **step_kw)
         self._prefill_jit = {}              # keyed by (bucket, cache_len)
 
         self.prefill_chunk = prefill_chunk
@@ -190,12 +216,28 @@ class ServingEngine:
             # ONE program for every prompt length/position: chunk size is
             # the only shape; cursor and prompt length ride as runtime
             # scalars (same trick as the decode limit argument)
-            chunk = lambda p, t, s, n, pages, bt: prefill_chunk_paged(  # noqa: E731
-                p, t, s, n, cfg, pages, bt, ffn=ffn)
+            def chunk(p, t, s, n, pages, bt):
+                return prefill_chunk_paged(
+                    p, t, s, n, cfg, pages, bt, ffn=ffn_chunk or ffn,
+                    attn_io=attn_io, linear=linear)
+            chunk_kw = {} if ps is None else {
+                "out_shardings": (None, {"k": ps, "v": ps})}
             if jax.default_backend() == "cpu":
-                self._chunk_step = jax.jit(chunk)
+                self._chunk_step = jax.jit(chunk, **chunk_kw)
             else:
-                self._chunk_step = jax.jit(chunk, donate_argnums=(4,))
+                self._chunk_step = jax.jit(chunk, donate_argnums=(4,),
+                                           **chunk_kw)
+
+    def _sync_mirrors(self) -> None:
+        """Upload the host slot mirrors to the device copies. The sharded
+        engine overrides this to COMMIT the uploads to the mesh (matching
+        the jit out_shardings pin) — pjit's executable cache keys on input
+        sharding/committed-ness, so a flip between an uncommitted first
+        upload and the committed fed-back outputs would cost one spurious
+        recompile per program."""
+        self._token_dev = jnp.asarray(self._token)
+        self._pos_dev = jnp.asarray(self._pos)
+        self._bt_dev = jnp.asarray(self._bt)
 
     # -- request intake ---------------------------------------------------
     def submit(self, prompt, max_new_tokens: int, rid: int | None = None
@@ -494,9 +536,7 @@ class ServingEngine:
             return not self.sched.idle
 
         if self._dirty:
-            self._token_dev = jnp.asarray(self._token)
-            self._pos_dev = jnp.asarray(self._pos)
-            self._bt_dev = jnp.asarray(self._bt)
+            self._sync_mirrors()
             self._dirty = False
             self.metrics.inc("host_syncs")
 

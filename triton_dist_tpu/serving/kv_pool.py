@@ -33,6 +33,18 @@ import jax
 from jax.sharding import PartitionSpec as P
 
 
+def _fnv1a(h: int, *words: int) -> int:
+    """Fold ints into a 32-bit FNV-1a state (4 bytes each, two's
+    complement for the odd negative sentinel). Shared by the pool and
+    scheduler digests so the two ledgers hash identically across ranks."""
+    for w in words:
+        w &= 0xFFFFFFFF
+        for shift in (0, 8, 16, 24):
+            h ^= (w >> shift) & 0xFF
+            h = (h * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
 class PageLedgerError(AssertionError):
     """Page-accounting corruption: double free, freeing a foreign page, or
     migrating a reserved/scratch page. Raised EXPLICITLY (not via bare
@@ -91,6 +103,24 @@ class KVPagePool:
 
     def holds(self, seq_id) -> bool:
         return seq_id in self._owned
+
+    def digest(self) -> int:
+        """Cheap order-sensitive ledger digest (32-bit FNV-1a) over the
+        ENTIRE allocator state: free-list order, ownership map in insertion
+        order, and the static geometry. Two pools that ever made a
+        different allocation decision — even ones that converged back to
+        the same free-page COUNT — digest differently, because the LIFO
+        free-list ORDER encodes the whole decision history. This is the
+        replicated-decision guard the sharded serving engine cross-checks
+        every step: every rank runs an identical allocator on identical
+        inputs, so any digest divergence means a rank's control plane
+        forked (and its block tables are about to scribble on the wrong
+        pages). Pure Python ints, microseconds at serving pool sizes."""
+        h = _fnv1a(0x811C9DC5, self.num_pages, self.page_size, self.reserved)
+        h = _fnv1a(h, len(self._free), *self._free)
+        for sid, pages in self._owned.items():
+            h = _fnv1a(h, hash(sid) & 0xFFFFFFFF, len(pages), *pages)
+        return h
 
     # -- allocation -------------------------------------------------------
     def alloc(self, seq_id, n_pages: int) -> list[int] | None:
@@ -303,4 +333,4 @@ def pages_to_cache(pages: jax.Array, block_table: jax.Array) -> jax.Array:
 
 
 __all__ = ["KVPagePool", "PageLedgerError", "page_pool_pspec",
-           "cache_to_pages", "pages_to_cache"]
+           "cache_to_pages", "pages_to_cache", "_fnv1a"]

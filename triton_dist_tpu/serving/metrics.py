@@ -97,6 +97,10 @@ class ServingMetrics:
             # the contiguous-cache converters and the host argmax never
             # run (tests assert this via prefill_chunks > 0)
             "prefill_chunks": 0,
+            # sharded serving (ISSUE 8): replicated-decision digest
+            # cross-checks run (each one all-gathered the control-plane
+            # digest over the mesh and compared every rank to rank 0)
+            "digest_checks": 0,
             # disaggregated serving (ISSUE 6): pages pushed over the
             # one-sided shmem layer, migration kernel launches (one per
             # finished chunk with at least one finalized page), and

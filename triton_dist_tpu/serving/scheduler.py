@@ -127,6 +127,29 @@ class ContinuousBatchingScheduler:
     def submit(self, req: Request, front: bool = False) -> None:
         (self.queue.appendleft if front else self.queue.append)(req)
 
+    def digest(self) -> int:
+        """Order-sensitive 32-bit FNV-1a digest of the WHOLE scheduling
+        state: queue order (with each request's resume-relevant cursors),
+        slot seating, and the admission ticket. The scheduler half of the
+        replicated-decision guard (see ``KVPagePool.digest``): sharded
+        serving runs one scheduler instance per rank and asserts the
+        digests match every step — a forked admission or victim choice is
+        caught before its block tables diverge, not after."""
+        from triton_dist_tpu.serving.kv_pool import _fnv1a
+        h = _fnv1a(0x811C9DC5, self.num_slots, self._admit_ticket,
+                   len(self.queue))
+        for r in self.queue:
+            h = _fnv1a(h, r.rid, r.prefill_cursor, r.preemptions,
+                       len(r.generated))
+        for r in self.slots:
+            if r is None:
+                h = _fnv1a(h, 0xFFFFFFFF)
+            else:
+                h = _fnv1a(h, r.rid, list(RequestState).index(r.state),
+                           r.admitted_seq, r.prefill_cursor,
+                           len(r.generated))
+        return h
+
     @property
     def queue_depth(self) -> int:
         return len(self.queue)

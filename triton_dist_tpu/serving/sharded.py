@@ -1,0 +1,263 @@
+"""Sharded serving (ISSUE 8 tentpole): the continuous-batching engine's two
+compiled programs — ``prefill_chunk_paged`` and ``decode_multistep_paged`` —
+run over a TP/SP/EP mesh, with every sharded layer routed through the
+overlap-kernel library via the model hooks:
+
+- **attention (SP)**: the page pool is sharded on its PAGE dim per
+  ``page_pool_pspec`` and each layer's KV-write + paged-GQA-attention pair
+  runs ``ops.flash_decode.sp_paged_attend_write`` — per-rank masked local
+  writes, tiled pool allgather, replicated attention walk.
+- **dense projections (TP)**: wq/wk/wv/wo/lm_head run
+  ``ops.allgather_gemm.tp_column_linear`` — column-sharded weights,
+  last-dim allgather (``tp_impl="ag_gemm"`` swaps in the Pallas
+  AllGather-GEMM overlap kernel).
+- **MoE FFN (EP)**: ``models.moe.moe_mlp_ep_overlap`` — router →
+  low-latency A2A dispatch (fp8 on the wire with ``wire_dtype="auto"``) →
+  grouped expert FFN on local experts → A2A combine.
+
+Host control plane stays REPLICATED-DECISION: one ``KVPagePool`` +
+``ContinuousBatchingScheduler`` instance makes every allocation/admission/
+preemption choice from device-independent inputs (token ids, counters), so
+all ranks agree on block tables by construction — and the per-step digest
+cross-check (``check_replicated_decisions``) turns "by construction" into a
+loud runtime guarantee.
+
+THE numerical contract (tests/test_sharded_serving.py): served tokens are
+BITWISE identical across mesh sizes — the n>1 trace replays the n=1 golden
+exactly, preemptions and all. This falls out of three exactness facts:
+
+1. column-split matmul + concat allgather == the unsplit matmul (TP);
+2. per-row EP dispatch/quant/combine with a fixed k-order fold is
+   independent of which rank computed the row (EP, incl. the fp8 wire —
+   the n=1 path runs the SAME quantize/dequantize round trip);
+3. the SP pool allgather is a pure page-order concatenation (SP).
+
+No cross-rank floating-point REDUCTION exists anywhere in the hot loop —
+which is also why ``gemm_rs`` is refused here (docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.layers.ep_a2a_layer import EPAll2AllLayer
+from triton_dist_tpu.models.moe import MoEConfig, moe_mlp_ep_overlap
+from triton_dist_tpu.ops.allgather_gemm import GemmConfig, tp_column_linear
+from triton_dist_tpu.ops.flash_decode import sp_paged_attend_write
+from triton_dist_tpu.serving.engine import ServingEngine
+from triton_dist_tpu.serving.kv_pool import _fnv1a, page_pool_pspec
+from triton_dist_tpu.serving.metrics import ServingMetrics
+from triton_dist_tpu.shmem.context import ShmemContext, initialize_distributed
+
+MESH_AXES = ("tp", "sp", "ep")
+
+
+class ReplicatedDecisionError(AssertionError):
+    """The per-rank control-plane digests diverged: some rank's allocator/
+    scheduler made a different decision than rank 0's. Block tables are
+    about to disagree across ranks — fail loudly BEFORE a wrong-rank page
+    write corrupts live KV, not after."""
+
+
+def serving_mesh(tp: int = 1, sp: int = 1, ep: int = 1) -> ShmemContext:
+    """Build the TP×SP×EP serving mesh (axis names fixed to ``MESH_AXES``
+    so the engine, bench rows, and serve_sim all agree on spelling)."""
+    return initialize_distributed(axis_names=MESH_AXES,
+                                  mesh_shape=(tp, sp, ep))
+
+
+class ShardedServingEngine(ServingEngine):
+    """``ServingEngine`` on a TP/SP/EP mesh serving an MoE model (see the
+    module docstring for the layer→kernel map and the bitwise contract).
+
+    ``cfg`` is a ``MoEConfig`` (params from ``init_moe_params``); the
+    flagship target is ``MoEConfig.deepseek_infer()``, the reference's
+    A2A benchmark shape. ``ctx`` must carry all three ``MESH_AXES``
+    (``serving_mesh``); size-1 axes degrade each path to its exact
+    single-rank form — the SAME code (hooks set, loops unrolled, fp8 wire
+    round-tripped) at every mesh size, which is what makes the n=1 run a
+    valid golden for n>1.
+
+    Requirements beyond the base engine:
+    - ``prefill_chunk`` is MANDATORY (the bucketed inline prefill has no
+      hook plumbing, and the EP FFN is shape-specialized per row count —
+      decode serves ``num_slots`` rows, a chunk serves ``prefill_chunk``);
+    - ``num_slots % ep == 0`` and ``prefill_chunk % ep == 0`` (the A2A
+      context splits token rows evenly over EP ranks);
+    - ``d_model % 128 == 0`` (A2A wire lane alignment, asserted there).
+
+    ``wire_dtype="auto"`` picks fp8 for the A2A payload when the platform
+    supports it; ``tp_impl="ag_gemm"`` routes the TP projections through
+    the Pallas overlap kernel (allclose-only — excluded from the bitwise
+    contract; see ``tp_column_linear``). ``digest_every=k`` runs the
+    replicated-decision guard every k-th step (0 disables).
+
+    Disaggregation does NOT compose with this engine yet: the migration
+    channel moves whole pages between two SINGLE-rank pools, while this
+    pool is page-sharded over SP — refused explicitly (docs/serving.md)
+    rather than silently migrating one shard.
+    """
+
+    def __init__(self, params: dict, cfg: MoEConfig, ctx: ShmemContext,
+                 num_slots: int = 4, page_size: int = 16,
+                 num_pages: int = 64, pages_per_seq: int = 8,
+                 max_prefills_per_step: int | None = None,
+                 metrics: ServingMetrics | None = None,
+                 decode_horizon: int = 1, eos_id: int | None = None,
+                 prefill_chunk: int | None = None,
+                 stall_deadline_steps: int = 256,
+                 wire_dtype: str | None = "auto", tp_impl: str = "xla",
+                 tp_cfg: GemmConfig | None = None, moe_block_m: int = 128,
+                 digest_every: int = 1):
+        for ax in MESH_AXES:
+            assert ax in ctx.axis_names, (
+                f"mesh is missing axis {ax!r} — build it with "
+                f"serving_mesh(tp, sp, ep); got {ctx.axis_names}")
+        assert prefill_chunk is not None, (
+            "sharded serving requires prefill_chunk: the bucketed inline "
+            "prefill path has no attn_io/linear/ffn-chunk plumbing")
+        self.ctx = ctx
+        self.moe_cfg = cfg
+        n_tp = ctx.axis_size("tp")
+        n_sp = ctx.axis_size("sp")
+        n_ep = ctx.axis_size("ep")
+        self.mesh_desc = f"{n_tp}x{n_sp}x{n_ep}"
+        assert num_slots % n_ep == 0, (
+            f"num_slots {num_slots} must split evenly over ep={n_ep}")
+        assert prefill_chunk % n_ep == 0, (
+            f"prefill_chunk {prefill_chunk} must split evenly over "
+            f"ep={n_ep}")
+
+        # TWO A2A layers: the EP dispatch is row-count-specialized, and the
+        # engine's two programs run different row counts (decode: the
+        # num_slots batch; chunk: the prefill_chunk rows)
+        mk = lambda rows: EPAll2AllLayer.create(  # noqa: E731
+            ctx, max_tokens=rows // n_ep, hidden=cfg.base.d_model,
+            topk=cfg.topk, num_experts=cfg.num_experts, axis="ep",
+            dtype=cfg.base.dtype, wire_dtype=wire_dtype)
+        self.a2a_decode = mk(num_slots)
+        self.a2a_chunk = (self.a2a_decode if prefill_chunk == num_slots
+                          else mk(prefill_chunk))
+        self.wire_dtype = str(jnp.dtype(self.a2a_decode.a2a.wire_dtype)) \
+            if self.a2a_decode.a2a.wire_dtype is not None else None
+
+        def moe_ffn(a2a):
+            def ffn(h, p):
+                return moe_mlp_ep_overlap(ctx, a2a, h, p["w_router"],
+                                          p["we_gate"], p["we_up"],
+                                          p["we_down"], block_m=moe_block_m)
+            return ffn
+
+        def attn_io(q, k, v, kp, vp, bt, pos, kv_len, active):
+            return sp_paged_attend_write(ctx, q, k, v, kp, vp, bt, pos,
+                                         kv_len, axis="sp", active=active)
+
+        def linear(h, w, name):
+            return tp_column_linear(ctx, h, w, axis="tp", impl=tp_impl,
+                                    cfg=tp_cfg)
+
+        # pool-output sharding pin: must exist BEFORE super().__init__
+        # builds the jitted programs (it becomes their out_shardings for
+        # the pool pytree — see the comment at the jit construction site
+        # in ServingEngine.__init__)
+        self._pool_out_sharding = jax.sharding.NamedSharding(
+            ctx.mesh, page_pool_pspec("sp"))
+        # replicated sharding for the control-plane mirrors (_sync_mirrors
+        # commits every upload so pjit's executable cache sees ONE input
+        # signature across all dispatches)
+        self._rep_sharding = jax.sharding.NamedSharding(ctx.mesh, P())
+
+        super().__init__(params, cfg.base, num_slots=num_slots,
+                         page_size=page_size, num_pages=num_pages,
+                         pages_per_seq=pages_per_seq,
+                         ffn=moe_ffn(self.a2a_decode),
+                         ffn_chunk=moe_ffn(self.a2a_chunk),
+                         attn_io=attn_io, linear=linear,
+                         max_prefills_per_step=max_prefills_per_step,
+                         metrics=metrics, decode_horizon=decode_horizon,
+                         eos_id=eos_id, prefill_chunk=prefill_chunk,
+                         stall_deadline_steps=stall_deadline_steps)
+
+        # shard the pool arrays over SP on the page dim, padding the page
+        # count up to a multiple of |sp|. The ALLOCATOR never learns about
+        # the padding pages — they are never handed out, every block-table
+        # fill entry stays the scratch page — so allocation/preemption
+        # schedules are identical at every mesh size (part of the bitwise
+        # contract). Zero-init padding matches the live pages' init.
+        pad = (-self.pool["k"].shape[1]) % n_sp
+        if pad:
+            self.pool = {
+                k: jnp.concatenate(
+                    [v, jnp.zeros(v.shape[:1] + (pad,) + v.shape[2:],
+                                  v.dtype)], axis=1)
+                for k, v in self.pool.items()}
+        self.pool = {k: jax.device_put(v, self._pool_out_sharding)
+                     for k, v in self.pool.items()}
+
+        # replicated-decision guard: every rank carries (conceptually) its
+        # own copy of the host control plane; the check all-gathers the
+        # per-rank digests ON DEVICE (through the same mesh the model
+        # runs on) and compares against rank 0. ``_digest_skew`` is the
+        # test hook that injects a per-rank divergence to prove the guard
+        # trips (there is no organic way to fork a replicated digest in a
+        # single-controller process).
+        self.digest_every = digest_every
+        self.n_ranks = ctx.num_ranks
+        self._digest_skew = np.zeros(self.n_ranks, np.uint32)
+
+        def gather_cmp(v):                       # v [1] int32, my digest
+            g = v
+            for ax in MESH_AXES:
+                g = lax.all_gather(g, ax, axis=0, tiled=True)
+            return jnp.any(g != g[0])[None].astype(jnp.int32)
+
+        self._digest_check = jax.jit(ctx.shard_map(
+            gather_cmp, in_specs=P(MESH_AXES), out_specs=P(MESH_AXES)))
+
+    def _sync_mirrors(self) -> None:
+        self._token_dev = jax.device_put(jnp.asarray(self._token),
+                                         self._rep_sharding)
+        self._pos_dev = jax.device_put(jnp.asarray(self._pos),
+                                       self._rep_sharding)
+        self._bt_dev = jax.device_put(jnp.asarray(self._bt),
+                                      self._rep_sharding)
+
+    # -- replicated-decision guard ----------------------------------------
+    def control_digest(self) -> int:
+        """One 32-bit word summarizing every control-plane decision so far
+        (allocator ledger ⊕ scheduler state, both order-sensitive)."""
+        return _fnv1a(0x811C9DC5, self.alloc.digest(), self.sched.digest())
+
+    def check_replicated_decisions(self) -> None:
+        """Cross-rank digest assertion (satellite 1): all-gather each
+        rank's control digest over the full mesh and compare to rank 0's.
+        Raises ``ReplicatedDecisionError`` on divergence."""
+        h = self.control_digest()
+        vals = (np.full(self.n_ranks, h, np.uint32)
+                + self._digest_skew).view(np.int32)
+        mismatch = np.asarray(self._digest_check(jnp.asarray(vals)))
+        self.metrics.inc("digest_checks")
+        if mismatch.any():
+            bad = np.nonzero(vals != vals[0])[0].tolist()
+            raise ReplicatedDecisionError(
+                f"control-plane digest diverged across ranks at step "
+                f"{self._steps}: ranks {bad or '<device-side only>'} "
+                f"disagree with rank 0 (digest 0x{h:08x}, mesh "
+                f"{self.mesh_desc}). A replicated-decision input leaked "
+                "rank-dependent state — block tables are no longer "
+                "trustworthy.")
+
+    def step(self) -> bool:
+        progressed = super().step()
+        if progressed and self.digest_every \
+                and self._steps % self.digest_every == 0:
+            self.check_replicated_decisions()
+        return progressed
+
+
+__all__ = ["ShardedServingEngine", "ReplicatedDecisionError",
+           "serving_mesh", "MESH_AXES"]
