@@ -1281,6 +1281,120 @@ def bench_chaos(ctx, num_slots: int = 4, page_size: int = 16,
     }
 
 
+def bench_recovery(ctx, num_requests: int = 20, num_slots: int = 4,
+                   page_size: int = 8, n_layers: int = 1,
+                   prefill_chunk: int = 8,
+                   checkpoint_every: int = 8) -> dict:
+    """Crash-consistency cost rows (ISSUE 9): what the journal/checkpoint/
+    restore machinery costs, priced on the same seeded traces the recovery
+    tests pin —
+
+    - ``checkpoint_us``: mean control-plane snapshot cost at an
+      every-``checkpoint_every``-steps cadence (pure host work, zero
+      dispatches — the number that bounds journaled-run overhead).
+    - ``recovery_replay_us``: one full restore on a freshly built engine —
+      checkpoint load + WAL-suffix replay + mirror re-upload (the
+      crash-to-serving gap, minus the re-prefill the trace contract makes
+      free).
+    - ``digest_recovery_us``: the sharded digest-divergence rung end to
+      end — quarantine, restore from the last agreed step, re-admission —
+      under a seeded transient ``digest_skew`` on the n=2 mesh.
+
+    Every row is priced on a run whose tokens are asserted BIT-IDENTICAL
+    to its fault-free golden: these rows price recovery, they must not
+    change output.
+    """
+    from triton_dist_tpu.models.llama import LlamaConfig, init_params
+    from triton_dist_tpu.serving import ControlJournal, ServingEngine
+    from triton_dist_tpu.shmem import FaultPlan
+    from triton_dist_tpu.shmem.faults import InjectedCrash
+    import numpy as _np
+
+    cfg = LlamaConfig.tiny(n_layers=n_layers)
+    params = init_params(jax.random.key(3), cfg)
+    kw = dict(num_slots=num_slots, page_size=page_size,
+              num_pages=3 * num_slots, pages_per_seq=6,
+              prefill_chunk=prefill_chunk)
+    us = lambda h, k="mean": round((h[k] or 0.0) * 1e6, 1)
+
+    def _trace():
+        rng = _np.random.RandomState(5)
+        return [(i, [int(t) for t in rng.randint(
+                    1, cfg.vocab_size, size=int(rng.randint(4, 17)))],
+                 int(rng.randint(2, 8))) for i in range(num_requests)]
+
+    gold_eng = ServingEngine(params, cfg, **kw)
+    gold = gold_eng.run(max_steps=100_000, arrivals=_trace())
+    journal = ControlJournal()
+    crash_at = gold_eng._steps // 2
+    eng = ServingEngine(params, cfg, journal=journal,
+                        checkpoint_every=checkpoint_every,
+                        fault_plan=FaultPlan(seed=7, crash_at=(crash_at,)),
+                        **kw)
+    try:
+        eng.run(max_steps=100_000, arrivals=_trace())
+        raise AssertionError("injected crash never fired")
+    except InjectedCrash:
+        pass
+    done = sum(1 for e in journal.entries if e["kind"] == "submit")
+    eng2 = ServingEngine(params, cfg, journal=journal,
+                         checkpoint_every=checkpoint_every, **kw)
+    res = eng2.run(max_steps=100_000, arrivals=_trace()[done:],
+                   recover=True)
+    assert res == gold, "crash recovery changed tokens — replay regression"
+    snap = eng2.metrics.snapshot()
+    rows = {
+        "checkpoint_us": us(eng.metrics.snapshot()["checkpoint_s"]),
+        "checkpoints": eng.metrics.counters["checkpoints"],
+        "recovery_replay_us": us(snap["restore_s"]),
+        "recovery_journal_entries": len(journal),
+        "recovery_knobs": {"num_slots": num_slots, "page_size": page_size,
+                           "n_layers": n_layers, "crash_at": crash_at,
+                           "checkpoint_every": checkpoint_every},
+    }
+
+    # the sharded digest rung needs a 2-rank mesh
+    if len(jax.devices()) >= 2:
+        from triton_dist_tpu.models.moe import MoEConfig, init_moe_params
+        from triton_dist_tpu.serving import (ShardedServingEngine,
+                                             serving_mesh)
+        mcfg = MoEConfig(base=LlamaConfig(vocab_size=128, d_model=128,
+                                          n_layers=1, n_heads=4,
+                                          n_kv_heads=2, d_ff=128,
+                                          max_seq_len=128,
+                                          dtype=jnp.float32),
+                         num_experts=4, topk=2, moe_d_ff=64)
+        mparams = init_moe_params(jax.random.key(3), mcfg)
+        skw = dict(num_slots=num_slots, page_size=page_size, num_pages=9,
+                   pages_per_seq=4, prefill_chunk=prefill_chunk,
+                   wire_dtype=jnp.float8_e4m3fn)
+
+        def _mtrace():
+            rng = _np.random.RandomState(5)
+            return [(i // 2, [int(t) for t in rng.randint(
+                        1, 128, size=int(rng.randint(4, 17)))],
+                     int(rng.randint(2, 8))) for i in range(12)]
+
+        mgold = ShardedServingEngine(
+            mparams, mcfg, serving_mesh(1, 2, 1), **skw).run(
+                max_steps=100_000, arrivals=_mtrace())
+        meng = ShardedServingEngine(
+            mparams, mcfg, serving_mesh(1, 2, 1), journal=ControlJournal(),
+            checkpoint_every=4, digest_every=1,
+            fault_plan=FaultPlan(seed=5, digest_skew_at=(7,)), **skw)
+        mres = meng.run(max_steps=100_000, arrivals=_mtrace())
+        assert meng.metrics.counters["digest_recoveries"] == 1
+        assert mres == mgold, ("digest recovery changed tokens — "
+                               "divergence rung regression")
+        msnap = meng.metrics.snapshot()
+        rows["digest_recovery_us"] = us(msnap["digest_recovery_s"])
+        rows["digest_recoveries"] = meng.metrics.counters[
+            "digest_recoveries"]
+    else:
+        rows["digest_recovery_skipped"] = "needs >= 2 devices"
+    return rows
+
+
 def bench_serving_sharded(ctx, num_requests: int = 24, num_slots: int = 4,
                           page_size: int = 8, num_pages: int = 24,
                           pages_per_seq: int = 4, prefill_chunk: int = 8,
@@ -1618,6 +1732,14 @@ def main(a2a_primary: bool = False):
         extras.update(bench_chaos(ctx, **csh))
 
     attempt("chaos", _chaos)
+
+    def _recovery():
+        # crash-consistency cost: checkpoint cadence, restore/replay, and
+        # the sharded digest-divergence rung (ISSUE 9); every row asserts
+        # token bit-identity against its fault-free golden
+        extras.update(bench_recovery(ctx))
+
+    attempt("recovery", _recovery)
 
     def _serving_sharded():
         # whole-engine mesh-size sweep for the EP MoE config (ISSUE 8);

@@ -8,6 +8,8 @@ matching knobs (--slots/--page-size/--layers mirror bench_serving's).
     python scripts/serve_sim.py --sim 50
     python scripts/serve_sim.py --sim 20 --slots 8 --pages 12  # preempts
     python scripts/serve_sim.py --sim 20 --model moe --mesh 1x2x2
+    python scripts/serve_sim.py --sim 30 --crash-at 25 --recover  # ISSUE 9
+    python scripts/serve_sim.py --sim 40 --queue-cap 6 --ttl 50  # overload
 
 A deliberately small --pages forces preemption-by-eviction; the replay is
 bit-deterministic (same seed => same tokens, same metrics counters), which
@@ -84,7 +86,33 @@ p.add_argument("--chaos", default=None, metavar="SPEC",
                     "rids=1|4|7'. Replays are bit-deterministic per spec; "
                     "a chaos summary line (retries / degradations / "
                     "failures / recovery latencies) is printed to stderr")
+p.add_argument("--crash-at", type=int, default=None, metavar="STEP",
+               help="inject a hard crash (InjectedCrash) at this engine "
+                    "step; with --recover a FRESH engine is rebuilt from "
+                    "the journal and the replay continues (the crash-"
+                    "consistency demo, docs/robustness.md). Without "
+                    "--recover the crash propagates (exit 1)")
+p.add_argument("--recover", action="store_true",
+               help="after --crash-at fires, restore a fresh engine from "
+                    "the journal (checkpoint + WAL-suffix replay, zero new "
+                    "compiles) and finish the trace; prints a recovery "
+                    "summary line to stderr. Tokens stay bit-identical to "
+                    "the crash-free replay")
+p.add_argument("--checkpoint-every", type=int, default=16, metavar="N",
+               help="control-plane checkpoint cadence in engine steps "
+                    "(journaled runs only; 0 disables checkpoints — "
+                    "recovery then replays the whole journal)")
+p.add_argument("--queue-cap", type=int, default=None, metavar="N",
+               help="bounded admission queue: submissions past N queued "
+                    "requests are REJECTED with a typed terminal "
+                    "(overload shedding; counted in 'rejections')")
+p.add_argument("--ttl", type=int, default=None, metavar="STEPS",
+               help="per-request TTL in engine steps: queued requests "
+                    "never admitted within the budget EXPIRE with a typed "
+                    "terminal (counted in 'expirations')")
 args = p.parse_args()
+if args.recover and args.crash_at is None:
+    p.error("--recover needs --crash-at")
 if args.chaos is not None:
     args.disagg = True
 if args.mesh is not None:
@@ -123,42 +151,67 @@ else:
     cfg = LlamaConfig.tiny(n_layers=args.layers)
     params = init_params(jax.random.PRNGKey(args.seed), cfg)
     vocab = cfg.vocab_size
-if args.mesh is not None:
-    import jax.numpy as jnp  # noqa: E402
 
-    from triton_dist_tpu.serving import ShardedServingEngine, serving_mesh  # noqa: E402
-    wire = {"auto": "auto", "fp8": jnp.float8_e4m3fn, "none": None}[args.wire]
-    eng = ShardedServingEngine(params, cfg, serving_mesh(tp, sp, ep),
-                               num_slots=args.slots,
-                               page_size=args.page_size,
-                               num_pages=args.pages,
-                               pages_per_seq=args.pages_per_seq,
-                               decode_horizon=args.decode_horizon,
-                               prefill_chunk=args.prefill_chunk or 8,
-                               wire_dtype=wire)
-    print(json.dumps({"mesh": eng.mesh_desc, "wire": eng.wire_dtype}),
-          file=sys.stderr)
-elif args.disagg:
-    from triton_dist_tpu.serving import DisaggServingEngine  # noqa: E402
+# crash-consistency plumbing: journaled runs get a WAL + periodic
+# checkpoints; --crash-at adds an engine-tier fault plan on top of any
+# --chaos signal-plane plan (the two tiers compose, see test_chaos.py)
+journaled = (args.crash_at is not None or args.queue_cap is not None
+             or args.ttl is not None)
+journal = None
+if journaled:
+    from triton_dist_tpu.serving import ControlJournal  # noqa: E402
+    journal = ControlJournal()
+ckpt_every = args.checkpoint_every or None if journaled else None
+
+
+def _fault_plan():
     from triton_dist_tpu.shmem import FaultPlan  # noqa: E402
     plan = FaultPlan.from_spec(args.chaos) if args.chaos else None
-    chunk = args.prefill_chunk or 2 * args.page_size
-    eng = DisaggServingEngine(params, cfg, num_slots=args.slots,
-                              page_size=args.page_size,
-                              num_pages=args.pages,
-                              pages_per_seq=args.pages_per_seq,
-                              decode_horizon=args.decode_horizon,
-                              prefill_chunk=chunk,
-                              fault_plan=plan)
-    if plan is not None:
-        print(json.dumps({"chaos": plan.describe()}), file=sys.stderr)
-else:
-    eng = ServingEngine(params, cfg, num_slots=args.slots,
-                        page_size=args.page_size, num_pages=args.pages,
-                        pages_per_seq=args.pages_per_seq,
-                        decode_horizon=args.decode_horizon,
-                        prefill_buckets=buckets,
-                        prefill_chunk=args.prefill_chunk)
+    if args.crash_at is not None:
+        import dataclasses as _dc  # noqa: E402
+        plan = (_dc.replace(plan, crash_at=(args.crash_at,)) if plan
+                else FaultPlan(seed=args.seed, crash_at=(args.crash_at,)))
+    return plan
+
+
+def mk_engine(fresh=False):
+    """Build the selected engine. ``fresh=True`` is the restarted
+    incarnation after a crash: same configuration, same journal — the
+    fault plan rides along unchanged (crash injection is incarnation-
+    gated, so it fires only once)."""
+    common = dict(num_slots=args.slots, page_size=args.page_size,
+                  num_pages=args.pages, pages_per_seq=args.pages_per_seq,
+                  decode_horizon=args.decode_horizon, journal=journal,
+                  checkpoint_every=ckpt_every, queue_cap=args.queue_cap,
+                  ttl_steps=args.ttl, fault_plan=_fault_plan())
+    if args.mesh is not None:
+        import jax.numpy as jnp  # noqa: E402
+
+        from triton_dist_tpu.serving import (ShardedServingEngine,  # noqa: E402
+                                             serving_mesh)
+        wire = {"auto": "auto", "fp8": jnp.float8_e4m3fn,
+                "none": None}[args.wire]
+        eng = ShardedServingEngine(params, cfg, serving_mesh(tp, sp, ep),
+                                   prefill_chunk=args.prefill_chunk or 8,
+                                   wire_dtype=wire, **common)
+        if not fresh:
+            print(json.dumps({"mesh": eng.mesh_desc,
+                              "wire": eng.wire_dtype}), file=sys.stderr)
+    elif args.disagg:
+        from triton_dist_tpu.serving import DisaggServingEngine  # noqa: E402
+        chunk = args.prefill_chunk or 2 * args.page_size
+        eng = DisaggServingEngine(params, cfg, prefill_chunk=chunk,
+                                  **common)
+        if args.chaos is not None and not fresh:
+            print(json.dumps({"chaos": eng._fault_plan.describe()}),
+                  file=sys.stderr)
+    else:
+        eng = ServingEngine(params, cfg, prefill_buckets=buckets,
+                            prefill_chunk=args.prefill_chunk, **common)
+    return eng
+
+
+eng = mk_engine()
 
 rng = np.random.RandomState(args.seed)
 max_plen = min(args.pages_per_seq * args.page_size - args.max_new, 24)
@@ -170,11 +223,39 @@ for i in range(args.sim):
     arrivals.append((i * args.arrive_every // max(args.arrive_every, 1),
                      prompt, mnt))
 
-results = eng.run(max_steps=200_000, arrivals=arrivals)
+if args.crash_at is not None:
+    from triton_dist_tpu.shmem.faults import InjectedCrash  # noqa: E402
+    try:
+        results = eng.run(max_steps=200_000, arrivals=arrivals)
+    except InjectedCrash as crash:
+        if not args.recover:
+            print(json.dumps({"crashed": str(crash)}), file=sys.stderr)
+            sys.exit(1)
+        # process "restart": the journal is the only surviving artifact.
+        # Submissions already journaled (admitted or rejected) replay
+        # from the WAL; only the rest of the trace is re-fed.
+        done = sum(1 for e in journal.entries
+                   if e["kind"] in ("submit", "reject"))
+        eng = mk_engine(fresh=True)
+        results = eng.run(max_steps=200_000, arrivals=arrivals[done:],
+                          recover=True)
+        ck = journal.last_checkpoint_entry()
+        print(json.dumps({
+            "recovery": True,
+            "crash": str(crash),
+            "checkpoint_step": None if ck is None else ck["step"],
+            "journal_entries": len(journal),
+            "restores": eng.metrics.counters["restores"],
+            "replayed_submits": done,
+            "final_step": eng._steps,
+        }), file=sys.stderr)
+else:
+    results = eng.run(max_steps=200_000, arrivals=arrivals)
 # run() returns FINISHED requests only. Under --chaos a request may
-# instead have FAILED (typed, per-request — the ladder ran dry); those
-# are accounted for, not "unfinished". Anything else absent ran out of
-# steps — a real error.
+# instead have FAILED (typed, per-request — the ladder ran dry); under
+# --queue-cap/--ttl it may have been REJECTED/EXPIRED (typed overload
+# terminals); those are accounted for, not "unfinished". Anything else
+# absent ran out of steps — a real error.
 failed = {r.rid: r for r in getattr(eng, "failed", [])}
 unfinished = sorted(set(range(args.sim)) - set(results) - set(failed))
 if unfinished:
@@ -185,6 +266,16 @@ for rid in sorted(failed):
     print(json.dumps({"failed_rid": rid,
                       "reason": type(failed[rid].failure).__name__,
                       "detail": str(failed[rid].failure)}), file=sys.stderr)
+if args.queue_cap is not None or args.ttl is not None:
+    c = eng.metrics.counters
+    print(json.dumps({
+        "overload": True,
+        "queue_cap": args.queue_cap, "ttl_steps": args.ttl,
+        "submitted": c["requests_submitted"],
+        "admitted_finished": len(results),
+        "rejections": c["rejections"],
+        "expirations": c["expirations"],
+    }), file=sys.stderr)
 
 if args.tokens:
     for req in sorted(eng._finished, key=lambda r: r.rid):

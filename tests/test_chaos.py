@@ -29,12 +29,14 @@ import pytest
 
 from conftest import TEST_WORLD  # noqa: F401
 from triton_dist_tpu.models.llama import LlamaConfig, init_params
-from triton_dist_tpu.serving import (DisaggServingEngine, EngineStallError,
+from triton_dist_tpu.serving import (ControlJournal, DisaggServingEngine,
+                                     EngineStallError,
                                      MigrationSignalTimeout,
                                      SignalProtocolError)
 from triton_dist_tpu.serving.scheduler import RequestState
 from triton_dist_tpu.shmem import FaultPlan
 from triton_dist_tpu.shmem.context import initialize_distributed
+from triton_dist_tpu.shmem.faults import InjectedCrash
 
 pytestmark = [pytest.mark.disagg, pytest.mark.chaos]
 
@@ -269,6 +271,35 @@ def test_over_signal_is_protocol_error_not_coverage(chaos_model, role_ctx):
     assert "over-signal" in str(failed[0].failure)
     assert sorted(res) == [1, 2, 3]
     _audit(eng)
+
+
+@pytest.mark.recovery
+def test_crash_under_signal_chaos_recovers_golden(chaos_model, role_ctx,
+                                                  golden):
+    """ISSUE 9 satellite: the crash rung composes with the ISSUE-7
+    ladder. A schedule mixing dropped signals with a mid-trace CRASH must
+    still land on the golden tokens — the restarted engine replays the
+    journal, re-earns every dropped signal through retry, and the two
+    fault tiers never observe each other."""
+    plan = FaultPlan(seed=31, p_drop=0.25, crash_at=(40,))
+    journal = ControlJournal()
+    eng = _engine(chaos_model, role_ctx, fault_plan=plan, max_retries=6,
+                  journal=journal, checkpoint_every=8)
+    with pytest.raises(InjectedCrash):
+        eng.run(max_steps=MAX_STEPS, arrivals=_trace())
+    done = sum(1 for e in journal.entries if e["kind"] == "submit")
+    # the restarted incarnation keeps the SAME plan: signal drops stay
+    # live after restore (only the crash is incarnation-gated)
+    eng2 = _engine(chaos_model, role_ctx, fault_plan=plan, max_retries=6,
+                   journal=journal, checkpoint_every=8)
+    res = eng2.run(max_steps=MAX_STEPS, arrivals=_trace()[done:],
+                   recover=True)
+    assert eng2.metrics.counters["restores"] == 1
+    assert eng2.failed == []
+    assert sorted(res) == sorted(golden)
+    for rid in golden:
+        assert res[rid] == golden[rid], f"rid {rid} diverged"
+    _audit(eng2)
 
 
 def test_stall_watchdog_backstops_ladder_bugs(chaos_model, role_ctx,

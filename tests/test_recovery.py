@@ -1,0 +1,603 @@
+"""Crash-consistent serving (ISSUE 9): journal/checkpoint/restore held to
+the bit-identity contract on all three engines.
+
+The trace-determinism contract (greedy argmax decode + LIFO page
+allocation + strict-FIFO scheduling) makes every request's tokens a pure
+function of (params, prompt) — so crash recovery never persists KV: a
+fresh engine + the journal (which embeds periodic control-plane
+checkpoints) replays the WAL suffix, requeues every in-flight request at
+cursor 0, and regenerates bit-identical tokens through the
+already-compiled programs. The tests pin exactly that:
+
+- **crash sweep**: inject ``InjectedCrash`` at strided steps of the
+  50-request forced-preemption trace (every step under ``-m slow``),
+  recover into a fresh engine, and assert the union of pre-crash and
+  post-recovery finishes is BIT-IDENTICAL to the fault-free golden — on
+  colocated, sharded (n ∈ {1, 2, 4}), and disaggregated (including a
+  crash with a migration in flight: the restarted decode worker
+  re-admits the request through the rebuilt ledger, never fails it).
+- **zero new compiles**: restore performs no device dispatches — the jit
+  trace-cache sizes are unchanged across ``restore()``, and a recovered
+  run still ends at exactly one decode + one chunk program.
+- **digest divergence rung**: a seeded transient ``digest_skew`` on the
+  sharded mesh is absorbed by quarantine + restore (``digest_recoveries
+  == 1``, tokens golden); persistent skew (re-diverging with no agreed
+  step in between) escalates instead of looping; no journal = the
+  pre-ISSUE-9 hard raise.
+- **overload terminals**: a bounded admission queue + TTL shed excess
+  load with typed REJECTED terminals while every admitted request still
+  finishes bit-identically.
+
+Every test runs under the per-test SIGALRM watchdog (test_chaos.py
+pattern)."""
+
+import dataclasses
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import TEST_WORLD  # noqa: F401
+from triton_dist_tpu.models.llama import LlamaConfig, init_params
+from triton_dist_tpu.models.moe import MoEConfig, init_moe_params
+from triton_dist_tpu.serving import (AdmissionRejected, ControlJournal,
+                                     DisaggServingEngine,
+                                     ReplicatedDecisionError, ServingEngine,
+                                     ShardedServingEngine, TtlExpired,
+                                     serving_mesh)
+from triton_dist_tpu.serving import checkpoint as ckpt_mod
+from triton_dist_tpu.serving.checkpoint import (CheckpointIntegrityError,
+                                                rebuild_request,
+                                                snapshot_request)
+from triton_dist_tpu.serving.kv_pool import KVPagePool
+from triton_dist_tpu.serving.scheduler import Request, RequestState
+from triton_dist_tpu.shmem import FaultPlan
+from triton_dist_tpu.shmem.context import initialize_distributed
+from triton_dist_tpu.shmem.faults import InjectedCrash
+
+pytestmark = [pytest.mark.recovery, pytest.mark.serving]
+
+WATCHDOG_S = 240          # per-test wall cap — generous, CPU CI is slow
+N_REQUESTS = 50
+MAX_STEPS = 6000          # far above any legitimate run length
+WIRE = jnp.float8_e4m3fn  # pinned wire dtype (test_sharded_serving caveat)
+
+
+@pytest.fixture(autouse=True)
+def recovery_watchdog():
+    """Hard per-test wall-clock watchdog: a hang anywhere in the
+    crash/recover cycle must kill the test loudly, not stall the suite."""
+    def boom(signum, frame):
+        raise TimeoutError(
+            f"recovery watchdog: test exceeded {WATCHDOG_S}s wall — "
+            "the engine (or its recovery harness) is hanging")
+
+    old = signal.signal(signal.SIGALRM, boom)
+    signal.alarm(WATCHDOG_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+# ---------------------------------------------------------------- fixtures
+@pytest.fixture(scope="module")
+def tiny_model():
+    """Chaos-scale 1-layer model — the sweep reruns the trace many times,
+    so per-step cost dominates the budget."""
+    cfg = dataclasses.replace(
+        LlamaConfig(vocab_size=128, d_model=32, n_layers=1, n_heads=2,
+                    n_kv_heads=1, d_ff=64, max_seq_len=64),
+        dtype=jnp.float32)
+    params = init_params(jax.random.key(1), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def moe_model():
+    """The micro MoE test_sharded_serving.py uses (d_model=128 is the A2A
+    wire-lane floor)."""
+    cfg = MoEConfig(base=LlamaConfig(vocab_size=128, d_model=128,
+                                     n_layers=1, n_heads=4, n_kv_heads=2,
+                                     d_ff=128, max_seq_len=128,
+                                     dtype=jnp.float32),
+                    num_experts=4, topk=2, moe_d_ff=64)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def role_ctx():
+    return initialize_distributed(axis_names=("role",), mesh_shape=(2,))
+
+
+def _trace(n=N_REQUESTS):
+    """The 50-request forced-preemption trace (test_chaos idiom):
+    staggered arrivals, prompts spanning 1..2 pages, mixed budgets."""
+    rng = np.random.RandomState(77)
+    out = []
+    for i in range(n):
+        plen = int(rng.randint(3, 17))
+        mnt = int(rng.randint(2, 6))
+        out.append((2 * i, list(rng.randint(1, 128, size=plen)), mnt))
+    return out
+
+
+# ------------------------------------------------------- engine factories
+def _colocated(tiny_model, **kw):
+    cfg, params = tiny_model
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("num_pages", 12)        # tight: forces preemption
+    kw.setdefault("pages_per_seq", 6)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("prefill_buckets", None)
+    return ServingEngine(params, cfg, **kw)
+
+
+def _sharded(moe_model, tp, sp, ep, **kw):
+    cfg, params = moe_model
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("num_pages", 9)         # tight: forces preemption
+    kw.setdefault("pages_per_seq", 4)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("wire_dtype", WIRE)
+    return ShardedServingEngine(params, cfg, serving_mesh(tp, sp, ep), **kw)
+
+
+def _disagg(tiny_model, ctx, **kw):
+    cfg, params = tiny_model
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("num_prefill_slots", 2)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("pages_per_seq", 6)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("signal_deadline_steps", 3)
+    return DisaggServingEngine(params, cfg, ctx=ctx, **kw)
+
+
+# ----------------------------------------------------- crash/recover harness
+def _crash_then_recover(mk_engine, arrivals, crash_step, checkpoint_every=8):
+    """The whole crash-consistency cycle at one crash point: journaled run
+    crashes at ``crash_step`` (returns None if the trace finished first —
+    nothing to recover), then a FRESH engine of the same configuration
+    restores from the journal and serves the not-yet-journaled remainder.
+    Returns the recovered {rid: tokens} union."""
+    journal = ControlJournal()
+    eng = mk_engine(journal=journal, checkpoint_every=checkpoint_every,
+                    fault_plan=FaultPlan(seed=3, crash_at=(crash_step,)))
+    try:
+        eng.run(max_steps=MAX_STEPS, arrivals=arrivals)
+        return None                      # ran to completion — no crash
+    except InjectedCrash:
+        pass
+    # the journal is the durable artifact; everything else is rebuilt
+    done = sum(1 for e in journal.entries if e["kind"] == "submit")
+    eng2 = mk_engine(journal=journal, checkpoint_every=checkpoint_every)
+    res = eng2.run(max_steps=MAX_STEPS, arrivals=arrivals[done:],
+                   recover=True)
+    assert eng2.metrics.counters["restores"] == 1
+    return res
+
+
+def _journaled_steps(mk_engine, arrivals):
+    """Total step count of the fault-free journaled run (the sweep's
+    crash-point domain) plus its result (the golden)."""
+    journal = ControlJournal()
+    eng = mk_engine(journal=journal, checkpoint_every=8)
+    res = eng.run(max_steps=MAX_STEPS, arrivals=arrivals)
+    return eng._steps, res, journal
+
+
+# ------------------------------------------------------------ journal units
+def test_journal_round_trip(tmp_path):
+    j = ControlJournal()
+    j.append("submit", 0, 0xAB, rid=0, prompt=[1, 2], max_new_tokens=3)
+    j.append("admit", 1, 0xCD, rid=0, slot=2)
+    j.record_checkpoint(4, 0xEF, {"live": []}, journal_seq=1)
+    j.append("finish", 7, 0x11, rid=0, tokens=[5, 6, 7])
+    assert len(j) == 4 and j.last_seq == 3
+    assert [e["seq"] for e in j.suffix(1)] == [2, 3]
+    assert j.last_checkpoint_entry()["journal_seq"] == 1
+    assert j.counts() == {"submit": 1, "admit": 1, "checkpoint": 1,
+                          "finish": 1}
+    # bulky checkpoint state is elided from the post-mortem rendering
+    tail = j.format_tail(8)
+    assert "<elided>" in tail and "'live'" not in tail
+    assert "digest=0x000000ab" in tail
+    # jsonl save/load reconstitutes an equivalent journal
+    p = tmp_path / "wal.jsonl"
+    j.save(str(p))
+    j2 = ControlJournal.load(str(p))
+    assert j2.entries == j.entries
+
+
+def test_journal_rejects_unknown_kind():
+    with pytest.raises(AssertionError, match="unknown journal event"):
+        ControlJournal().append("frobnicate", 0, 0)
+
+
+def test_journal_path_mirror(tmp_path):
+    p = tmp_path / "live.jsonl"
+    j = ControlJournal(path=str(p))
+    j.append("submit", 0, 1, rid=0, prompt=[1], max_new_tokens=1)
+    j.close()
+    assert ControlJournal.load(str(p)).entries == j.entries
+
+
+def test_request_snapshot_round_trip():
+    req = Request(rid=7, prompt=(1, 2, 3), max_new_tokens=4, eos_token=9)
+    req.generated = [5, 6]
+    req.prefill_cursor = 2
+    req.preemptions = 1
+    req.retries = 2
+    back = rebuild_request(snapshot_request(req))
+    assert back.rid == 7 and back.prompt == (1, 2, 3)
+    assert back.state is RequestState.QUEUED
+    assert back.prefill_cursor == 0 and back.generated == []
+    assert back.preemptions == 1 and back.retries == 2
+
+
+def test_pool_snapshot_audit_catches_tamper():
+    pool = KVPagePool(8, 4, reserved=1)
+    pool.alloc(0, 3)
+    snap = pool.snapshot()
+    ckpt_mod.audit_pool_snapshot(snap, pool.digest(), 8, 4, 1)  # clean
+    snap["free"] = snap["free"][::-1]     # torn snapshot: free-list order
+    with pytest.raises(CheckpointIntegrityError, match="torn or tampered"):
+        ckpt_mod.audit_pool_snapshot(snap, pool.digest(), 8, 4, 1)
+
+
+def test_fault_plan_engine_tier():
+    p = FaultPlan(seed=1, crash_at=(5,), digest_skew_at=(3,))
+    assert p.crash(5, incarnation=0) and not p.crash(5, incarnation=1)
+    assert not p.crash(4, incarnation=0)
+    assert p.digest_skew(3, attempt=0) > 0
+    assert p.digest_skew(3, attempt=1) == 0   # transient: attempt 0 only
+    assert p.any_engine_faults
+    # spec parsing round-trips the engine-tier keys
+    q = FaultPlan.from_spec("seed=9,crash_at=4|7,skew=0.5")
+    assert q.crash_at == (4, 7) and q.p_digest_skew == 0.5
+    # probabilistic draws are seed-deterministic
+    assert [q.digest_skew(s) for s in range(6)] == \
+        [q.digest_skew(s) for s in range(6)]
+
+
+# --------------------------------------------------- colocated crash sweep
+def test_colocated_crash_sweep_quick(tiny_model):
+    """Strided crash points over the full 50-request trace (every step is
+    the slow-tier sweep): each crash+recover must reproduce the golden
+    bit-for-bit."""
+    arrivals = _trace()
+    mk = lambda **kw: _colocated(tiny_model, **kw)          # noqa: E731
+    total, golden, _ = _journaled_steps(mk, arrivals)
+    assert len(golden) == N_REQUESTS
+    stride = max(1, total // 8)
+    points = list(range(1, total, stride))
+    for s in points:
+        res = _crash_then_recover(mk, arrivals, s)
+        assert res is not None, f"crash at step {s} never fired"
+        assert res == golden, f"crash at step {s}: not bit-identical"
+
+
+@pytest.mark.slow
+def test_colocated_crash_sweep_dense(tiny_model):
+    arrivals = _trace()
+    mk = lambda **kw: _colocated(tiny_model, **kw)          # noqa: E731
+    total, golden, _ = _journaled_steps(mk, arrivals)
+    for s in range(1, total):
+        res = _crash_then_recover(mk, arrivals, s)
+        assert res is not None and res == golden, f"crash at step {s}"
+
+
+def test_colocated_checkpoint_cadence_sweep(tiny_model):
+    """Recovery is cadence-independent: sparse checkpoints only lengthen
+    the replay suffix, never change the outcome. cadence=None = no
+    checkpoints at all — the whole journal is the suffix."""
+    arrivals = _trace(24)
+    mk = lambda **kw: _colocated(tiny_model, **kw)          # noqa: E731
+    total, golden, _ = _journaled_steps(mk, arrivals)
+    crash = total // 2
+    for every in (2, 16, 64, None):
+        res = _crash_then_recover(mk, arrivals, crash, checkpoint_every=every)
+        assert res == golden, f"checkpoint_every={every}"
+    # dense cadence actually produced checkpoints
+    j = ControlJournal()
+    eng = mk(journal=j, checkpoint_every=2)
+    eng.run(max_steps=MAX_STEPS, arrivals=arrivals)
+    assert eng.metrics.counters["checkpoints"] >= total // 4
+    assert j.counts().get("checkpoint", 0) == eng.metrics.counters[
+        "checkpoints"]
+
+
+def test_restore_compiles_nothing(tiny_model):
+    """The compile guard (ISSUE 9 acceptance): restore is host-only —
+    the jit trace caches are untouched by restore itself, and the whole
+    recovered run still ends at exactly one decode + one chunk program."""
+    arrivals = _trace(24)
+    mk = lambda **kw: _colocated(tiny_model, **kw)          # noqa: E731
+    journal = ControlJournal()
+    eng = mk(journal=journal, checkpoint_every=8,
+             fault_plan=FaultPlan(seed=3, crash_at=(21,)))
+    with pytest.raises(InjectedCrash):
+        eng.run(max_steps=MAX_STEPS, arrivals=arrivals)
+    done = sum(1 for e in journal.entries if e["kind"] == "submit")
+    eng2 = mk(journal=journal, checkpoint_every=8)
+    assert eng2._step._cache_size() == 0
+    assert eng2._chunk_step._cache_size() == 0
+    info = ckpt_mod.restore(eng2, ckpt_mod.latest(journal), journal)
+    # restore dispatched NOTHING: both trace caches still empty
+    assert eng2._step._cache_size() == 0
+    assert eng2._chunk_step._cache_size() == 0
+    assert info["replayed"] > 0
+    res = eng2.run(max_steps=MAX_STEPS, arrivals=arrivals[done:])
+    golden = _colocated(tiny_model).run(max_steps=MAX_STEPS,
+                                        arrivals=arrivals)
+    assert res == golden
+    stats = eng2.compile_stats
+    assert stats["decode_compiles"] == 1
+    assert stats["prefill_chunk_compiles"] == 1
+
+
+def test_recover_without_checkpoint_replays_whole_journal(tiny_model):
+    """A crash before the first checkpoint cadence still recovers: the
+    journal alone (checkpoint=None path) is a complete WAL."""
+    arrivals = _trace(16)
+    mk = lambda **kw: _colocated(tiny_model, **kw)          # noqa: E731
+    _, golden, _ = _journaled_steps(mk, arrivals)
+    journal = ControlJournal()
+    eng = mk(journal=journal, checkpoint_every=1000,  # never reached
+             fault_plan=FaultPlan(seed=3, crash_at=(7,)))
+    with pytest.raises(InjectedCrash):
+        eng.run(max_steps=MAX_STEPS, arrivals=arrivals)
+    assert journal.last_checkpoint_entry() is None
+    done = sum(1 for e in journal.entries if e["kind"] == "submit")
+    eng2 = mk(journal=journal)
+    res = eng2.run(max_steps=MAX_STEPS, arrivals=arrivals[done:],
+                   recover=True)
+    assert res == golden
+
+
+# ----------------------------------------------------- sharded crash sweep
+@pytest.mark.mesh
+@pytest.mark.parametrize("tp,sp,ep,points", [
+    (1, 1, 1, 2),
+    (1, 2, 1, 2),
+    (2, 2, 1, 1),
+])
+def test_sharded_crash_recovery(moe_model, tp, sp, ep, points):
+    """Crash+recover on the mesh (n ∈ {1, 2, 4}): the restored engine
+    reproduces the n-rank golden bit-for-bit — recovery composes with the
+    cross-mesh bitwise contract instead of breaking it."""
+    arrivals = _trace(20)
+    mk = lambda **kw: _sharded(moe_model, tp, sp, ep, **kw)  # noqa: E731
+    total, golden, _ = _journaled_steps(mk, arrivals)
+    stride = max(1, total // (points + 1))
+    for s in range(stride, total, stride)[:points] or [1]:
+        res = _crash_then_recover(mk, arrivals, s)
+        assert res is not None and res == golden, \
+            f"mesh {tp}x{sp}x{ep}, crash at step {s}"
+
+
+@pytest.mark.slow
+@pytest.mark.mesh
+@pytest.mark.parametrize("tp,sp,ep,stride", [
+    (1, 1, 1, 1),
+    (1, 2, 1, 3),
+    (2, 2, 1, 6),
+])
+def test_sharded_crash_sweep_dense(moe_model, tp, sp, ep, stride):
+    arrivals = _trace()
+    mk = lambda **kw: _sharded(moe_model, tp, sp, ep, **kw)  # noqa: E731
+    total, golden, _ = _journaled_steps(mk, arrivals)
+    for s in range(1, total, stride):
+        res = _crash_then_recover(mk, arrivals, s)
+        assert res is not None and res == golden, f"crash at step {s}"
+
+
+# ----------------------------------------------- digest-divergence rung
+@pytest.mark.mesh
+def test_digest_skew_absorbed_by_restore(moe_model):
+    """A transient seeded digest divergence is QUARANTINED and absorbed:
+    exactly one digest_recovery, tokens still golden, nothing raised."""
+    arrivals = _trace(20)
+    golden = _sharded(moe_model, 1, 2, 1).run(max_steps=MAX_STEPS,
+                                              arrivals=arrivals)
+    journal = ControlJournal()
+    eng = _sharded(moe_model, 1, 2, 1, journal=journal, checkpoint_every=4,
+                   digest_every=1,
+                   fault_plan=FaultPlan(seed=5, digest_skew_at=(9,)))
+    res = eng.run(max_steps=MAX_STEPS, arrivals=arrivals)
+    c = eng.metrics.counters
+    assert c["digest_recoveries"] == 1
+    assert c["restores"] == 1
+    assert c["faults_injected"] >= 1
+    assert res == golden
+    assert journal.counts().get("digest_divergence") == 1
+    assert eng.metrics.hist["digest_recovery_s"].count == 1
+
+
+@pytest.mark.mesh
+def test_persistent_digest_skew_escalates(moe_model):
+    """Skew that re-diverges with no agreed step since the restore is
+    PERSISTENT: the rung escalates (raises) instead of looping, and the
+    report embeds the counters + journal tail post-mortem."""
+    journal = ControlJournal()
+    eng = _sharded(moe_model, 1, 2, 1, journal=journal, checkpoint_every=4,
+                   digest_every=1)
+    eng._digest_skew[1] = 1               # persistent per-rank corruption
+    with pytest.raises(ReplicatedDecisionError, match="persistent skew"):
+        eng.run(max_steps=MAX_STEPS, arrivals=_trace(8))
+    assert eng.metrics.counters["digest_recoveries"] == 1  # tried once
+    try:
+        eng2 = _sharded(moe_model, 1, 2, 1, journal=ControlJournal(),
+                        checkpoint_every=4, digest_every=1)
+        eng2._digest_skew[1] = 1
+        eng2.run(max_steps=MAX_STEPS, arrivals=_trace(8))
+    except ReplicatedDecisionError as e:
+        assert "counters" in str(e) and "journal tail" in str(e)
+
+
+@pytest.mark.mesh
+def test_digest_skew_without_journal_still_raises(moe_model):
+    """No journal = no restore rung: the pre-ISSUE-9 hard raise stands
+    (fail loud beats silently serving forked block tables)."""
+    eng = _sharded(moe_model, 1, 2, 1, digest_every=1)
+    eng._digest_skew[1] = 1
+    with pytest.raises(ReplicatedDecisionError, match="digest diverged"):
+        eng.run(max_steps=MAX_STEPS, arrivals=_trace(8))
+    assert eng.metrics.counters["digest_recoveries"] == 0
+
+
+# ------------------------------------------------------ disagg crash sweep
+@pytest.mark.disagg
+def test_disagg_crash_recovery(tiny_model, role_ctx):
+    """Crash+recover on the disaggregated engine, including a crash with
+    a migration IN FLIGHT: the restarted engine re-admits the migrated
+    request through the rebuilt ledger (re-prefill + re-migrate), never
+    fails it for having been half-handed-off."""
+    arrivals = _trace(24)
+    mk = lambda **kw: _disagg(tiny_model, role_ctx, **kw)   # noqa: E731
+    total, golden, ref = _journaled_steps(mk, arrivals)
+    # a crash point with a handoff in flight: a rid went MIGRATING at
+    # step s (journal "handoff") and only finished at some step > s + 1
+    finish_step = {e["rid"]: e["step"] for e in ref.entries
+                   if e["kind"] == "finish"}
+    midflight = [e["step"] for e in ref.entries if e["kind"] == "handoff"
+                 and finish_step.get(e["rid"], 10**9) > e["step"] + 1]
+    points = sorted({max(1, total // 3), midflight[0] if midflight
+                     else total // 2, total - 1})
+    for s in points:
+        res = _crash_then_recover(mk, arrivals, s)
+        assert res is not None and res == golden, f"crash at step {s}"
+
+
+@pytest.mark.slow
+@pytest.mark.disagg
+def test_disagg_crash_sweep_dense(tiny_model, role_ctx):
+    arrivals = _trace()
+    mk = lambda **kw: _disagg(tiny_model, role_ctx, **kw)   # noqa: E731
+    total, golden, _ = _journaled_steps(mk, arrivals)
+    for s in range(1, total):
+        res = _crash_then_recover(mk, arrivals, s)
+        assert res is not None and res == golden, f"crash at step {s}"
+
+
+@pytest.mark.disagg
+def test_disagg_journal_records_migration(tiny_model, role_ctx):
+    """The disagg journal carries the migration story: migrate attempts
+    (with chunk + page counts), handoffs, and the per-event digest over
+    BOTH workers' control planes."""
+    journal = ControlJournal()
+    eng = _disagg(tiny_model, role_ctx, journal=journal, checkpoint_every=8)
+    eng.run(max_steps=MAX_STEPS, arrivals=_trace(8))
+    counts = journal.counts()
+    assert counts["migrate"] >= counts["handoff"] >= 1
+    assert counts["finish"] == 8
+    m = next(e for e in journal.entries if e["kind"] == "migrate")
+    assert m["pages"] >= 1 and "chunk" in m and "attempt" in m
+    # pool audit: nothing leaked through the journaled run
+    assert eng.alloc_p.used_pages == 0 and eng.alloc_d.used_pages == 0
+    eng.alloc_p.check(eng.channel.ledger)
+    eng.alloc_d.check(eng.channel.ledger)
+
+
+# ------------------------------------------------------- overload terminals
+def test_queue_cap_rejects_typed(tiny_model):
+    """2x oversubscription against a bounded queue: the excess is shed
+    with typed AdmissionRejected terminals, every admitted request
+    finishes bit-identical to the uncapped golden, and the engine never
+    raises."""
+    rng = np.random.RandomState(7)
+    arrivals = [(0, list(rng.randint(1, 128, size=int(rng.randint(3, 17)))),
+                 int(rng.randint(2, 6))) for _ in range(20)]
+    mk = lambda **kw: _colocated(tiny_model, num_slots=2, num_pages=8,
+                                 **kw)                       # noqa: E731
+    golden = mk().run(max_steps=MAX_STEPS, arrivals=arrivals)
+    journal = ControlJournal()
+    eng = mk(queue_cap=4, journal=journal)
+    res = eng.run(max_steps=MAX_STEPS, arrivals=arrivals)
+    c = eng.metrics.counters
+    assert c["rejections"] > 0 and c["rejections"] == len(eng.failed)
+    assert c["requests_submitted"] == 20
+    for r in eng.failed:
+        assert r.state is RequestState.REJECTED
+        assert isinstance(r.failure, AdmissionRejected)
+        assert not isinstance(r.failure, TtlExpired)
+        assert "queue full" in str(r.failure)
+    assert len(res) + c["rejections"] == 20
+    for rid, toks in res.items():
+        assert toks == golden[rid], f"rid {rid} not bit-identical"
+    assert journal.counts()["reject"] == c["rejections"]
+
+
+def test_ttl_expires_typed(tiny_model):
+    """A slow-draining queue expires never-admitted requests past their
+    TTL with typed TtlExpired terminals; admitted requests are immune
+    (preemption requeues never expire) and finish bit-identically."""
+    rng = np.random.RandomState(7)
+    arrivals = [(0, list(rng.randint(1, 128, size=12)), 5)
+                for _ in range(8)]
+    mk = lambda **kw: _colocated(tiny_model, num_slots=1, num_pages=8,
+                                 **kw)                       # noqa: E731
+    golden = mk().run(max_steps=MAX_STEPS, arrivals=arrivals)
+    journal = ControlJournal()
+    eng = mk(ttl_steps=6, journal=journal)
+    res = eng.run(max_steps=MAX_STEPS, arrivals=arrivals)
+    c = eng.metrics.counters
+    assert c["expirations"] > 0 and c["rejections"] == 0
+    for r in eng.failed:
+        assert isinstance(r.failure, TtlExpired)
+        assert "TTL" in str(r.failure)
+    assert len(res) + c["expirations"] == 8
+    for rid, toks in res.items():
+        assert toks == golden[rid]
+    assert journal.counts()["expire"] == c["expirations"]
+
+
+def test_overload_survives_crash_recovery(tiny_model):
+    """Overload terminals are journaled state: a crash after rejections
+    restores them — the recovered engine reports the same terminal set
+    and still finishes every admitted request bit-identically."""
+    rng = np.random.RandomState(7)
+    arrivals = [(0, list(rng.randint(1, 128, size=int(rng.randint(3, 17)))),
+                 int(rng.randint(2, 6))) for _ in range(20)]
+    mk = lambda **kw: _colocated(tiny_model, num_slots=2, num_pages=8,
+                                 queue_cap=4, **kw)          # noqa: E731
+    golden_eng = mk()
+    golden = golden_eng.run(max_steps=MAX_STEPS, arrivals=arrivals)
+    golden_failed = sorted(r.rid for r in golden_eng.failed)
+    journal = ControlJournal()
+    eng = mk(journal=journal, checkpoint_every=4,
+             fault_plan=FaultPlan(seed=3, crash_at=(9,)))
+    with pytest.raises(InjectedCrash):
+        eng.run(max_steps=MAX_STEPS, arrivals=arrivals)
+    done = sum(1 for e in journal.entries
+               if e["kind"] in ("submit", "reject"))
+    eng2 = mk(journal=journal, checkpoint_every=4)
+    res = eng2.run(max_steps=MAX_STEPS, arrivals=arrivals[done:],
+                   recover=True)
+    assert res == golden
+    assert sorted(r.rid for r in eng2.failed) == golden_failed
+    for r in eng2.failed:
+        assert isinstance(r.failure, AdmissionRejected)
+
+
+# -------------------------------------------------------------- post-mortem
+def test_postmortem_embeds_journal_tail(tiny_model):
+    """Engine error reports carry the forensic record: non-zero counters
+    plus the last journal entries (bulky checkpoint payloads elided)."""
+    journal = ControlJournal()
+    eng = _colocated(tiny_model, journal=journal, checkpoint_every=4)
+    eng.run(max_steps=MAX_STEPS, arrivals=_trace(6))
+    pm = eng._postmortem()
+    assert "counters" in pm and "journal tail" in pm
+    assert "finish" in pm and "tokens_generated" in pm
+    assert "<elided>" in pm or "checkpoint" not in journal.counts()
+    # without a journal the report says so instead of crashing
+    assert "<no journal attached>" in _colocated(tiny_model)._postmortem()

@@ -13,9 +13,15 @@ EP/SP overlap ops (see docs/serving.md).
                typed per-request failure)
 - deadline   — Deadline/Backoff helpers + EngineStallError (the global
                progress watchdog both engines share)
+- journal    — append-only WAL of control-plane events (ISSUE 9)
+- checkpoint — periodic control-plane snapshot + journal-suffix replay
+               restore (crash recovery with zero new compiles)
 - metrics    — counters + histograms, JSON-lines wire format
 """
 
+from triton_dist_tpu.serving.checkpoint import (Checkpoint,
+                                                CheckpointIntegrityError,
+                                                capture, latest, restore)
 from triton_dist_tpu.serving.deadline import (Backoff, Deadline,
                                               EngineStallError)
 from triton_dist_tpu.serving.disagg import (ChunkSignalLedger,
@@ -24,12 +30,15 @@ from triton_dist_tpu.serving.disagg import (ChunkSignalLedger,
                                             PageMigrationChannel,
                                             SignalProtocolError)
 from triton_dist_tpu.serving.engine import ServingEngine
+from triton_dist_tpu.serving.journal import EVENT_KINDS, ControlJournal
 from triton_dist_tpu.serving.kv_pool import (KVPagePool, PageLedgerError,
                                              cache_to_pages,
                                              page_pool_pspec, pages_to_cache)
 from triton_dist_tpu.serving.metrics import Histogram, ServingMetrics
-from triton_dist_tpu.serving.scheduler import (ContinuousBatchingScheduler,
-                                               Request, RequestState)
+from triton_dist_tpu.serving.scheduler import (AdmissionRejected,
+                                               ContinuousBatchingScheduler,
+                                               Request, RequestState,
+                                               TtlExpired)
 from triton_dist_tpu.serving.sharded import (MESH_AXES,
                                              ReplicatedDecisionError,
                                              ShardedServingEngine,
@@ -49,6 +58,15 @@ __all__ = [
     "Deadline",
     "Backoff",
     "EngineStallError",
+    "ControlJournal",
+    "EVENT_KINDS",
+    "Checkpoint",
+    "CheckpointIntegrityError",
+    "capture",
+    "restore",
+    "latest",
+    "AdmissionRejected",
+    "TtlExpired",
     "KVPagePool",
     "PageLedgerError",
     "page_pool_pspec",

@@ -1,0 +1,193 @@
+"""Checkpoint/restore for the serving engines: snapshot + journal replay.
+
+A checkpoint is a JSON-able snapshot of the *control plane only* — scheduler
+queues, `KVPagePool` ledger, request cursors, terminal sets.  No KV bytes are
+ever persisted: the trace-determinism contract (greedy argmax decode, LIFO
+page allocation, strict-FIFO scheduling) guarantees that a request restarted
+from its prompt regenerates bit-identical tokens, so `restore()` simply
+requeues every live request at cursor 0 and lets the already-compiled chunk
+program re-prefill it.  Restore therefore compiles **zero** new programs:
+it touches host state only and reuses the engine's existing jitted
+decode/chunk executables.
+
+Restore pipeline::
+
+    checkpoint (state @ step S, journal high-water mark Q)
+        │ engine._restore_state(state)     rebuild scheduler/ledger/terminals;
+        │                                  live requests requeued at cursor 0
+        ▼
+    journal suffix (seq > Q)               replayed in order:
+        submit  -> engine.submit(...)      re-enqueue post-snapshot arrivals
+        finish  -> tokens from the entry   settle post-snapshot completions
+        reject/expire/fail -> terminals    re-settle typed terminals
+        ▼
+    engine._steps = max(S, last entry step); decode resumes
+
+The checkpoint's page-ledger snapshot is *not* used to re-own pages (pages
+are re-earned by re-prefill); it is used as an integrity audit — the ledger
+is rebuilt from the snapshot and its FNV-1a digest compared against the
+digest recorded at capture time, catching torn or tampered snapshots.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+from triton_dist_tpu.serving.journal import ControlJournal
+from triton_dist_tpu.serving.kv_pool import KVPagePool
+from triton_dist_tpu.serving.scheduler import Request, RequestState
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint's recorded ledger digest does not match its snapshot."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Checkpoint:
+    """One engine snapshot.  ``journal_seq`` is the newest journal entry the
+    snapshot already covers; restore replays only entries after it."""
+
+    step: int
+    digest: int
+    journal_seq: int
+    state: dict[str, Any]
+
+
+# ----------------------------------------------------------- request (de)ser
+def snapshot_request(req: Request) -> dict[str, Any]:
+    """JSON-able view of a live request.  Generated tokens and the prefill
+    cursor are recorded for observability but deliberately *not* restored —
+    restart-from-prompt regenerates them bit-identically."""
+    return {
+        "rid": req.rid,
+        "prompt": list(req.prompt),
+        "max_new_tokens": req.max_new_tokens,
+        "eos_token": req.eos_token,
+        "generated": list(req.generated),
+        "cursor": req.prefill_cursor,
+        "preemptions": req.preemptions,
+        "admitted_seq": req.admitted_seq,
+        "submit_step": req.submit_step,
+        "retries": req.retries,
+        "degradations": req.degradations,
+    }
+
+
+def rebuild_request(snap: dict[str, Any]) -> Request:
+    """Rebuild a snapshot as a fresh QUEUED request at cursor 0 — the
+    restart-from-prompt form that deterministic replay makes bit-identical."""
+    req = Request(
+        rid=snap["rid"],
+        prompt=tuple(snap["prompt"]),
+        max_new_tokens=snap["max_new_tokens"],
+        eos_token=snap.get("eos_token"),
+    )
+    req.state = RequestState.QUEUED
+    req.preemptions = snap.get("preemptions", 0)
+    req.submit_step = snap.get("submit_step", 0)
+    req.retries = snap.get("retries", 0)
+    req.degradations = snap.get("degradations", 0)
+    return req
+
+
+def snapshot_finished(req: Request) -> dict[str, Any]:
+    """JSON-able terminal record of a finished request: the tokens plus
+    the latency/preemption numbers the original process measured (restored
+    verbatim — a settled terminal is never re-measured)."""
+    return {
+        "rid": req.rid,
+        "prompt": list(req.prompt),
+        "tokens": list(req.generated),
+        "submit_step": req.submit_step,
+        "first_token_step": req.first_token_step,
+        "preemptions": req.preemptions,
+    }
+
+
+def audit_pool_snapshot(snap: dict[str, Any], digest: int, num_pages: int,
+                        page_size: int, reserved: int) -> None:
+    """Rebuild a ledger from its snapshot and check the recorded digest."""
+    pool = KVPagePool.from_snapshot(snap, num_pages, page_size, reserved=reserved)
+    got = pool.digest()
+    if got != (digest & 0xFFFFFFFF):
+        raise CheckpointIntegrityError(
+            f"page-ledger snapshot digest 0x{got:08x} != recorded "
+            f"0x{digest & 0xFFFFFFFF:08x} — checkpoint is torn or tampered")
+
+
+# ------------------------------------------------------------------ capture
+def capture(engine: Any) -> Checkpoint:
+    """Snapshot an engine's control plane.  Pure host work, no dispatches."""
+    journal = engine.journal
+    seq = journal.last_seq if journal is not None else -1
+    return Checkpoint(step=engine._steps, digest=engine.control_digest(),
+                      journal_seq=seq, state=engine._capture_state())
+
+
+def latest(journal: ControlJournal | None) -> Checkpoint | None:
+    """Newest checkpoint recorded in the journal, or None."""
+    if journal is None:
+        return None
+    e = journal.last_checkpoint_entry()
+    if e is None:
+        return None
+    return Checkpoint(step=e["step"], digest=e["digest"],
+                      journal_seq=e["journal_seq"], state=e["state"])
+
+
+# ------------------------------------------------------------------ restore
+def restore(engine: Any, ckpt: Checkpoint | None,
+            journal: ControlJournal | None) -> dict[str, Any]:
+    """Rebuild ``engine``'s control plane from ``ckpt`` (may be None — then
+    the whole journal is the suffix) and replay the journal suffix.
+
+    Works both in place (the crashed process recovering itself, e.g. the
+    sharded digest-divergence rung) and on a freshly constructed engine of
+    the same configuration (process restart).  Either way no new programs
+    are compiled: restore performs zero device dispatches and the engine's
+    existing jitted executables are reused when decode resumes.
+    """
+    t0 = time.perf_counter()
+    engine._journal_muted = True   # replay must not re-journal its own events
+    engine._replaying = True       # replayed submits bypass the admission cap
+    replayed = 0
+    last_step = ckpt.step if ckpt is not None else 0
+    try:
+        engine._restore_state(ckpt.state if ckpt is not None else None)
+        suffix = journal.suffix(ckpt.journal_seq if ckpt is not None else -1) \
+            if journal is not None else []
+        for e in suffix:
+            last_step = max(last_step, e["step"])
+            kind = e["kind"]
+            if kind == "submit":
+                engine.submit(tuple(e["prompt"]), e["max_new_tokens"], rid=e["rid"])
+                # re-stamp the ORIGINAL submit step (reporting only —
+                # replay-time submit() stamped the checkpoint step)
+                sched = getattr(engine, "sched_p", None) or engine.sched
+                if sched.queue and sched.queue[-1].rid == e["rid"]:
+                    sched.queue[-1].submit_step = e["step"]
+                replayed += 1
+            elif kind == "finish":
+                engine._restore_finished(e["rid"], list(e["tokens"]), meta=e)
+                replayed += 1
+            elif kind in ("reject", "expire", "fail"):
+                engine._restore_terminal(e["rid"], kind, e.get("reason", ""),
+                                         e.get("error_type"))
+                replayed += 1
+            # admit/chunk/grow/preempt/handoff/migrate/checkpoint/restore/
+            # digest_divergence entries carry no state restore needs: slot
+            # seating and page ownership are re-earned by deterministic
+            # re-admission + re-prefill.
+        engine._steps = max(engine._steps, last_step)
+    finally:
+        engine._journal_muted = False
+        engine._replaying = False
+    engine._incarnation += 1
+    engine.metrics.inc("restores")
+    engine.metrics.observe("restore_s", time.perf_counter() - t0)
+    engine._jlog("restore", replayed=replayed,
+                 from_step=ckpt.step if ckpt is not None else None)
+    return {"replayed": replayed, "resume_step": engine._steps,
+            "checkpoint_step": ckpt.step if ckpt is not None else None}

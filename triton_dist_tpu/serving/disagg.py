@@ -52,6 +52,7 @@ deployment (see docs/serving.md for the launch recipe and the
 
 from __future__ import annotations
 
+import json
 import time
 from collections import deque
 
@@ -65,14 +66,19 @@ from triton_dist_tpu.models.llama import (LlamaConfig,
                                           init_page_pool,
                                           prefill_chunk_paged)
 from triton_dist_tpu.ops.page_migrate import migrate_pages
+from triton_dist_tpu.serving import checkpoint as ckpt_mod
 from triton_dist_tpu.serving.deadline import (Backoff, Deadline,
                                               EngineStallError)
 from triton_dist_tpu.serving.engine import (mark_prefill_start,
                                             record_first_token)
-from triton_dist_tpu.serving.kv_pool import KVPagePool, PageLedgerError
+from triton_dist_tpu.serving.journal import ControlJournal
+from triton_dist_tpu.serving.kv_pool import (KVPagePool, PageLedgerError,
+                                             _fnv1a)
 from triton_dist_tpu.serving.metrics import ServingMetrics
-from triton_dist_tpu.serving.scheduler import (ContinuousBatchingScheduler,
-                                               Request, RequestState)
+from triton_dist_tpu.serving.scheduler import (AdmissionRejected,
+                                               ContinuousBatchingScheduler,
+                                               Request, RequestState,
+                                               TtlExpired)
 from triton_dist_tpu.shmem import faults
 from triton_dist_tpu.shmem.context import (ShmemContext,
                                            initialize_distributed)
@@ -415,9 +421,18 @@ class DisaggServingEngine:
                  wall_deadline_s: float | None = None,
                  fault_plan: "faults.FaultPlan | None" = None,
                  metrics: ServingMetrics | None = None,
-                 metrics_decode: ServingMetrics | None = None):
+                 metrics_decode: ServingMetrics | None = None,
+                 journal: ControlJournal | None = None,
+                 checkpoint_every: int | None = None,
+                 queue_cap: int | None = None,
+                 ttl_steps: int | None = None):
         assert prefill_chunk >= 1 and decode_horizon >= 1
         assert signal_deadline_steps >= 1 and max_retries >= 0
+        assert checkpoint_every is None or checkpoint_every >= 1
+        assert queue_cap is None or queue_cap >= 1
+        assert ttl_steps is None or ttl_steps >= 1
+        assert checkpoint_every is None or journal is not None, (
+            "checkpoint_every needs a journal to record into")
         if ctx is None:
             ctx = initialize_distributed(axis_names=(axis,), mesh_shape=(2,))
         assert ctx.axis_size(axis) == 2, (
@@ -458,8 +473,23 @@ class DisaggServingEngine:
         self.pool_v = ctx.create_symm_tensor(local, ref["v"].dtype, axis=axis)
         self.alloc_p = KVPagePool(num_pages + 1, page_size, reserved=1)
         self.alloc_d = KVPagePool(num_pages + 1, page_size, reserved=1)
-        self.sched_p = ContinuousBatchingScheduler(num_prefill_slots)
+        # the bounded admission queue (ISSUE 9) guards the PREFILL worker's
+        # intake — that is where fresh arrivals wait; preemption requeues
+        # (front=True) are exempt by scheduler construction
+        self.sched_p = ContinuousBatchingScheduler(num_prefill_slots,
+                                                   queue_cap=queue_cap)
         self.sched_d = ContinuousBatchingScheduler(num_slots)
+        # crash consistency (ISSUE 9): journal + checkpoint cadence + the
+        # overload knobs, mirroring ServingEngine's control surface
+        self.journal = journal
+        self.checkpoint_every = checkpoint_every
+        self.ttl_steps = ttl_steps
+        self._fault_plan = fault_plan
+        self._journal_muted = False
+        self._replaying = False
+        self._incarnation = 0
+        self._last_ckpt_step = -1
+        self._rejected: list[Request] = []
         self._handoff: deque[Request] = deque()   # MIGRATING, no slot yet
         self._dslot: dict[int, int] = {}          # rid -> decode slot
         self._wait_steps: dict[int, int] = {}     # rid -> signal-wait steps
@@ -564,8 +594,24 @@ class DisaggServingEngine:
         req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
                       eos_token=self.eos_id, submit_step=self._steps,
                       submit_time=time.perf_counter())
-        self.sched_p.submit(req)
         self.metrics.inc("requests_submitted")
+        # bounded admission (ISSUE 9): shed fresh arrivals at capacity —
+        # journal replay bypasses the cap (the WAL holds the authoritative
+        # accept/reject decisions)
+        if self.sched_p.at_capacity and not self._replaying:
+            req.state = RequestState.REJECTED
+            req.failure = AdmissionRejected(
+                f"admission queue full (cap {self.sched_p.queue_cap}) — "
+                f"request {rid} rejected")
+            self._rejected.append(req)
+            self.metrics.inc("rejections")
+            self._jlog("reject", rid=rid, reason=str(req.failure))
+            return rid
+        if self.ttl_steps is not None:
+            req.deadline = Deadline(self.ttl_steps, req.submit_step)
+        self.sched_p.submit(req)
+        self._jlog("submit", rid=rid, prompt=list(prompt),
+                   max_new_tokens=max_new_tokens)
         return rid
 
     # -- prefill worker ----------------------------------------------------
@@ -595,6 +641,7 @@ class DisaggServingEngine:
             got = self.alloc_d.alloc(req.rid, need - have_d)
             assert got is not None, "admissible() guaranteed the pages"
         self.sched_p.activate(slot, req)
+        self._jlog("admit", rid=req.rid, slot=slot)
         req.state = RequestState.PREFILLING
         mark_prefill_start(req, self.metrics, self._steps)
         self.metrics.inc("prefills")
@@ -620,6 +667,13 @@ class DisaggServingEngine:
         chunk_idx = start // self.prefill_chunk
         self.pool_k, self.pool_v = self.channel.send_chunk(
             req.rid, chunk_idx, src, dst, self.pool_k, self.pool_v)
+        # the migration attempt rides the journal (ISSUE 9): a restarted
+        # decode worker re-admits migrated requests through the rebuilt
+        # ledger instead of failing them — the journal records that the
+        # attempt happened, the ledger decides whether it still counts
+        self._jlog("migrate", rid=req.rid, chunk=chunk_idx,
+                   pages=len(src), attempt=self.channel._attempt.get(
+                       (req.rid, chunk_idx), 0))
 
     def _oldest_local_prefill(self) -> tuple[int, Request] | None:
         """Oldest (by admission ticket) degraded request re-prefilling
@@ -703,6 +757,7 @@ class DisaggServingEngine:
             req_p.prefill_cursor = cursor_new
             self.metrics.inc("prefill_chunks")
             self.metrics.observe("prefill_stall_s", dt)
+            self._jlog("chunk", rid=req_p.rid, cursor=cursor_new)
             try:
                 self._migrate_finalized(req_p, start, cursor_new)
             except SignalProtocolError as e:
@@ -718,6 +773,7 @@ class DisaggServingEngine:
                 self.metrics.inc("handoffs")
                 self.sched_p.remove(slot_p)
                 req_p.state = RequestState.MIGRATING
+                self._jlog("handoff", rid=req_p.rid)
                 if req_p.rid not in self._dslot:
                     self._handoff.append(req_p)
 
@@ -784,6 +840,7 @@ class DisaggServingEngine:
             req.prefill_cursor = 0
         self.sched_p.evict(slot)
         self.metrics.inc("preemptions")
+        self._jlog("preempt", rid=req.rid, slot=slot, worker="prefill")
 
     # -- decode worker -----------------------------------------------------
     def _seat_decode_slots(self) -> None:
@@ -920,6 +977,9 @@ class DisaggServingEngine:
             self.pool_k, self.pool_v = self.channel.send_chunk(
                 rid, ci, list(src_ids), list(dst_ids),
                 self.pool_k, self.pool_v)
+            self._jlog("migrate", rid=rid, chunk=ci, pages=len(src_ids),
+                       attempt=self.channel._attempt.get((rid, ci), 0),
+                       retry=True)
         req.retries += 1
         self.metrics_decode.inc("retries")
         return True
@@ -977,6 +1037,8 @@ class DisaggServingEngine:
         self._park(slot)
         self._failed.append(req)
         self.metrics_decode.inc("failed_requests")
+        self._jlog("fail", rid=rid, error_type=type(exc).__name__,
+                   reason=str(exc).splitlines()[0])
 
     def _poison(self, slot: int, req: Request, exc: Exception) -> None:
         """A protocol error surfaced while the request still sits on the
@@ -1000,6 +1062,8 @@ class DisaggServingEngine:
         self.channel.forget(rid)
         self._failed.append(req)
         self.metrics_decode.inc("failed_requests")
+        self._jlog("fail", rid=rid, error_type=type(exc).__name__,
+                   reason=str(exc).splitlines()[0])
 
     def _finish_decode(self, slot: int) -> None:
         req = self.sched_d.finish(slot)
@@ -1017,6 +1081,13 @@ class DisaggServingEngine:
         self._park(slot)
         self._finished.append(req)
         self.metrics_decode.inc("requests_finished")
+        # finished tokens ride the journal so post-checkpoint finishes
+        # survive a crash without re-running the request; the terminal
+        # metadata rides along so the restored record stays faithful
+        self._jlog("finish", rid=req.rid, tokens=list(req.generated),
+                   submit_step=req.submit_step,
+                   first_token_step=req.first_token_step,
+                   preemptions=req.preemptions)
 
     def _preempt_decode(self, slot: int) -> None:
         """Decode-side eviction loses the migrated KV with the pages: the
@@ -1044,6 +1115,7 @@ class DisaggServingEngine:
         self.sched_p.submit(req, front=True)
         self._park(slot)
         self.metrics_decode.inc("preemptions")
+        self._jlog("preempt", rid=req.rid, slot=slot, worker="decode")
 
     def _park(self, slot: int) -> None:
         self._token[slot] = 0
@@ -1058,6 +1130,27 @@ class DisaggServingEngine:
                 and all(s is None for s in self.sched_d.slots))
 
     def step(self) -> bool:
+        """One step of BOTH workers. Thin wrapper (ISSUE 9): TTL expiry
+        sweep before the iteration, checkpoint cadence after a productive
+        one — mirroring ``ServingEngine.step``."""
+        if self.ttl_steps is not None:
+            self._expire_queued()
+        progressed = self._step_impl()
+        if progressed:
+            self._maybe_checkpoint()
+        return progressed
+
+    def _expire_queued(self) -> None:
+        for req in self.sched_p.expire(self._steps):
+            req.failure = TtlExpired(
+                f"request {req.rid} queued past its TTL "
+                f"({self.ttl_steps} steps from step {req.submit_step}) "
+                "without admission")
+            self._rejected.append(req)
+            self.metrics.inc("expirations")
+            self._jlog("expire", rid=req.rid, reason=str(req.failure))
+
+    def _step_impl(self) -> bool:
         """One step of BOTH workers (single-driver SPMD: each device
         program below is entered by both roles). Returns False when fully
         idle."""
@@ -1175,10 +1268,17 @@ class DisaggServingEngine:
         return True
 
     def run(self, max_steps: int | None = None,
-            arrivals=None) -> dict[int, list[int]]:
+            arrivals=None, recover=None) -> dict[int, list[int]]:
         """Drive ``step()`` until idle (or ``max_steps``); same contract
         as ``ServingEngine.run`` — returns {rid: tokens} for FINISHED
         requests only (``failed`` exposes the casualties).
+
+        ``recover`` (ISSUE 9): truthy = restore from the journal's last
+        checkpoint + suffix replay before stepping. A decode-worker
+        restart re-admits every in-flight (including mid-migration)
+        request through the rebuilt ledger: the request re-prefills and
+        re-migrates deterministically, nothing is failed for having been
+        half-migrated at the crash.
 
         A global progress WATCHDOG (ISSUE 7) backstops the per-request
         ladder: if no externally visible progress marker moves for
@@ -1187,6 +1287,11 @@ class DisaggServingEngine:
         ``EngineStallError`` with a state dump. Chaos runs assert this
         never fires: every fault path must END somewhere (handoff,
         degradation, or typed failure), not spin."""
+        if recover:
+            assert self.journal is not None, "recover= needs a journal"
+            ck = recover if isinstance(recover, ckpt_mod.Checkpoint) \
+                else ckpt_mod.latest(self.journal)
+            ckpt_mod.restore(self, ck, self.journal)
         pending = deque(arrivals or [])
         i = 0
         marker, since = self._progress_marker(), 0
@@ -1197,13 +1302,22 @@ class DisaggServingEngine:
             if not self.step() and not pending:
                 break
             i += 1
+            plan = self._fault_plan if self._fault_plan is not None \
+                else faults.active_plan()
+            if plan is not None and plan.crash(self._steps,
+                                               self._incarnation):
+                self.metrics.inc("faults_injected")
+                raise faults.InjectedCrash(
+                    f"injected crash at step {self._steps} "
+                    f"(incarnation {self._incarnation})")
             m = self._progress_marker()
             if m != marker:
                 marker, since = m, 0
             else:
                 since += 1
                 if since >= self._stall_steps and not self.idle:
-                    raise EngineStallError(self._stall_report(since))
+                    raise EngineStallError(self._stall_report(since)
+                                           + self._postmortem())
         return {req.rid: list(req.generated) for req in self._finished}
 
     def _progress_marker(self) -> tuple:
@@ -1213,6 +1327,7 @@ class DisaggServingEngine:
         wait, they don't extend it)."""
         c, d = self.metrics.counters, self.metrics_decode.counters
         return (c["prefill_chunks"], c["pages_migrated"], c["migrate_chunks"],
+                c["restores"], c["expirations"],
                 d["tokens_generated"], d["handoffs"], d["retries"],
                 d["degradations"], d["failed_requests"], d["preemptions"],
                 len(self._finished), len(self._failed),
@@ -1236,11 +1351,214 @@ class DisaggServingEngine:
                 f"poisoned={sorted(self._poisoned)}; slots: "
                 + ("; ".join(rows) if rows else "<none>"))
 
+    # -- crash consistency (ISSUE 9) --------------------------------------
+    def control_digest(self) -> int:
+        """FNV-1a digest over BOTH workers' control planes (each role's
+        allocator + scheduler) — the per-event stamp journal entries
+        carry."""
+        return _fnv1a(0x811C9DC5, self.alloc_p.digest(),
+                      self.sched_p.digest(), self.alloc_d.digest(),
+                      self.sched_d.digest())
+
+    def _jlog(self, kind: str, **payload) -> None:
+        if self.journal is None or self._journal_muted:
+            return
+        self.journal.append(kind, self._steps, self.control_digest(),
+                            **payload)
+
+    def _maybe_checkpoint(self) -> None:
+        if (self.journal is None or not self.checkpoint_every
+                or self._steps == 0
+                or self._steps % self.checkpoint_every
+                or self._steps == self._last_ckpt_step):
+            return
+        self.checkpoint()
+
+    def checkpoint(self) -> "ckpt_mod.Checkpoint":
+        """Capture a control-plane snapshot of both workers into the
+        journal. Host-only — no device work, no KV bytes, no migration
+        state beyond the ledger audit artifact."""
+        assert self.journal is not None, "checkpoint() needs a journal"
+        t0 = time.perf_counter()
+        ck = ckpt_mod.capture(self)
+        self.journal.record_checkpoint(ck.step, ck.digest, ck.state,
+                                       ck.journal_seq)
+        self._last_ckpt_step = self._steps
+        self.metrics.inc("checkpoints")
+        self.metrics.observe("checkpoint_s", time.perf_counter() - t0)
+        return ck
+
+    def _capture_state(self) -> dict:
+        """JSON-able snapshot of BOTH workers' control planes. Live
+        requests are recorded in deterministic order — decode seats by
+        admission ticket, the handoff queue, prefill seats by ticket,
+        then the prefill queue — and every one of them restores as a
+        fresh QUEUED prefill: restart-from-prompt re-earns pages AND
+        re-migrates, so no migration state needs to survive."""
+        live: list[Request] = []
+        seen: set[int] = set()
+
+        def add(r: Request | None) -> None:
+            if r is not None and r.rid not in seen:
+                seen.add(r.rid)
+                live.append(r)
+
+        for _, r in sorted(((r.admitted_seq, r)
+                            for _, r in self.sched_d.active),
+                           key=lambda t: t[0]):
+            add(r)
+        for r in self._handoff:
+            add(r)
+        for _, r in sorted(((r.admitted_seq, r)
+                            for _, r in self.sched_p.active),
+                           key=lambda t: t[0]):
+            add(r)
+        for r in self.sched_p.queue:
+            add(r)
+        return {
+            "engine": "disagg",
+            "step": self._steps,
+            "next_rid": self._next_rid,
+            "admit_ticket_p": self.sched_p._admit_ticket,
+            "admit_ticket_d": self.sched_d._admit_ticket,
+            "pool_p": self.alloc_p.snapshot(),
+            "pool_p_digest": self.alloc_p.digest(),
+            "pool_d": self.alloc_d.snapshot(),
+            "pool_d_digest": self.alloc_d.digest(),
+            "live": [ckpt_mod.snapshot_request(r) for r in live],
+            "finished": [ckpt_mod.snapshot_finished(r)
+                         for r in self._finished],
+            "failed": [{"rid": r.rid,
+                        "error_type": type(r.failure).__name__,
+                        "reason": str(r.failure).splitlines()[0]}
+                       for r in self._failed],
+            "rejected": [{"rid": r.rid, "kind": "expire"
+                          if isinstance(r.failure, TtlExpired) else "reject",
+                          "reason": str(r.failure)} for r in self._rejected],
+            "counters": dict(self.metrics.counters),
+            "counters_decode": dict(self.metrics_decode.counters),
+        }
+
+    def _restore_state(self, state: dict | None) -> None:
+        """Rebuild both workers' host control state (None = from nothing).
+        The symmetric device pools are left untouched: every live request
+        re-prefills and RE-MIGRATES from scratch, rewriting its pages'
+        bytes before any decode read, so stale device KV is unreachable.
+        The signal ledger and the channel's attempt/delay state are
+        cleared — coverage must be re-earned by fresh signals, never
+        trusted across a restart."""
+        self.alloc_p = KVPagePool(self.alloc_p.num_pages, self.page_size,
+                                  reserved=1)
+        self.alloc_d = KVPagePool(self.alloc_d.num_pages, self.page_size,
+                                  reserved=1)
+        self.sched_p = ContinuousBatchingScheduler(
+            self.sched_p.num_slots, queue_cap=self.sched_p.queue_cap)
+        self.sched_d = ContinuousBatchingScheduler(self.num_slots)
+        self._handoff.clear()
+        self._dslot.clear()
+        self._wait_steps.clear()
+        self._recovery.clear()
+        self._poisoned.clear()
+        self._local_prefill.clear()
+        self._finished = []
+        self._failed = []
+        self._rejected = []
+        self.channel.ledger = ChunkSignalLedger()
+        self.channel._attempt.clear()
+        self.channel._delayed.clear()
+        for slot in range(self.num_slots):
+            self._park(slot)
+        self._token_dev = self._up(np.stack([self._z_row, self._token]))
+        self._pos_dev = self._up(np.stack([self._z_row, self._pos]))
+        self._bt_dev = self._up(np.stack([self._z_bt, self._bt]))
+        self._dirty = False
+        if state is None:
+            return
+        ckpt_mod.audit_pool_snapshot(
+            state["pool_p"], state["pool_p_digest"],
+            self.alloc_p.num_pages, self.page_size, 1)
+        ckpt_mod.audit_pool_snapshot(
+            state["pool_d"], state["pool_d_digest"],
+            self.alloc_d.num_pages, self.page_size, 1)
+        self._steps = state["step"]
+        self._next_rid = state["next_rid"]
+        self.sched_p._admit_ticket = state["admit_ticket_p"]
+        self.sched_d._admit_ticket = state["admit_ticket_d"]
+        for snap in state["live"]:
+            req = ckpt_mod.rebuild_request(snap)
+            req.submit_time = time.perf_counter()
+            if self.ttl_steps is not None:
+                req.deadline = Deadline(self.ttl_steps, req.submit_step)
+            self.sched_p.submit(req)
+        for f in state["finished"]:
+            self._restore_finished(f["rid"], f["tokens"], meta=f)
+        for f in state["failed"]:
+            self._restore_terminal(f["rid"], "fail", f["reason"],
+                                   f.get("error_type"))
+        for f in state["rejected"]:
+            self._restore_terminal(f["rid"], f["kind"], f["reason"])
+
+    _ERROR_TYPES = {
+        "MigrationSignalTimeout": MigrationSignalTimeout,
+        "SignalProtocolError": SignalProtocolError,
+        "AdmissionRejected": AdmissionRejected,
+        "TtlExpired": TtlExpired,
+    }
+
+    def _restore_finished(self, rid: int, tokens: list[int],
+                          meta: dict | None = None) -> None:
+        req = self._pop_queued(rid)
+        if req is None:
+            prompt = tuple((meta or {}).get("prompt", (0,)))
+            req = Request(rid=rid, prompt=prompt,
+                          max_new_tokens=len(tokens), eos_token=self.eos_id)
+        req.state = RequestState.FINISHED
+        req.generated = list(tokens)
+        for k in ("submit_step", "first_token_step", "preemptions"):
+            if meta is not None and k in meta:
+                setattr(req, k, meta[k])
+        self._finished.append(req)
+
+    def _restore_terminal(self, rid: int, kind: str, reason: str,
+                          error_type: str | None = None) -> None:
+        req = self._pop_queued(rid)
+        if req is None:
+            req = Request(rid=rid, prompt=(0,), max_new_tokens=1,
+                          eos_token=self.eos_id)
+        if kind == "fail":
+            req.state = RequestState.FAILED
+            cls = self._ERROR_TYPES.get(error_type or "", RuntimeError)
+            req.failure = cls(reason)
+            self._failed.append(req)
+        else:
+            req.state = RequestState.REJECTED
+            req.failure = (TtlExpired(reason) if kind == "expire"
+                           else AdmissionRejected(reason))
+            self._rejected.append(req)
+
+    def _pop_queued(self, rid: int) -> Request | None:
+        for r in self.sched_p.queue:
+            if r.rid == rid:
+                self.sched_p.queue.remove(r)
+                return r
+        return None
+
+    def _postmortem(self) -> str:
+        counters = {k: v for k, v in self.metrics.counters.items() if v}
+        counters_d = {k: v for k, v in self.metrics_decode.counters.items()
+                      if v}
+        tail = (self.journal.format_tail(8) if self.journal is not None
+                else "  <no journal attached>")
+        return ("\ncounters: " + json.dumps(counters)
+                + "\ncounters_decode: " + json.dumps(counters_d)
+                + "\njournal tail:\n" + tail)
+
     @property
     def failed(self) -> list[Request]:
-        """Requests the recovery ladder could not save, in failure order;
-        each carries its typed reason in ``req.failure``."""
-        return list(self._failed)
+        """Requests the recovery ladder could not save plus overload
+        terminals (REJECTED), in failure order; each carries its typed
+        reason in ``req.failure``."""
+        return list(self._failed) + list(self._rejected)
 
     # -- introspection ----------------------------------------------------
     @property

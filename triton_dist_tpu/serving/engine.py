@@ -49,6 +49,7 @@ at every horizon (tests/test_serving.py asserts both for K in {1, 4}).
 
 from __future__ import annotations
 
+import json
 import time
 from collections import deque
 
@@ -60,11 +61,17 @@ from triton_dist_tpu.models.llama import (LlamaConfig,
                                           decode_multistep_paged,
                                           init_kv_cache, init_page_pool,
                                           prefill, prefill_chunk_paged)
-from triton_dist_tpu.serving.deadline import EngineStallError
-from triton_dist_tpu.serving.kv_pool import KVPagePool, cache_to_pages
+from triton_dist_tpu.serving import checkpoint as ckpt_mod
+from triton_dist_tpu.serving.deadline import Deadline, EngineStallError
+from triton_dist_tpu.serving.journal import ControlJournal
+from triton_dist_tpu.serving.kv_pool import KVPagePool, _fnv1a, cache_to_pages
 from triton_dist_tpu.serving.metrics import ServingMetrics
-from triton_dist_tpu.serving.scheduler import (ContinuousBatchingScheduler,
-                                               Request, RequestState)
+from triton_dist_tpu.serving.scheduler import (AdmissionRejected,
+                                               ContinuousBatchingScheduler,
+                                               Request, RequestState,
+                                               TtlExpired)
+from triton_dist_tpu.shmem import faults as faults_mod
+from triton_dist_tpu.shmem.faults import InjectedCrash
 
 
 # -- role-shared bookkeeping helpers ----------------------------------------
@@ -139,10 +146,18 @@ class ServingEngine:
                  eos_id: int | None = None,
                  prefill_chunk: int | None = None,
                  stall_deadline_steps: int = 256,
-                 ffn_chunk=None, attn_io=None, linear=None):
+                 ffn_chunk=None, attn_io=None, linear=None,
+                 journal: ControlJournal | None = None,
+                 checkpoint_every: int | None = None,
+                 queue_cap: int | None = None,
+                 ttl_steps: int | None = None,
+                 fault_plan=None):
         assert decode_horizon >= 1
         assert prefill_chunk is None or prefill_chunk >= 1
         assert stall_deadline_steps >= 1
+        assert checkpoint_every is None or checkpoint_every >= 1
+        assert queue_cap is None or queue_cap >= 1
+        assert ttl_steps is None or ttl_steps >= 1
         self.params = params
         self.cfg = cfg
         self.page_size = page_size
@@ -160,10 +175,25 @@ class ServingEngine:
 
         self.pool = init_page_pool(cfg, num_pages + 1, page_size)
         self.alloc = KVPagePool(num_pages + 1, page_size, reserved=1)
-        self.sched = ContinuousBatchingScheduler(num_slots)
+        self.sched = ContinuousBatchingScheduler(num_slots,
+                                                 queue_cap=queue_cap)
         self._next_rid = 0
         self._steps = 0
         self._finished: list[Request] = []
+
+        # crash consistency (ISSUE 9): the journal is the durable
+        # artifact — a fresh engine + journal (which embeds periodic
+        # checkpoints) reconstructs bit-identical serving state. See
+        # serving/journal.py and serving/checkpoint.py.
+        self.journal = journal
+        self.checkpoint_every = checkpoint_every
+        self.ttl_steps = ttl_steps
+        self._fault_plan = fault_plan
+        self._journal_muted = False     # True while replaying (restore)
+        self._replaying = False         # replayed submits bypass the cap
+        self._incarnation = 0           # bumped per restore (crash keying)
+        self._last_ckpt_step = -1
+        self._rejected: list[Request] = []
 
         # host-side mirrors of the per-slot device state (control plane);
         # the device copies below are authoritative between dispatches
@@ -259,8 +289,25 @@ class ServingEngine:
                       eos_token=self.eos_id,
                       submit_step=self._steps,
                       submit_time=time.perf_counter())
-        self.sched.submit(req)
         self.metrics.inc("requests_submitted")
+        # bounded admission (ISSUE 9): shed fresh arrivals when the queue
+        # is at capacity — a typed terminal, never an exception into the
+        # submitter. Journal replay bypasses the cap: the journal already
+        # holds the authoritative accept/reject decisions.
+        if self.sched.at_capacity and not self._replaying:
+            req.state = RequestState.REJECTED
+            req.failure = AdmissionRejected(
+                f"admission queue full (cap {self.sched.queue_cap}) — "
+                f"request {rid} rejected")
+            self._rejected.append(req)
+            self.metrics.inc("rejections")
+            self._jlog("reject", rid=rid, reason=str(req.failure))
+            return rid
+        if self.ttl_steps is not None:
+            req.deadline = Deadline(self.ttl_steps, req.submit_step)
+        self.sched.submit(req)
+        self._jlog("submit", rid=rid, prompt=list(prompt),
+                   max_new_tokens=max_new_tokens)
         return rid
 
     # -- prefill + admission ----------------------------------------------
@@ -323,6 +370,7 @@ class ServingEngine:
         }
         tok0 = int(np.argmax(np.asarray(logits[0])))
         self.sched.activate(slot, req)
+        self._jlog("admit", rid=req.rid, slot=slot)
         req.generated.append(tok0)
         self.metrics.inc("prefills")
         self.metrics.inc("tokens_generated")
@@ -349,6 +397,7 @@ class ServingEngine:
             got = self.alloc.alloc(req.rid, n_pages - have)
             assert got is not None, "admissible() guaranteed the pages"
         self.sched.activate(slot, req)
+        self._jlog("admit", rid=req.rid, slot=slot)
         req.state = RequestState.PREFILLING
         self._mark_prefill_start(req)
         self.metrics.inc("prefills")
@@ -392,6 +441,7 @@ class ServingEngine:
         req.prefill_cursor = min(start + C, sp)
         self.metrics.inc("prefill_chunks")
         self.metrics.observe("prefill_stall_s", dt)
+        self._jlog("chunk", rid=req.rid, cursor=req.prefill_cursor)
         if req.prefill_cursor < sp:
             return len(part)
         # last chunk → the slot starts decoding this very step
@@ -415,6 +465,13 @@ class ServingEngine:
         self._park(slot)
         self._finished.append(req)
         self.metrics.inc("requests_finished")
+        # the finished tokens ride the journal so a post-checkpoint finish
+        # survives a crash without re-running the request; the terminal
+        # metadata rides along so the restored record stays faithful
+        self._jlog("finish", rid=req.rid, tokens=list(req.generated),
+                   submit_step=req.submit_step,
+                   first_token_step=req.first_token_step,
+                   preemptions=req.preemptions)
 
     def _preempt(self, slot: int) -> None:
         req = self.sched.slots[slot]
@@ -439,6 +496,7 @@ class ServingEngine:
         self.sched.evict(slot)
         self._park(slot)
         self.metrics.inc("preemptions")
+        self._jlog("preempt", rid=req.rid, slot=slot)
 
     def _park(self, slot: int) -> None:
         """Point an empty slot at the scratch page: its row writes land on
@@ -452,7 +510,33 @@ class ServingEngine:
     def step(self) -> bool:
         """Admissions (prefill) + one batched decode dispatch (up to
         ``decode_horizon`` tokens per slot). Returns False when there is
-        nothing to do (engine idle)."""
+        nothing to do (engine idle).
+
+        Thin wrapper around ``_step_impl``: the TTL expiry sweep runs
+        before the iteration (an expired request must not be admitted),
+        ``_post_step`` after a productive one (checkpoint cadence here;
+        the sharded engine chains its digest cross-check in front)."""
+        if self.ttl_steps is not None:
+            self._expire_queued()
+        progressed = self._step_impl()
+        if progressed:
+            self._post_step()
+        return progressed
+
+    def _expire_queued(self) -> None:
+        for req in self.sched.expire(self._steps):
+            req.failure = TtlExpired(
+                f"request {req.rid} queued past its TTL "
+                f"({self.ttl_steps} steps from step {req.submit_step}) "
+                "without admission")
+            self._rejected.append(req)
+            self.metrics.inc("expirations")
+            self._jlog("expire", rid=req.rid, reason=str(req.failure))
+
+    def _post_step(self) -> None:
+        self._maybe_checkpoint()
+
+    def _step_impl(self) -> bool:
         t_begin = time.perf_counter()
         if self.sched.idle:
             return False
@@ -518,6 +602,8 @@ class ServingEngine:
             if not np.array_equal(row, self._bt[slot]):
                 self._bt[slot] = row
                 self._dirty = True
+                self._jlog("grow", rid=req.rid,
+                           pages=len(self.alloc.pages_of(req.rid)))
         # a slot preempted while a LATER slot grew already has its limit
         # computed — zero it (its mirrors are parked; writes go to scratch)
         for slot in range(self.num_slots):
@@ -582,18 +668,31 @@ class ServingEngine:
         return True
 
     def run(self, max_steps: int | None = None,
-            arrivals=None) -> dict[int, list[int]]:
+            arrivals=None, recover=None) -> dict[int, list[int]]:
         """Drive ``step()`` until idle (or ``max_steps``). ``arrivals`` is
         an optional iterable of (step_index, prompt, max_new_tokens)
         sorted by step — the synthetic-trace replay hook serve_sim uses.
         Returns {rid: generated tokens} for FINISHED requests only — a
         truncated run (``max_steps`` hit) simply omits the unfinished.
 
+        ``recover`` (ISSUE 9): truthy = restore from the journal's last
+        checkpoint + suffix replay before stepping (a ``Checkpoint``
+        object restores from that specific snapshot). Requires a journal.
+        The caller feeds only not-yet-journaled arrivals — journaled
+        submissions are replayed from the WAL. Restored FINISHED requests
+        are included in the returned dict, so a recovered run returns the
+        complete trace.
+
         A progress watchdog (ISSUE 7, shared with the disagg engine)
         deadlines the whole drive loop: ``stall_deadline_steps``
         consecutive non-idle steps with no counter movement raise
         ``EngineStallError`` instead of spinning forever — the colocated
         engine has no migration ladder, so ANY stall here is a bug."""
+        if recover:
+            assert self.journal is not None, "recover= needs a journal"
+            ck = recover if isinstance(recover, ckpt_mod.Checkpoint) \
+                else ckpt_mod.latest(self.journal)
+            ckpt_mod.restore(self, ck, self.journal)
         pending = deque(arrivals or [])
         i = 0
         marker, since = self._progress_marker(), 0
@@ -604,6 +703,14 @@ class ServingEngine:
             if not self.step() and not pending:
                 break
             i += 1
+            plan = self._fault_plan if self._fault_plan is not None \
+                else faults_mod.active_plan()
+            if plan is not None and plan.crash(self._steps,
+                                               self._incarnation):
+                self.metrics.inc("faults_injected")
+                raise InjectedCrash(
+                    f"injected crash at step {self._steps} "
+                    f"(incarnation {self._incarnation})")
             m = self._progress_marker()
             if m != marker:
                 marker, since = m, 0
@@ -618,14 +725,167 @@ class ServingEngine:
                         f"engine made no progress for {since} steps "
                         f"(stall deadline {self._stall_steps}); queue="
                         f"{self.sched.queue_depth}, slots: "
-                        f"{active or '<none>'}")
+                        f"{active or '<none>'}" + self._postmortem())
         return {req.rid: list(req.generated) for req in self._finished}
 
     def _progress_marker(self) -> tuple:
         c = self.metrics.counters
         return (c["tokens_generated"], c["prefills"], c["prefill_chunks"],
                 c["preemptions"], c["requests_finished"],
-                len(self._finished))
+                c["restores"], len(self._finished))
+
+    # -- crash consistency (ISSUE 9) --------------------------------------
+    def control_digest(self) -> int:
+        """FNV-1a digest of the full host control plane (allocator +
+        scheduler) — the per-event stamp journal entries carry, and the
+        replicated-decision word the sharded engine cross-checks."""
+        return _fnv1a(0x811C9DC5, self.alloc.digest(), self.sched.digest())
+
+    def _jlog(self, kind: str, **payload) -> None:
+        """Append one control-plane event to the journal (no-op without
+        one; muted while a restore replays the journal into this engine —
+        replay must not re-journal its own effects)."""
+        if self.journal is None or self._journal_muted:
+            return
+        self.journal.append(kind, self._steps, self.control_digest(),
+                            **payload)
+
+    def _maybe_checkpoint(self) -> None:
+        if (self.journal is None or not self.checkpoint_every
+                or self._steps == 0
+                or self._steps % self.checkpoint_every
+                or self._steps == self._last_ckpt_step):
+            return
+        self.checkpoint()
+
+    def checkpoint(self) -> "ckpt_mod.Checkpoint":
+        """Capture a control-plane snapshot into the journal. Host-only
+        (no device work, no KV bytes); restore pairs it with the journal
+        suffix appended after it."""
+        assert self.journal is not None, "checkpoint() needs a journal"
+        t0 = time.perf_counter()
+        ck = ckpt_mod.capture(self)
+        self.journal.record_checkpoint(ck.step, ck.digest, ck.state,
+                                       ck.journal_seq)
+        self._last_ckpt_step = self._steps
+        self.metrics.inc("checkpoints")
+        self.metrics.observe("checkpoint_s", time.perf_counter() - t0)
+        return ck
+
+    def _capture_state(self) -> dict:
+        """JSON-able control-plane snapshot. Live requests are recorded in
+        deterministic order (seated slots by admission ticket, then the
+        queue); the page-ledger snapshot is an integrity audit artifact —
+        restore re-earns pages via re-prefill, it never trusts old
+        ownership."""
+        live = [r for _, r in sorted(
+            ((r.admitted_seq, r) for _, r in self.sched.active),
+            key=lambda t: t[0])]
+        live += list(self.sched.queue)
+        return {
+            "engine": "colocated",
+            "step": self._steps,
+            "next_rid": self._next_rid,
+            "admit_ticket": self.sched._admit_ticket,
+            "pool": self.alloc.snapshot(),
+            "pool_digest": self.alloc.digest(),
+            "live": [ckpt_mod.snapshot_request(r) for r in live],
+            "finished": [ckpt_mod.snapshot_finished(r)
+                         for r in self._finished],
+            "rejected": [{"rid": r.rid, "kind": "expire"
+                          if isinstance(r.failure, TtlExpired) else "reject",
+                          "reason": str(r.failure)} for r in self._rejected],
+            "counters": dict(self.metrics.counters),
+        }
+
+    def _restore_state(self, state: dict | None) -> None:
+        """Rebuild host control state from a snapshot (None = from
+        nothing — the whole journal is then the replay suffix). Device
+        pool arrays are left untouched: every live request restarts from
+        its prompt, and re-prefill rewrites a page's KV before any decode
+        read of it, so stale device bytes are unreachable."""
+        self.alloc = KVPagePool(self.alloc.num_pages, self.page_size,
+                                reserved=self.alloc.reserved)
+        self.sched = ContinuousBatchingScheduler(
+            self.num_slots, queue_cap=self.sched.queue_cap)
+        self._finished = []
+        self._rejected = []
+        for slot in range(self.num_slots):
+            self._park(slot)
+        self._sync_mirrors()
+        self._dirty = False
+        if state is None:
+            return
+        # integrity audit: the snapshot's ledger must digest to the value
+        # recorded at capture time (a torn snapshot fails loudly here)
+        ckpt_mod.audit_pool_snapshot(
+            state["pool"], state["pool_digest"], self.alloc.num_pages,
+            self.page_size, self.alloc.reserved)
+        self._steps = state["step"]
+        self._next_rid = state["next_rid"]
+        self.sched._admit_ticket = state["admit_ticket"]
+        for snap in state["live"]:
+            req = ckpt_mod.rebuild_request(snap)
+            req.submit_time = time.perf_counter()
+            if self.ttl_steps is not None:
+                req.deadline = Deadline(self.ttl_steps, req.submit_step)
+            self.sched.submit(req)
+        for f in state["finished"]:
+            self._restore_finished(f["rid"], f["tokens"], meta=f)
+        for f in state["rejected"]:
+            self._restore_terminal(f["rid"], f["kind"], f["reason"])
+
+    def _restore_finished(self, rid: int, tokens: list[int],
+                          meta: dict | None = None) -> None:
+        """Settle ``rid`` as FINISHED with ``tokens`` (from a snapshot or
+        a journal ``finish`` entry), removing it from the restored queue
+        if it was live at the checkpoint. ``meta`` carries the terminal
+        record's prompt/steps so the restored entry reports the same
+        ttft/preemption numbers the original process measured."""
+        req = self._pop_queued(rid)
+        if req is None:
+            prompt = tuple((meta or {}).get("prompt", (0,)))
+            req = Request(rid=rid, prompt=prompt,
+                          max_new_tokens=len(tokens), eos_token=self.eos_id)
+        req.state = RequestState.FINISHED
+        req.generated = list(tokens)
+        for k in ("submit_step", "first_token_step", "preemptions"):
+            if meta is not None and k in meta:
+                setattr(req, k, meta[k])
+        self._finished.append(req)
+
+    def _restore_terminal(self, rid: int, kind: str, reason: str,
+                          error_type: str | None = None) -> None:
+        req = self._pop_queued(rid)
+        if req is None:
+            req = Request(rid=rid, prompt=(0,), max_new_tokens=1,
+                          eos_token=self.eos_id)
+        req.state = RequestState.REJECTED
+        req.failure = (TtlExpired(reason) if kind == "expire"
+                       else AdmissionRejected(reason))
+        self._rejected.append(req)
+
+    def _pop_queued(self, rid: int) -> Request | None:
+        for r in self.sched.queue:
+            if r.rid == rid:
+                self.sched.queue.remove(r)
+                return r
+        return None
+
+    def _postmortem(self) -> str:
+        """Counters + journal tail appended to engine-level error reports
+        so a post-mortem never needs a live process."""
+        counters = {k: v for k, v in self.metrics.counters.items() if v}
+        tail = (self.journal.format_tail(8) if self.journal is not None
+                else "  <no journal attached>")
+        return ("\ncounters: " + json.dumps(counters)
+                + "\njournal tail:\n" + tail)
+
+    @property
+    def failed(self) -> list[Request]:
+        """Typed terminals that will never finish (REJECTED overload
+        terminals — the colocated engine has no other failure domain)."""
+        return list(self._rejected)
 
     # -- introspection ----------------------------------------------------
     @property

@@ -1,0 +1,166 @@
+"""Append-only control-plane journal (write-ahead log) for the serving tier.
+
+The serving engines are deterministic in the control plane: greedy argmax
+decoding, LIFO page allocation, and strict-FIFO scheduling make every
+request's tokens a pure function of ``(params, prompt)``.  That contract
+(the same one the producer/consumer signal overlap relies on for bit-exact
+results) means crash recovery never has to persist KV bytes — it only has
+to remember *which control-plane events happened*.  This module is that
+memory: a tiny append-only log of typed events, each stamped with the
+engine step index and the FNV-1a control digest of the post-event state.
+
+Event kinds written by the engines:
+
+=================  ============================================================
+``submit``         request entered the admission queue (payload: rid, prompt,
+                   max_new_tokens) — replayed verbatim on restore
+``admit``          request seated in a slot (rid, slot)
+``chunk``          prefill chunk advanced (rid, cursor)
+``grow``           page-pool growth for a decoding row (rid, pages)
+``preempt``        youngest-victim eviction (rid, slot)
+``handoff``        disagg: prefill row flipped to MIGRATING (rid)
+``migrate``        disagg: migration attempt pushed chunks over the channel
+``finish``         request finished (rid, tokens) — the tokens ride in the
+                   journal so post-checkpoint finishes survive a crash
+``reject``         typed terminal: admission queue at capacity (rid, reason)
+``expire``         typed terminal: queued past its TTL deadline (rid, reason)
+``fail``           typed terminal: recovery ladder exhausted (rid, kind, reason)
+``digest_divergence``  sharded: replicated-decision digest mismatch was
+                   quarantined before a restore
+``checkpoint``     full engine snapshot (``state`` payload + ``journal_seq``
+                   high-water mark); see :mod:`serving.checkpoint`
+``restore``        a restore completed (replayed entry count)
+=================  ============================================================
+
+Entries are plain JSON-able dicts ``{"seq", "step", "kind", "digest", ...}``
+so a journal can be persisted as JSON-lines and reloaded in a fresh process.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable
+
+EVENT_KINDS = (
+    "submit",
+    "admit",
+    "chunk",
+    "grow",
+    "preempt",
+    "handoff",
+    "migrate",
+    "finish",
+    "reject",
+    "expire",
+    "fail",
+    "digest_divergence",
+    "checkpoint",
+    "restore",
+)
+
+# Payload keys elided from one-line renderings (bulky checkpoint state).
+_BULKY_KEYS = ("state",)
+
+
+class ControlJournal:
+    """Append-only WAL of control-plane events.
+
+    The journal is the durable artifact of a serving process: a fresh engine
+    plus the journal (which embeds periodic checkpoints) reconstructs
+    bit-identical serving results.  ``path`` optionally mirrors every entry
+    to a JSON-lines file as it is appended.
+    """
+
+    def __init__(self, path: str | None = None):
+        self._entries: list[dict[str, Any]] = []
+        self.path = path
+        self._fh = open(path, "a", encoding="utf-8") if path else None
+
+    # ------------------------------------------------------------- append
+    def append(self, kind: str, step: int, digest: int, **payload: Any) -> dict[str, Any]:
+        assert kind in EVENT_KINDS, f"unknown journal event kind {kind!r}"
+        entry = {"seq": len(self._entries), "step": int(step), "kind": kind,
+                 "digest": int(digest), **payload}
+        self._entries.append(entry)
+        if self._fh is not None:
+            self._fh.write(json.dumps(entry) + "\n")
+            self._fh.flush()
+        return entry
+
+    def record_checkpoint(self, step: int, digest: int, state: dict,
+                          journal_seq: int) -> dict[str, Any]:
+        """Append a checkpoint entry.  ``journal_seq`` is the seq of the last
+        entry the snapshot already covers; restore replays only entries with
+        ``seq > journal_seq``."""
+        return self.append("checkpoint", step, digest, state=state,
+                           journal_seq=int(journal_seq))
+
+    # -------------------------------------------------------------- reads
+    @property
+    def entries(self) -> list[dict[str, Any]]:
+        return self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def last_seq(self) -> int:
+        """Seq of the newest entry, or -1 for an empty journal."""
+        return self._entries[-1]["seq"] if self._entries else -1
+
+    def suffix(self, after_seq: int) -> Iterable[dict[str, Any]]:
+        """Entries with ``seq > after_seq``, oldest first."""
+        return [e for e in self._entries if e["seq"] > after_seq]
+
+    def last_checkpoint_entry(self) -> dict[str, Any] | None:
+        """Newest ``checkpoint`` entry, or None if never checkpointed."""
+        for e in reversed(self._entries):
+            if e["kind"] == "checkpoint":
+                return e
+        return None
+
+    def counts(self) -> dict[str, int]:
+        """Event-kind histogram (cheap integrity/debug summary)."""
+        out: dict[str, int] = {}
+        for e in self._entries:
+            out[e["kind"]] = out.get(e["kind"], 0) + 1
+        return out
+
+    # -------------------------------------------------------- post-mortem
+    def tail(self, n: int = 8) -> list[dict[str, Any]]:
+        return self._entries[-n:]
+
+    def format_tail(self, n: int = 8) -> str:
+        """Human-readable last-``n`` entries for embedding in error reports,
+        bulky payloads elided — a post-mortem never needs a live process."""
+        lines = []
+        for e in self.tail(n):
+            extra = {k: v for k, v in e.items()
+                     if k not in ("seq", "step", "kind", "digest") + _BULKY_KEYS}
+            if "state" in e:
+                extra["state"] = "<elided>"
+            lines.append(f"  #{e['seq']} step={e['step']} {e['kind']}"
+                         f" digest=0x{e['digest'] & 0xFFFFFFFF:08x}"
+                         + (f" {extra}" if extra else ""))
+        return "\n".join(lines) if lines else "  <empty journal>"
+
+    # -------------------------------------------------------- persistence
+    def save(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as fh:
+            for e in self._entries:
+                fh.write(json.dumps(e) + "\n")
+
+    @classmethod
+    def load(cls, path: str) -> "ControlJournal":
+        j = cls()
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    j._entries.append(json.loads(line))
+        return j
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

@@ -122,6 +122,29 @@ class KVPagePool:
             h = _fnv1a(h, hash(sid) & 0xFFFFFFFF, len(pages), *pages)
         return h
 
+    # -- checkpointing (ISSUE 9) ------------------------------------------
+    def snapshot(self) -> dict:
+        """JSON-able snapshot of the ledger: free-list order and ownership
+        map in insertion order (both order-sensitive — they round-trip the
+        digest exactly). Used by serving/checkpoint.py, which rebuilds a
+        pool from the snapshot and audits ``digest()`` against the value
+        recorded at capture time (a torn snapshot fails loudly instead of
+        silently double-owning pages after a restore)."""
+        return {"free": list(self._free),
+                "owned": [[sid, list(pages)] for sid, pages in self._owned.items()]}
+
+    @classmethod
+    def from_snapshot(cls, snap: dict, num_pages: int, page_size: int,
+                      reserved: int = 0) -> "KVPagePool":
+        """Rebuild a ledger from ``snapshot()`` output (geometry is not in
+        the snapshot — it comes from the engine's own configuration, which
+        a restore never changes)."""
+        pool = cls(num_pages, page_size, reserved)
+        pool._free = [int(p) for p in snap["free"]]
+        pool._owned = {sid: [int(p) for p in pages]
+                       for sid, pages in snap["owned"]}
+        return pool
+
     # -- allocation -------------------------------------------------------
     def alloc(self, seq_id, n_pages: int) -> list[int] | None:
         """Grow ``seq_id`` by ``n_pages``; all-or-nothing. Returns the new

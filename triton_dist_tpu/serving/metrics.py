@@ -121,6 +121,17 @@ class ServingMetrics:
             "failed_requests": 0,
             "stale_signals": 0,
             "faults_injected": 0,
+            # crash consistency (ISSUE 9): control-plane checkpoints
+            # captured into the journal, restores completed (from a
+            # checkpoint + journal-suffix replay), digest divergences
+            # absorbed by the sharded restore rung instead of raised,
+            # and the overload terminals — submits rejected at a full
+            # bounded queue, queued requests expired past their TTL
+            "checkpoints": 0,
+            "restores": 0,
+            "digest_recoveries": 0,
+            "rejections": 0,
+            "expirations": 0,
         }
         self.hist = {
             "ttft_s": Histogram(),
@@ -163,6 +174,13 @@ class ServingMetrics:
             "recovered_ttft_s": Histogram(),
             "degraded_ttft_s": Histogram(),
             "degraded_prefill_tokens": Histogram(),
+            # crash consistency (ISSUE 9): wall time per checkpoint
+            # capture, per restore (snapshot rebuild + journal-suffix
+            # replay — host-only, zero dispatches), and per absorbed
+            # digest divergence (the sharded restore rung end-to-end)
+            "checkpoint_s": Histogram(),
+            "restore_s": Histogram(),
+            "digest_recovery_s": Histogram(),
         }
         self._t0 = time.perf_counter()
 

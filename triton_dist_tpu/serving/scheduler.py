@@ -30,6 +30,24 @@ import dataclasses
 import enum
 from collections import deque
 
+from triton_dist_tpu.serving.deadline import Deadline
+
+
+class AdmissionRejected(RuntimeError):
+    """Typed overload terminal (ISSUE 9): the bounded admission queue was
+    at capacity when the request arrived. The request never held a slot or
+    a page — rejecting it is free and keeps queue wait bounded, which the
+    TTL below turns into a hard latency contract."""
+
+
+class TtlExpired(AdmissionRejected):
+    """Typed overload terminal (ISSUE 9): the request sat in the admission
+    queue past its ``Deadline`` without ever being admitted. Only
+    never-admitted requests expire — once a request is admitted it is
+    carried to completion (possibly through preemptions), so 'every
+    admitted request finishes bit-identically' stays an invariant under
+    overload."""
+
 
 class RequestState(enum.Enum):
     QUEUED = "queued"
@@ -47,6 +65,12 @@ class RequestState(enum.Enum):
     # (with the ledger dump), and the engine keeps serving everyone else —
     # a failed request never takes the engine down with it.
     FAILED = "failed"
+    # overload terminal (ISSUE 9): rejected at submit (bounded admission
+    # queue at capacity) or expired in the queue past its TTL deadline —
+    # the request never held a slot or a page. ``failure`` carries the
+    # typed AdmissionRejected/TtlExpired reason. Appended AFTER the
+    # pre-existing states so their digest indices are unchanged.
+    REJECTED = "rejected"
 
 
 @dataclasses.dataclass
@@ -89,6 +113,10 @@ class Request:
     retries: int = 0
     degradations: int = 0
     failure: Exception | None = None
+    # bounded-queue TTL (ISSUE 9): armed by the engine at submit when
+    # ``ttl_steps`` is configured; ``expire()`` sweeps never-admitted
+    # queued requests whose deadline has passed. None = no TTL.
+    deadline: Deadline | None = None
 
     @property
     def kv_len(self) -> int:
@@ -116,9 +144,10 @@ class ContinuousBatchingScheduler:
     then ``pick_victim()`` whenever growth fails, then ``finish()`` as
     slots complete."""
 
-    def __init__(self, num_slots: int):
+    def __init__(self, num_slots: int, queue_cap: int | None = None):
         assert num_slots >= 1
         self.num_slots = num_slots
+        self.queue_cap = queue_cap
         self.queue: deque[Request] = deque()
         self.slots: list[Request | None] = [None] * num_slots
         self._admit_ticket = 0
@@ -126,6 +155,29 @@ class ContinuousBatchingScheduler:
     # -- queue ------------------------------------------------------------
     def submit(self, req: Request, front: bool = False) -> None:
         (self.queue.appendleft if front else self.queue.append)(req)
+
+    # -- bounded admission (ISSUE 9) --------------------------------------
+    @property
+    def at_capacity(self) -> bool:
+        """True when a NEW submission must be rejected. Preemption requeues
+        (``front=True``) are exempt — an admitted request always keeps its
+        place in line, only fresh arrivals are shed."""
+        return self.queue_cap is not None and len(self.queue) >= self.queue_cap
+
+    def expire(self, now: int) -> list[Request]:
+        """Sweep never-admitted queued requests whose TTL ``Deadline`` has
+        passed at step ``now``. Expired requests are removed from the queue
+        and flipped to REJECTED; the engine attaches the typed failure and
+        counts them. Requests that have ever been admitted
+        (``admitted_seq >= 0``, i.e. preemption requeues) never expire —
+        their work is carried to completion."""
+        expired = [r for r in self.queue
+                   if r.admitted_seq < 0 and r.deadline is not None
+                   and r.deadline.expired(now)]
+        for r in expired:
+            self.queue.remove(r)
+            r.state = RequestState.REJECTED
+        return expired
 
     def digest(self) -> int:
         """Order-sensitive 32-bit FNV-1a digest of the WHOLE scheduling
@@ -256,4 +308,5 @@ class ContinuousBatchingScheduler:
         return req
 
 
-__all__ = ["Request", "RequestState", "ContinuousBatchingScheduler"]
+__all__ = ["Request", "RequestState", "ContinuousBatchingScheduler",
+           "AdmissionRejected", "TtlExpired"]

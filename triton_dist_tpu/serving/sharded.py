@@ -38,6 +38,8 @@ which is also why ``gemm_rs`` is refused here (docs/serving.md).
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -48,9 +50,12 @@ from triton_dist_tpu.layers.ep_a2a_layer import EPAll2AllLayer
 from triton_dist_tpu.models.moe import MoEConfig, moe_mlp_ep_overlap
 from triton_dist_tpu.ops.allgather_gemm import GemmConfig, tp_column_linear
 from triton_dist_tpu.ops.flash_decode import sp_paged_attend_write
+from triton_dist_tpu.serving import checkpoint as ckpt_mod
 from triton_dist_tpu.serving.engine import ServingEngine
-from triton_dist_tpu.serving.kv_pool import _fnv1a, page_pool_pspec
+from triton_dist_tpu.serving.journal import ControlJournal
+from triton_dist_tpu.serving.kv_pool import page_pool_pspec
 from triton_dist_tpu.serving.metrics import ServingMetrics
+from triton_dist_tpu.shmem import faults as faults_mod
 from triton_dist_tpu.shmem.context import ShmemContext, initialize_distributed
 
 MESH_AXES = ("tp", "sp", "ep")
@@ -112,7 +117,12 @@ class ShardedServingEngine(ServingEngine):
                  stall_deadline_steps: int = 256,
                  wire_dtype: str | None = "auto", tp_impl: str = "xla",
                  tp_cfg: GemmConfig | None = None, moe_block_m: int = 128,
-                 digest_every: int = 1):
+                 digest_every: int = 1,
+                 journal: ControlJournal | None = None,
+                 checkpoint_every: int | None = None,
+                 queue_cap: int | None = None,
+                 ttl_steps: int | None = None,
+                 fault_plan=None):
         for ax in MESH_AXES:
             assert ax in ctx.axis_names, (
                 f"mesh is missing axis {ax!r} — build it with "
@@ -180,7 +190,10 @@ class ShardedServingEngine(ServingEngine):
                          max_prefills_per_step=max_prefills_per_step,
                          metrics=metrics, decode_horizon=decode_horizon,
                          eos_id=eos_id, prefill_chunk=prefill_chunk,
-                         stall_deadline_steps=stall_deadline_steps)
+                         stall_deadline_steps=stall_deadline_steps,
+                         journal=journal, checkpoint_every=checkpoint_every,
+                         queue_cap=queue_cap, ttl_steps=ttl_steps,
+                         fault_plan=fault_plan)
 
         # shard the pool arrays over SP on the page dim, padding the page
         # count up to a multiple of |sp|. The ALLOCATOR never learns about
@@ -208,6 +221,15 @@ class ShardedServingEngine(ServingEngine):
         self.digest_every = digest_every
         self.n_ranks = ctx.num_ranks
         self._digest_skew = np.zeros(self.n_ranks, np.uint32)
+        # digest-divergence recovery rung (ISSUE 9): per-step count of
+        # divergences already recovered (keys FaultPlan.digest_skew's
+        # ``attempt`` so a scheduled transient fires exactly once), plus
+        # the escalation latch — a second divergence with ZERO clean
+        # checks since the last restore means the skew is persistent and
+        # the rung must escalate, not loop.
+        self._digest_attempts: dict[int, int] = {}
+        self._recovered_once = False
+        self._checks_since_recovery = 0
 
         def gather_cmp(v):                       # v [1] int32, my digest
             g = v
@@ -227,18 +249,32 @@ class ShardedServingEngine(ServingEngine):
                                       self._rep_sharding)
 
     # -- replicated-decision guard ----------------------------------------
-    def control_digest(self) -> int:
-        """One 32-bit word summarizing every control-plane decision so far
-        (allocator ledger ⊕ scheduler state, both order-sensitive)."""
-        return _fnv1a(0x811C9DC5, self.alloc.digest(), self.sched.digest())
+    # ``control_digest`` lives on the base engine now (ISSUE 9: journal
+    # entries on every engine carry it); this class adds the cross-rank
+    # comparison and the recovery rung on top.
 
     def check_replicated_decisions(self) -> None:
         """Cross-rank digest assertion (satellite 1): all-gather each
         rank's control digest over the full mesh and compare to rank 0's.
-        Raises ``ReplicatedDecisionError`` on divergence."""
+        Raises ``ReplicatedDecisionError`` on divergence.
+
+        Divergence sources: the ``_digest_skew`` per-rank array (the
+        direct test hook) and — ISSUE 9 — an active ``FaultPlan``'s
+        ``digest_skew`` schedule, which corrupts one keyed rank's word at
+        scheduled/probabilistic steps so seeds can drive the restore rung.
+        """
         h = self.control_digest()
-        vals = (np.full(self.n_ranks, h, np.uint32)
-                + self._digest_skew).view(np.int32)
+        vals = np.full(self.n_ranks, h, np.uint32) + self._digest_skew
+        plan = self._fault_plan if self._fault_plan is not None \
+            else faults_mod.active_plan()
+        if plan is not None and self.n_ranks > 1:
+            w = plan.digest_skew(self._steps,
+                                 self._digest_attempts.get(self._steps, 0))
+            if w:
+                vals[plan.skew_rank(self._steps, self.n_ranks)] += \
+                    np.uint32(w)
+                self.metrics.inc("faults_injected")
+        vals = vals.view(np.int32)
         mismatch = np.asarray(self._digest_check(jnp.asarray(vals)))
         self.metrics.inc("digest_checks")
         if mismatch.any():
@@ -249,14 +285,48 @@ class ShardedServingEngine(ServingEngine):
                 f"disagree with rank 0 (digest 0x{h:08x}, mesh "
                 f"{self.mesh_desc}). A replicated-decision input leaked "
                 "rank-dependent state — block tables are no longer "
-                "trustworthy.")
+                "trustworthy." + self._postmortem())
 
-    def step(self) -> bool:
-        progressed = super().step()
-        if progressed and self.digest_every \
-                and self._steps % self.digest_every == 0:
-            self.check_replicated_decisions()
-        return progressed
+    def _post_step(self) -> None:
+        """Digest cross-check first (same cadence the pre-ISSUE-9 ``step``
+        override ran it on), then the base checkpoint cadence — so a
+        checkpoint is only ever captured at a step whose digest all ranks
+        just agreed on."""
+        if self.digest_every and self._steps % self.digest_every == 0:
+            try:
+                self.check_replicated_decisions()
+            except ReplicatedDecisionError as err:
+                self._recover_divergence(err)
+                return          # quarantined step: no checkpoint here
+            self._checks_since_recovery += 1
+        super()._post_step()
+
+    def _recover_divergence(self, err: ReplicatedDecisionError) -> None:
+        """The top recovery rung (ISSUE 9 tentpole): quarantine the
+        diverged step in the journal, restore every rank's control plane
+        from the last agreed checkpoint + journal replay, and keep
+        serving. Escalates (re-raises) when there is no journal to
+        restore from, or on REPEAT divergence — a second trip with zero
+        clean checks since the last restore means the skew is persistent,
+        and looping restores would never converge."""
+        if self.journal is None:
+            raise err
+        if self._recovered_once and self._checks_since_recovery == 0:
+            raise ReplicatedDecisionError(
+                "repeat digest divergence with no agreed step since the "
+                "last restore — persistent skew, escalating instead of "
+                "looping the restore rung.\nfirst divergence:\n"
+                + str(err)) from err
+        step = self._steps
+        self._digest_attempts[step] = self._digest_attempts.get(step, 0) + 1
+        self._jlog("digest_divergence",
+                   error=str(err).splitlines()[0])
+        self._recovered_once = True
+        self._checks_since_recovery = 0
+        self.metrics.inc("digest_recoveries")
+        t0 = time.perf_counter()
+        ckpt_mod.restore(self, ckpt_mod.latest(self.journal), self.journal)
+        self.metrics.observe("digest_recovery_s", time.perf_counter() - t0)
 
 
 __all__ = ["ShardedServingEngine", "ReplicatedDecisionError",

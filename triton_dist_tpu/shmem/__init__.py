@@ -6,6 +6,7 @@ from triton_dist_tpu.shmem.context import (  # noqa: F401
 from triton_dist_tpu.shmem import device  # noqa: F401
 from triton_dist_tpu.shmem.faults import (  # noqa: F401
     FaultPlan,
+    InjectedCrash,
     active_plan,
     use_plan,
 )

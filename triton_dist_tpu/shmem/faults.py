@@ -51,6 +51,16 @@ _ACTIVE: "FaultPlan | None" = None
 _ENV_CACHE: tuple[str, "FaultPlan | None"] | None = None
 
 
+class InjectedCrash(RuntimeError):
+    """An engine-tier ``crash`` fault fired (ISSUE 9): the serving process
+    is presumed dead at this step. Everything host-side is lost except the
+    control-plane journal — the recovery harness (tests,
+    ``serve_sim.py --recover``) builds a fresh engine and restores it from
+    the journal's last checkpoint + suffix replay. Raised from
+    ``engine.run`` AFTER the step's journal entries were appended, so the
+    WAL semantics are honest: an event is durable iff it was journaled."""
+
+
 def _uniform(seed: int, *key) -> float:
     """Deterministic uniform in [0, 1) keyed by the event identity.
 
@@ -94,6 +104,20 @@ class FaultPlan:
     max_delay_steps: int = 8
     dead_peer_after: int | None = None
     rids: tuple[int, ...] | None = None
+    # engine tier (ISSUE 9): process crashes and replicated-digest skew.
+    # ``crash_at`` kills the engine at exactly these steps — but only in
+    # incarnation 0 (the original process), so the restored engine does
+    # not re-crash at the same step forever; ``p_crash`` is the keyed-hash
+    # probabilistic form, rolled per (step, incarnation) so a restarted
+    # engine re-rolls its own fate. ``digest_skew_at`` / ``p_digest_skew``
+    # corrupt ONE rank's control digest before the sharded engine's
+    # cross-check — ``digest_skew_at`` only on attempt 0 (a transient
+    # divergence the restore rung must absorb), ``p_digest_skew`` re-rolls
+    # per (step, attempt).
+    crash_at: tuple[int, ...] | None = None
+    p_crash: float = 0.0
+    digest_skew_at: tuple[int, ...] | None = None
+    p_digest_skew: float = 0.0
     # device tier (trace-time)
     device_put_delay: int = 0
     device_drop_signals: bool = False
@@ -130,6 +154,36 @@ class FaultPlan:
             return ("delay", k)
         return ("ok", 0)
 
+    # -- engine tier (ISSUE 9) ---------------------------------------------
+    def crash(self, step: int, incarnation: int = 0) -> bool:
+        """Should the engine process die at ``step``? ``crash_at`` fires
+        only in incarnation 0; ``p_crash`` is keyed by (step, incarnation)
+        so every restart re-rolls independently."""
+        if (self.crash_at is not None and incarnation == 0
+                and step in self.crash_at):
+            return True
+        return bool(self.p_crash) and _uniform(
+            self.seed, "crash", step, incarnation) < self.p_crash
+
+    def digest_skew(self, step: int, attempt: int = 0) -> int:
+        """Non-zero word to add to one rank's control digest at ``step``
+        (0 = no skew). ``attempt`` counts divergences already recovered at
+        this step: the scheduled ``digest_skew_at`` form fires only on
+        attempt 0 (transient — the restore rung must absorb it), the
+        probabilistic form re-rolls per attempt."""
+        if (self.digest_skew_at is not None and attempt == 0
+                and step in self.digest_skew_at):
+            return 1 + int(_uniform(self.seed, "skew_v", step) * 0xFFFF)
+        if self.p_digest_skew and _uniform(
+                self.seed, "digest_skew", step, attempt) < self.p_digest_skew:
+            return 1 + int(_uniform(self.seed, "skew_v", step, attempt)
+                           * 0xFFFF)
+        return 0
+
+    def skew_rank(self, step: int, n_ranks: int) -> int:
+        """Which rank's digest the skew lands on (keyed, deterministic)."""
+        return int(_uniform(self.seed, "skew_rank", step) * n_ranks)
+
     # -- device tier -------------------------------------------------------
     def device_signal_inc(self, inc):
         """What ``signal_op`` should emit under this plan: ``None`` to
@@ -147,14 +201,23 @@ class FaultPlan:
         return (self.p_drop > 0 or self.p_dup > 0 or self.p_delay > 0
                 or self.dead_peer_after is not None)
 
+    @property
+    def any_engine_faults(self) -> bool:
+        return (self.crash_at is not None or self.p_crash > 0
+                or self.digest_skew_at is not None or self.p_digest_skew > 0)
+
     def describe(self) -> str:
         on = [f"seed={self.seed}"]
-        for k in ("p_drop", "p_dup", "p_delay"):
+        for k in ("p_drop", "p_dup", "p_delay", "p_crash", "p_digest_skew"):
             v = getattr(self, k)
             if v:
                 on.append(f"{k}={v}")
         if self.dead_peer_after is not None:
             on.append(f"dead_peer_after={self.dead_peer_after}")
+        if self.crash_at is not None:
+            on.append(f"crash_at={list(self.crash_at)}")
+        if self.digest_skew_at is not None:
+            on.append(f"digest_skew_at={list(self.digest_skew_at)}")
         if self.rids is not None:
             on.append(f"rids={list(self.rids)}")
         for k in ("device_put_delay", "device_drop_signals",
@@ -181,6 +244,8 @@ class FaultPlan:
                 "dup": ("p_dup", float), "delay": ("p_delay", float),
                 "delay_max": ("max_delay_steps", int),
                 "dead": ("dead_peer_after", int),
+                "crash": ("p_crash", float),
+                "skew": ("p_digest_skew", float),
                 "put_delay": ("device_put_delay", int)}
         kw = {}
         for part in spec.split(","):
@@ -188,6 +253,10 @@ class FaultPlan:
             k = k.strip()
             if k == "rids":
                 kw["rids"] = tuple(int(r) for r in v.split("|"))
+            elif k == "crash_at":
+                kw["crash_at"] = tuple(int(s) for s in v.split("|"))
+            elif k == "skew_at":
+                kw["digest_skew_at"] = tuple(int(s) for s in v.split("|"))
             elif k in keys:
                 name, cast = keys[k]
                 kw[name] = cast(v)
@@ -232,4 +301,5 @@ def active_plan() -> FaultPlan | None:
     return _ENV_CACHE[1]
 
 
-__all__ = ["FaultPlan", "activate", "use_plan", "active_plan"]
+__all__ = ["FaultPlan", "InjectedCrash", "activate", "use_plan",
+           "active_plan"]
