@@ -1558,6 +1558,36 @@ MODEL_SHAPES = {
 }
 
 
+def bench_sigcheck() -> dict:
+    """Static verifier throughput: one full-registry ``scripts/sigcheck.py``
+    sweep in a CPU subprocess (the capture layer monkeypatches global jax
+    surfaces — it must never share a process with live-chip benchmarks),
+    amortized per checked op. Tracks the wall cost of the dryrun gate's
+    rung 0 so a registry growth or capture slowdown shows up on the
+    scoreboard; also re-asserts zero findings on the shipping registry."""
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "sigcheck.py")
+    proc = subprocess.run(
+        [sys.executable, script, "--all", "--quiet"],
+        capture_output=True, text=True, timeout=580,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    if proc.returncode != 0:
+        raise RuntimeError(f"sigcheck rc={proc.returncode}: "
+                           f"{proc.stderr[-300:]}")
+    doc = json.loads(proc.stdout)
+    checked = sum(1 for r in doc["ops"].values() if not r.get("skipped"))
+    return {
+        "sigcheck_us_per_op": round(doc["elapsed_s"] * 1e6
+                                    / max(checked, 1), 1),
+        "sigcheck_ops_checked": checked,
+        "sigcheck_findings": doc["n_findings"],
+    }
+
+
 def sweep():
     """Per-model-family AG-GEMM sweep at the reference's perf shapes; one
     JSON line per shape (informational — the driver parses main()'s single
@@ -1896,6 +1926,13 @@ def main(a2a_primary: bool = False):
             extras.update(bench_small_ag(ctx, i1=10, i2=1610))
 
     attempt("small_ag", _small_ag)
+
+    def _sigcheck():
+        # static-verifier throughput (rung 0 of the validation ladder);
+        # CPU subprocess, so the row rides along on chip runs too
+        extras.update(bench_sigcheck())
+
+    attempt("sigcheck", _sigcheck)
 
     if artifact:
         # three impossible readings in a row: report, but flagged so no

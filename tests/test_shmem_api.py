@@ -154,3 +154,100 @@ def test_subaxis_barrier_then_signal(barrier_axes):
     got = np.asarray(jax.jit(ctx.shard_map(
         f, in_specs=(), out_specs=P(axes)))())
     np.testing.assert_array_equal(got, np.ones(6, np.int32))
+
+
+def test_signal_read_after_partial_consume():
+    """signal_read is NON-destructive and sees the residue of a partially
+    consumed count: accumulate 3, wait 2 (TPU waits consume), read -> 1,
+    read again -> still 1, then drain the last arrival so the physical
+    register leaves the kernel clean."""
+    ctx = initialize_distributed(axis_names=("x",), mesh_shape=(TEST_WORLD,))
+
+    def kernel(out_ref, sig):
+        shd.signal_op(sig, 3)           # self-signal: deterministic count
+        shd.signal_wait_until(sig, 2)   # consumes 2 of the 3
+        out_ref[0] = shd.signal_read(sig)
+        out_ref[1] = shd.signal_read(sig)   # non-destructive: unchanged
+        shd.signal_wait_until(sig, 1)   # drain the residue
+        out_ref[2] = shd.signal_read(sig)
+
+    def f():
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((3,), jnp.int32),
+            out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+            scratch_shapes=[pltpu.SemaphoreType.REGULAR],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=collective_id_for("shmem_api_sigread")),
+            interpret=default_interpret(),
+        )()[None]
+
+    got = np.asarray(jax.jit(ctx.shard_map(
+        f, in_specs=(), out_specs=P("x")))())
+    np.testing.assert_array_equal(
+        got, np.tile(np.array([1, 1, 0], np.int32), (TEST_WORLD, 1)))
+
+
+def test_quiet_with_zero_rdmas():
+    """``quiet()`` with nothing outstanding is a legal no-op — protocols
+    built over a dynamic rdma list hit the empty case whenever a rank has
+    no remote peers (n=1 subgroup, self-only slice)."""
+    ctx = initialize_distributed(axis_names=("x",), mesh_shape=(TEST_WORLD,))
+
+    def kernel(out_ref):
+        shd.quiet()                     # zero descriptors: must not block
+        shd.fence()                     # ordering no-op rides along
+        out_ref[0] = 1
+
+    def f():
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((1,), jnp.int32),
+            out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+            compiler_params=pltpu.CompilerParams(has_side_effects=True),
+            interpret=default_interpret(),
+        )()
+
+    got = np.asarray(jax.jit(ctx.shard_map(
+        f, in_specs=(), out_specs=P("x")))())
+    np.testing.assert_array_equal(got, np.ones(TEST_WORLD, np.int32))
+
+
+def test_barrier_pair_reentry():
+    """Back-to-back ``barrier_pair`` on the same physical barrier register:
+    each crossing must consume exactly what it signalled (signal 1 / wait 1)
+    so re-entry neither deadlocks nor inherits residue from the previous
+    crossing. This jax's mosaic interpreter cannot execute remote REGULAR
+    signals, so the protocol is proven through the sigcheck capture layer
+    (no device): the cross-rank checker simulates all interleavings and
+    flags any starvation, wait cycle, or leftover count."""
+    from triton_dist_tpu.analysis import sigcheck
+
+    def run(ctx):
+        def kernel(out_ref, sig):
+            me = shd.my_pe("x")
+            peer = me ^ 1               # even<->odd partner pairs
+            for _ in range(3):          # re-entry: three crossings in a row
+                shd.barrier_pair(("x",), peer)
+            out_ref[0] = 1
+
+        def f():
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((1,), jnp.int32),
+                out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+                scratch_shapes=[pltpu.SemaphoreType.REGULAR],
+                compiler_params=pltpu.CompilerParams(
+                    has_side_effects=True,
+                    collective_id=collective_id_for(
+                        "shmem_api_pair_reentry")),
+                interpret=default_interpret(),
+            )()
+
+        ctx.shard_map(f, in_specs=(), out_specs=P("x"))()
+
+    rep = sigcheck(run, op="barrier_pair_reentry",
+                   meshes=({"x": 2}, {"x": 4}))
+    assert rep.ok, "\n".join(str(f) for f in rep.findings)
+    assert all(c > 0 for c in rep.event_counts.values())

@@ -1,0 +1,151 @@
+"""sigcheck: the static signal-protocol verifier's own gate (ISSUE 10).
+
+Everything here is trace-time only — the capture replays kernels on
+numpy-backed fake refs and the determinism lint runs ``jax.make_jaxpr``,
+so NO kernel executes on any device. The suite pins three contracts:
+
+1. every registered op verifies CLEAN at n ∈ {2, 3, 4} (and the 2d/pair
+   meshes its entry declares) — zero findings of any kind;
+2. the three serving programs pass the determinism lint;
+3. every broken-kernel gallery entry is flagged WITH ITS EXPECTED finding
+   kind — if a checker change stops catching one, that is a checker
+   regression, not a cleaner gallery;
+
+plus the registry↔ops parity satellite: the registry must name the entire
+``triton_dist_tpu.ops`` public surface (checked or skipped-with-reason), so
+a new op cannot land unverified by accident.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from triton_dist_tpu.analysis import (check_gallery, check_registry,
+                                      lint_serving_programs, sigcheck)
+from triton_dist_tpu.analysis.registry import REGISTRY, surface_names
+
+pytestmark = [pytest.mark.quick, pytest.mark.analysis]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- 1. the registry verifies clean ------------------------------------------
+
+_CHECKED = sorted(n for n, e in REGISTRY.items() if e.skip is None)
+_SKIPPED = sorted(n for n, e in REGISTRY.items() if e.skip is not None)
+
+
+@pytest.mark.parametrize("name", _CHECKED)
+def test_registered_op_is_clean(name):
+    entry = REGISTRY[name]
+    rep = sigcheck(entry.run, op=name, meshes=entry.meshes)
+    assert rep.ok, (
+        f"{name} has findings:\n" +
+        "\n".join(f"  {f}" for f in rep.findings))
+    # on multi-rank meshes the capture must have actually recorded the
+    # protocol, not no-opped (local single-rank kernels legitimately have
+    # no signal events)
+    assert rep.event_counts
+    for n, count in rep.event_counts.items():
+        if n >= 2:
+            assert count > 0, f"{name}: no events captured at n={n}"
+
+
+def test_skips_carry_reasons():
+    for name in _SKIPPED:
+        assert REGISTRY[name].skip.strip(), f"{name} skipped without reason"
+
+
+def test_registry_matches_ops_surface():
+    """Satellite (a): the registry must cover the whole ops re-export
+    surface and name nothing stale — parity both ways."""
+    surface = set(surface_names())
+    registry = set(REGISTRY)
+    assert surface - registry == set(), (
+        f"public ops missing from the sigcheck registry: "
+        f"{sorted(surface - registry)}")
+    assert registry - surface == set(), (
+        f"registry names no longer exported from triton_dist_tpu.ops: "
+        f"{sorted(registry - surface)}")
+
+
+def test_ops_init_reexports_submodule_surface():
+    """Satellite (a): ``ops/__init__.py`` re-exports every public symbol of
+    every ops submodule (lockstep guard for the next op that lands)."""
+    import importlib
+    import pkgutil
+
+    import triton_dist_tpu.ops as ops_pkg
+
+    top = {n for n in dir(ops_pkg) if not n.startswith("_")}
+    for info in pkgutil.iter_modules(ops_pkg.__path__):
+        mod = importlib.import_module(f"triton_dist_tpu.ops.{info.name}")
+        public = getattr(mod, "__all__", None)
+        if public is None:
+            continue
+        missing = set(public) - top
+        assert missing == set(), (
+            f"ops.{info.name}.__all__ names not re-exported from "
+            f"triton_dist_tpu.ops: {sorted(missing)}")
+
+
+# -- 2. serving determinism lint ---------------------------------------------
+
+def test_serving_programs_lint_clean():
+    findings = lint_serving_programs()
+    assert findings == [], (
+        "serving trace-determinism contract violated:\n" +
+        "\n".join(f"  {f}" for f in findings))
+
+
+# -- 3. the broken-kernel gallery is caught ----------------------------------
+
+_GALLERY = check_gallery()
+
+
+@pytest.mark.parametrize("name", sorted(_GALLERY))
+def test_gallery_kernel_is_flagged(name):
+    expected, rep = _GALLERY[name]
+    assert expected in rep.finding_kinds, (
+        f"gallery kernel {name} must be flagged {expected!r}, got "
+        f"{rep.finding_kinds or 'nothing'} — checker regression")
+
+
+def test_gallery_spans_the_taxonomy():
+    """One gallery kernel per finding class the issue names."""
+    kinds = {expected for expected, _ in _GALLERY.values()}
+    assert {"under_signal", "over_signal", "deadlock", "unordered_read",
+            "nondeterminism"} <= kinds
+
+
+def test_capture_error_is_a_finding_not_an_escape():
+    """An op the verifier cannot replay must FAIL the check, loudly."""
+    def broken(ctx):
+        raise RuntimeError("kernel changed its host signature")
+
+    rep = sigcheck(broken, op="broken", meshes=({"x": 2},))
+    assert rep.finding_kinds == ["capture_error"]
+    assert "kernel changed its host signature" in rep.findings[0].detail
+
+
+# -- CLI ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_cli_json_contract():
+    """``scripts/sigcheck.py --all --gallery`` emits one parseable JSON doc
+    and exits 0 with --fail-on-findings (slow tier: it re-runs the whole
+    registry in a subprocess; the in-process tests above already gate
+    tier 1)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "sigcheck.py"),
+         "--all", "--gallery", "--fail-on-findings", "--quiet"],
+        capture_output=True, text=True, timeout=560,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(proc.stdout)
+    assert doc["n_findings"] == 0
+    assert doc["gallery_misses"] == []
+    assert doc["ops"] and doc["gallery"]

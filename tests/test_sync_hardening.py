@@ -37,8 +37,15 @@ def ctx2d():
 
 def _assert_detector_ran_clean(what: str):
     """The detector must have RUN (ipc.races populated — guards against the
-    env-flag plumbing silently breaking) and found nothing."""
-    from jax._src.pallas.mosaic.interpret import interpret_pallas_call as ipc
+    env-flag plumbing silently breaking) and found nothing. The state lives
+    on a private jax module; ``interpret_race_state`` version-guards the
+    import so a jax bump turns these asserts into skips, not failures."""
+    from triton_dist_tpu.utils.debug import interpret_race_state
+    ipc = interpret_race_state()
+    if ipc is None:
+        pytest.skip("jax moved the private interpret-mode race-detector "
+                    "state (jax._src.pallas.mosaic.interpret) — cannot "
+                    "assert the detector ran on this jax version")
     assert ipc.races is not None, (
         f"race detector never ran for {what} — TDT_DETECT_RACES plumbing "
         "broken?")

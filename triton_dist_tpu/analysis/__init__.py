@@ -1,0 +1,36 @@
+"""sigcheck — static signal-protocol verifier for the overlap kernels.
+
+The dynamic validation ladder (interpret-mode race detector → serial-mode
+bisection → noise fuzzing, docs/debugging.md) only checks the one schedule
+it executed, at one mesh size. This package adds rung 0: a *static* pass
+that replays each kernel's Python body per rank with symbolic bookkeeping —
+no devices, no execution — and proves, over n ∈ {2, 3, 4}:
+
+- **coverage**: signals reaching each ``signal_wait_until(sem, v)`` /
+  ``wait_recv`` sum to exactly what it consumes (under-signal = static
+  deadlock, over-signal = the PR-6 ledger-poison bug class);
+- **deadlock-freedom**: the cross-rank wait graph has an execution order
+  (found by simulating the recorded event streams);
+- **ordering**: every read of a remote-put destination is dominated by a
+  wait on the covering semaphore (static analog of the race detector,
+  covering all grid positions at once);
+- **trace determinism** (serving contract): the serving programs' jaxprs
+  contain no rank-count-dependent reduction or host-callback op.
+
+Entry points: :func:`sigcheck` (one op), :func:`check_registry` (the whole
+public surface), :func:`lint.lint_serving_programs` (the jaxpr lint), and
+``scripts/sigcheck.py`` (CLI, JSON findings).
+"""
+
+from .events import Event, Region, SemId
+from .checker import Finding, check_events
+from .capture import FakeContext, capture_op
+from .lint import lint_determinism, lint_serving_programs
+from .api import OpReport, sigcheck, check_registry, check_gallery
+
+__all__ = [
+    "Event", "Region", "SemId", "Finding", "check_events",
+    "FakeContext", "capture_op", "lint_determinism",
+    "lint_serving_programs", "OpReport", "sigcheck", "check_registry",
+    "check_gallery",
+]

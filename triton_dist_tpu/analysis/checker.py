@@ -1,0 +1,328 @@
+"""Cross-rank verification over captured event streams.
+
+Given the per-rank event streams from :mod:`.capture` at a concrete rank
+count n, the checker:
+
+1. **simulates** the streams against counting-semaphore semantics (signals
+   and put deliveries credit, waits consume; semaphores are monotone, so
+   greedy round-robin saturation reaches completion iff ANY schedule does);
+2. classifies a stuck simulation as **under-signal** (static supply on some
+   blocked semaphore is less than its demand — the wait can never be paid)
+   or **deadlock** (supply suffices globally but every order leaves a
+   wait-before-signal cycle);
+3. flags **over-signal** residue: credits left on any semaphore after a
+   completed run — the PR-6 ledger-poison class (a later call on the same
+   scratch inherits the stale count);
+4. flags **unordered reads**: a consumer-side read overlapping a put's
+   destination region that is neither dominated by the wait covering that
+   delivery nor provably happens-before the put's issuance (vector clocks
+   carried through signal/put credits — the entry-barrier and ack-credit
+   patterns are what make reads-before-reuse legal);
+5. fits **peer patterns** per put/signal site — ``(me+k)%n`` or constant —
+   purely as a protocol summary for the JSON report.
+
+Vector clocks: each executed event joins the clocks attached to the
+credits it consumed; a credit carries the producer's clock at deposit
+time. ``read ⊑ signal ⊑ wait ⊑ put`` chains therefore rescue slot-reuse
+protocols (ring ack credits) from rule 4.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict, deque
+from typing import Dict, List, Optional, Tuple
+
+from .events import Event, Region, SemId
+
+# finding kinds (the taxonomy docs/debugging.md tabulates)
+UNDER_SIGNAL = "under_signal"
+OVER_SIGNAL = "over_signal"
+DEADLOCK = "deadlock"
+UNORDERED_READ = "unordered_read"
+NONDETERMINISM = "nondeterminism"
+CAPTURE_ERROR = "capture_error"
+
+
+@dataclasses.dataclass
+class Finding:
+    kind: str
+    op: str
+    n: Optional[int]
+    detail: str
+    events: List[str] = dataclasses.field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {"kind": self.kind, "op": self.op, "n": self.n,
+                "detail": self.detail, "events": self.events}
+
+    def __str__(self) -> str:
+        where = f" n={self.n}" if self.n is not None else ""
+        return f"[{self.kind}] {self.op}{where}: {self.detail}"
+
+
+class _Clock:
+    """Vector clock over ranks: component r = highest seq at rank r known to
+    happen-before this point."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, n: int):
+        self.v = [-1] * n
+
+    def copy(self) -> "_Clock":
+        c = _Clock(len(self.v))
+        c.v = list(self.v)
+        return c
+
+    def join(self, other: "_Clock") -> None:
+        self.v = [max(a, b) for a, b in zip(self.v, other.v)]
+
+    def dominates(self, rank: int, seq: int) -> bool:
+        return self.v[rank] >= seq
+
+
+@dataclasses.dataclass
+class _Credit:
+    amount: int
+    clock: _Clock
+    delivery: Optional["_Delivery"] = None
+
+
+@dataclasses.dataclass
+class _Delivery:
+    """One put landing at a consumer: region + issuance clock; filled in
+    with the covering wait (if any) during simulation."""
+    src_rank: int
+    put: Event
+    issue_clock: _Clock
+    wait_seq: Optional[int] = None       # consumer seq of the covering wait
+    consumed: int = 0
+
+
+def _sem_key(rank: int, sem: SemId) -> Tuple[int, str, Tuple[int, ...]]:
+    return (rank, sem.alloc, sem.cell)
+
+
+def check_events(op: str, streams: Dict[int, List[Event]],
+                 n: int) -> List[Finding]:
+    """Run all cross-rank checks over one captured instantiation."""
+    findings: List[Finding] = []
+    ranks = sorted(streams)
+    if len(ranks) != n:
+        findings.append(Finding(CAPTURE_ERROR, op, n,
+                                f"captured {len(ranks)} rank streams, "
+                                f"expected {n}"))
+        return findings
+
+    # ---- static supply/demand per (rank, sem-cell)
+    supply: Dict[Tuple, int] = defaultdict(int)
+    demand: Dict[Tuple, int] = defaultdict(int)
+    for r in ranks:
+        for e in streams[r]:
+            if e.kind == "signal":
+                supply[_sem_key(e.dst_rank, e.sem)] += e.value
+            elif e.kind == "put":
+                supply[_sem_key(e.dst_rank, e.sem)] += e.value
+                if e.send_sem is not None:
+                    # send completion credits the SOURCE-side send sem — the
+                    # standard quiet-by-same-ref-wait drains it as a wait_recv
+                    supply[_sem_key(e.rank, e.send_sem)] += e.value
+            elif e.kind in ("wait", "wait_recv"):
+                demand[_sem_key(e.rank, e.sem)] += e.value
+            elif e.kind == "wait_send" and e.sem is not None:
+                demand[_sem_key(e.rank, e.sem)] += e.value
+
+    for key in sorted(set(supply) | set(demand),
+                      key=lambda k: (k[0], k[1], k[2])):
+        s, d = supply.get(key, 0), demand.get(key, 0)
+        rank, alloc, cell = key
+        sem_str = f"{alloc}{list(cell)}" if cell else alloc
+        if s > d:
+            findings.append(Finding(
+                OVER_SIGNAL, op, n,
+                f"semaphore {sem_str} at rank {rank} accumulates {s} but "
+                f"only {d} is ever consumed — {s - d} left behind poisons "
+                f"the next call on this scratch"))
+
+    # ---- simulation
+    queues: Dict[Tuple, deque] = defaultdict(deque)
+    deliveries: List[_Delivery] = []
+    clocks = {r: _Clock(n) for r in ranks}
+    pos = {r: 0 for r in ranks}
+
+    def _is_wait(e: Event) -> bool:
+        if e.kind in ("wait", "wait_recv"):
+            return True
+        return e.kind == "wait_send" and e.sem is not None
+
+    def executable(e: Event) -> bool:
+        if _is_wait(e):
+            q = queues[_sem_key(e.rank, e.sem)]
+            return sum(c.amount for c in q) >= e.value
+        return True
+
+    def execute(e: Event) -> None:
+        clk = clocks[e.rank]
+        if _is_wait(e):
+            q = queues[_sem_key(e.rank, e.sem)]
+            need = e.value
+            while need > 0:
+                c = q[0]
+                take = min(need, c.amount)
+                c.amount -= take
+                need -= take
+                clk.join(c.clock)
+                if c.delivery is not None:
+                    c.delivery.consumed += take
+                    # a wait_send that happens to drain a delivery credit
+                    # (shared sem cell) proves nothing about arrival — never
+                    # let it stand in as the covering wait
+                    if c.delivery.wait_seq is None and e.kind != "wait_send":
+                        c.delivery.wait_seq = e.seq
+                if c.amount == 0:
+                    q.popleft()
+        clk.v[e.rank] = e.seq
+        if e.kind == "signal":
+            queues[_sem_key(e.dst_rank, e.sem)].append(
+                _Credit(e.value, clk.copy()))
+        elif e.kind == "put":
+            d = _Delivery(e.rank, e, clk.copy())
+            deliveries.append(d)
+            queues[_sem_key(e.dst_rank, e.sem)].append(
+                _Credit(e.value, clk.copy(), d))
+            if e.send_sem is not None:
+                # no delivery attached: draining the send sem proves the
+                # source buffer is reusable, NOT that the remote write landed
+                queues[_sem_key(e.rank, e.send_sem)].append(
+                    _Credit(e.value, clk.copy()))
+
+    progressed = True
+    while progressed:
+        progressed = False
+        for r in ranks:
+            while pos[r] < len(streams[r]) and executable(streams[r][pos[r]]):
+                execute(streams[r][pos[r]])
+                pos[r] += 1
+                progressed = True
+
+    stuck = {r: pos[r] for r in ranks if pos[r] < len(streams[r])}
+    if stuck:
+        blocked = [streams[r][pos[r]] for r in sorted(stuck)]
+        starved = [e for e in blocked
+                   if supply.get(_sem_key(e.rank, e.sem), 0)
+                   < demand.get(_sem_key(e.rank, e.sem), 0)]
+        if starved:
+            e = starved[0]
+            key = _sem_key(e.rank, e.sem)
+            findings.append(Finding(
+                UNDER_SIGNAL, op, n,
+                f"rank {e.rank} waits {demand[key]} on {e.sem} but total "
+                f"signal supply is {supply.get(key, 0)} — static deadlock "
+                "(missing/dropped signal)",
+                [e.describe() for e in blocked]))
+        else:
+            findings.append(Finding(
+                DEADLOCK, op, n,
+                "no execution order exists: every rank is blocked on a "
+                "wait whose signals sit behind other blocked waits "
+                "(wait-before-signal cycle)",
+                [e.describe() for e in blocked]))
+        # hazard analysis below would double-report on a half-run protocol
+        return findings
+
+    # ---- unordered-read hazards (completed runs only)
+    reads_by_rank: Dict[int, List[Tuple[int, Region]]] = {r: [] for r in ranks}
+    for r in ranks:
+        for e in streams[r]:
+            if e.kind == "read" and e.src is not None:
+                reads_by_rank[r].append((e.seq, e.src))
+            elif e.kind == "put" and e.src is not None:
+                # a put reads its source region (ring forwarding)
+                reads_by_rank[r].append((e.seq, e.src))
+
+    reported = set()
+    for d in deliveries:
+        cons_rank = d.put.dst_rank
+        if cons_rank == d.src_rank:
+            pass  # local async copy: same rules apply to its waiter
+        region = d.put.dst
+        for seq, rregion in reads_by_rank[cons_rank]:
+            if not region.overlaps(rregion):
+                continue
+            if d.wait_seq is not None and seq > d.wait_seq:
+                continue  # dominated by the covering wait
+            if d.issue_clock.dominates(cons_rank, seq):
+                continue  # read happens-before the put was even issued
+            key = (cons_rank, region.buffer, seq)
+            if key in reported:
+                continue
+            reported.add(key)
+            covering = ("no wait ever covers this delivery"
+                        if d.wait_seq is None else
+                        f"the covering wait runs at seq {d.wait_seq}, after "
+                        "the read")
+            findings.append(Finding(
+                UNORDERED_READ, op, n,
+                f"rank {cons_rank} reads {rregion} (seq {seq}) which "
+                f"overlaps the destination of a put from rank "
+                f"{d.src_rank}; {covering}",
+                [d.put.describe()]))
+
+    return findings
+
+
+# -- peer-pattern fitting (informational) ------------------------------------
+
+def fit_peer_patterns(streams_by_n: Dict[int, Dict[int, List[Event]]]
+                      ) -> Dict[str, str]:
+    """Best-effort symbolic summary: for each put/signal site (aligned by
+    per-rank occurrence index), fit ``dst = (me+k)%n`` or ``dst = c``
+    consistent across every rank and every captured n. Asymmetric protocols
+    (root broadcast, ring-position-dependent counts) report ``asymmetric``.
+    """
+    # site key -> {n: {rank: [dst,...]}}
+    table: Dict[str, Dict[int, Dict[int, List[int]]]] = defaultdict(
+        lambda: defaultdict(dict))
+    for n, streams in streams_by_n.items():
+        for r, evs in streams.items():
+            per_site: Dict[str, List[int]] = defaultdict(list)
+            for e in evs:
+                if e.kind in ("put", "signal") and e.dst_rank is not None:
+                    site = f"{e.site}:{e.kind}:{e.sem.alloc if e.sem else ''}"
+                    per_site[site].append(e.dst_rank)
+            for site, dsts in per_site.items():
+                table[site][n][r] = dsts
+
+    out: Dict[str, str] = {}
+    for site, by_n in table.items():
+        shifts: set = set()
+        consts: set = set()
+        ok = True
+        for n, by_rank in by_n.items():
+            counts = {len(v) for v in by_rank.values()}
+            if len(by_rank) != n or len(counts) != 1:
+                ok = False
+                break
+            m = counts.pop()
+            for i in range(m):
+                k0 = {(by_rank[r][i] - r) % n for r in by_rank}
+                c0 = {by_rank[r][i] for r in by_rank}
+                if len(k0) == 1:
+                    shifts.add((i, k0.pop()))
+                elif len(c0) == 1:
+                    consts.add((i, c0.pop()))
+                else:
+                    ok = False
+        if not ok:
+            out[site] = "asymmetric"
+        elif shifts and not consts:
+            ks = sorted({k for _, k in shifts})
+            out[site] = ("dst=(me+k)%n, k in " + repr(ks)) if len(ks) > 1 \
+                else f"dst=(me+{ks[0]})%n"
+        elif consts and not shifts:
+            cs = sorted({c for _, c in consts})
+            out[site] = f"dst=const {cs}"
+        else:
+            out[site] = "mixed"
+    return out

@@ -1,0 +1,179 @@
+"""Determinism lint: enforce the serving trace contract on jaxprs.
+
+The sharded-serving trace contract (docs/serving.md, CHANGES.md PR 8) is
+prose today: the hot path must stay *rank-count independent* — bitwise
+identical logits whichever mesh it runs on — which is why ``gemm_rs`` (a
+``psum_scatter`` whose accumulation order depends on n) was refused in the
+sharded engine. This module turns the prose into a rule: walk the jaxpr of
+a serving program and flag any rank-count-dependent reduction or
+host-sync-shaped op in it.
+
+Flagged primitives:
+- ``psum`` / ``reduce_scatter`` (``lax.psum_scatter``): cross-rank float
+  accumulation whose result depends on the rank count and reduction order;
+- ``pure_callback`` / ``io_callback`` / ``debug_callback`` / ``infeed`` /
+  ``outfeed``: host round-trips — a host sync in the decode loop both
+  breaks trace determinism (host state) and stalls the pipeline.
+
+``all_gather`` / ``all_to_all`` / ``ppermute`` stay legal: pure data
+movement, bitwise independent of arrival order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .checker import Finding, NONDETERMINISM
+
+BANNED_PRIMITIVES: Dict[str, str] = {
+    "psum": "rank-count-dependent reduction (order/count changes the sum)",
+    "reduce_scatter": "rank-count-dependent reduction (lax.psum_scatter)",
+    "pure_callback": "host callback in the hot path",
+    "io_callback": "host callback in the hot path",
+    "debug_callback": "host callback in the hot path",
+    "infeed": "host transfer in the hot path",
+    "outfeed": "host transfer in the hot path",
+}
+
+
+def _sub_jaxprs(value: Any):
+    values = value if isinstance(value, (tuple, list)) else (value,)
+    for v in values:
+        if hasattr(v, "eqns"):          # Jaxpr
+            yield v
+        elif hasattr(v, "jaxpr"):       # ClosedJaxpr
+            yield v.jaxpr
+
+
+def _walk(jaxpr, path: Tuple[str, ...], hits: List[Tuple[str, str]]):
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in BANNED_PRIMITIVES:
+            hits.append((name, "/".join(path) or "<top>"))
+        for param in eqn.params.values():
+            for sub in _sub_jaxprs(param):
+                _walk(sub, path + (name,), hits)
+
+
+def lint_jaxpr(jaxpr, op: str) -> List[Finding]:
+    """Flag banned primitives anywhere in ``jaxpr`` (recursing through
+    pjit/scan/cond/while/shard_map/pallas sub-jaxprs)."""
+    hits: List[Tuple[str, str]] = []
+    _walk(jaxpr, (), hits)
+    return [Finding(NONDETERMINISM, op, None,
+                    f"`{name}` under {where}: {BANNED_PRIMITIVES[name]}")
+            for name, where in hits]
+
+
+def lint_determinism(fn: Callable[..., Any], *example_args,
+                     op: str = "fn",
+                     axis_env: Optional[Tuple[Tuple[str, int], ...]] = None
+                     ) -> List[Finding]:
+    """Trace ``fn`` (arguments may be ShapeDtypeStructs — trace only, no
+    execution) and lint the resulting jaxpr. ``axis_env`` binds named axes
+    for tracing collective-bearing code outside a mesh — sizes > 1, or a
+    ``psum`` over a size-1 axis constant-folds away before the lint sees
+    it."""
+    closed = jax.make_jaxpr(fn, axis_env=axis_env)(*example_args)
+    return lint_jaxpr(closed.jaxpr, op)
+
+
+# -- the three serving programs ---------------------------------------------
+
+def _abstract(tree):
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype), tree)
+
+
+@dataclasses.dataclass
+class _ServingShapes:
+    """Tiny but representative shapes for the serving-program lint."""
+    chunk: int = 8
+    batch: int = 2
+    horizon: int = 2
+    num_pages: int = 9
+    page_size: int = 8
+    pages_per_seq: int = 4
+
+
+def lint_serving_programs(ctx=None) -> List[Finding]:
+    """Lint the three serving programs the trace contract names:
+    ``prefill_chunk_paged``, ``decode_multistep_paged`` (pure trace, no
+    devices) and ``migrate_pages`` (traced through ``shard_map`` on a
+    2-device mesh — pass ``ctx`` or have ≥ 2 local devices)."""
+    from ..models.llama import (LlamaConfig, init_page_pool, init_params,
+                                prefill_chunk_paged, decode_multistep_paged)
+
+    sh = _ServingShapes()
+    cfg = dataclasses.replace(LlamaConfig.tiny(n_layers=2), dtype=jnp.float32)
+    params = _abstract(jax.eval_shape(
+        lambda: init_params(jax.random.key(0), cfg)))
+    pages = _abstract(jax.eval_shape(
+        lambda: init_page_pool(cfg, sh.num_pages, sh.page_size)))
+    i32 = lambda *shape: jax.ShapeDtypeStruct(shape, jnp.int32)
+
+    findings: List[Finding] = []
+    findings += lint_determinism(
+        lambda p, t, s, pl_, pg, bt: prefill_chunk_paged(
+            p, t, s, pl_, cfg, pg, bt),
+        params, i32(sh.chunk), i32(), i32(), pages, i32(sh.pages_per_seq),
+        op="prefill_chunk_paged")
+    findings += lint_determinism(
+        lambda p, t, pos, pg, bt, lim: decode_multistep_paged(
+            p, t, pos, cfg, pg, bt, lim, sh.horizon),
+        params, i32(sh.batch), i32(sh.batch), pages,
+        i32(sh.batch, sh.pages_per_seq), i32(sh.batch),
+        op="decode_multistep_paged")
+    findings += lint_migrate_pages(ctx)
+    return findings
+
+
+def lint_migrate_pages(ctx=None) -> List[Finding]:
+    from ..ops import migrate_pages
+
+    if ctx is None:
+        import numpy as np
+        from jax.sharding import Mesh
+        from ..shmem import ShmemContext
+        devices = jax.devices()
+        if len(devices) < 2:
+            return [Finding(
+                NONDETERMINISM, "migrate_pages", None,
+                "lint could not run: needs a 2-device mesh to trace "
+                "through shard_map (got 1 local device)")]
+        ctx = ShmemContext(mesh=Mesh(np.array(devices[:2]), ("role",)))
+
+    sh = _ServingShapes()
+    axis = ctx.axis_names[0]
+    n_roles = ctx.axis_size(axis)
+    L, Hkv, D, pmax = 2, 2, 64, 4
+    pool = jax.ShapeDtypeStruct(
+        (n_roles, L, sh.num_pages, Hkv, sh.page_size, D), jnp.float32)
+    i32 = lambda *shape: jax.ShapeDtypeStruct(shape, jnp.int32)
+    return lint_determinism(
+        lambda kp, vp, src, dst, npg: migrate_pages(
+            ctx, kp, vp, src, dst, npg, axis=axis),
+        pool, pool, i32(pmax), i32(pmax), i32(1),
+        op="migrate_pages")
+
+
+# -- engine hook (TDT_SIGCHECK=1) -------------------------------------------
+
+def lint_engine_programs(programs: Dict[str, Tuple[Callable, tuple]],
+                         what: str) -> None:
+    """Raise if any of an engine's jitted programs violates the determinism
+    contract. ``programs`` maps name → (fn, example_args) with abstract
+    example args; called from the engine constructors when
+    ``TDT_SIGCHECK=1`` so a contract regression fails at engine build time,
+    before any request is admitted."""
+    findings: List[Finding] = []
+    for name, (fn, example_args) in programs.items():
+        findings += lint_determinism(fn, *example_args, op=f"{what}.{name}")
+    if findings:
+        raise RuntimeError(
+            "TDT_SIGCHECK: serving trace-determinism contract violated:\n"
+            + "\n".join(f"  {f}" for f in findings))

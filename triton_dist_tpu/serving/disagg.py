@@ -53,6 +53,7 @@ deployment (see docs/serving.md for the launch recipe and the
 from __future__ import annotations
 
 import json
+import os
 import time
 from collections import deque
 
@@ -571,6 +572,27 @@ class DisaggServingEngine:
         # completes plus the final chunk's partial last page — and a
         # RETRY may need to re-send a whole prompt's pages in one call
         pmax = max(prefill_chunk // page_size + 2, pages_per_seq)
+
+        # TDT_SIGCHECK=1: build-time determinism lint of the three role-
+        # stacked SPMD programs (sigcheck rung 0 — docs/debugging.md);
+        # trace-only, abstract args, raises before any request is admitted
+        if os.environ.get("TDT_SIGCHECK") == "1":
+            from triton_dist_tpu.analysis.lint import lint_engine_programs
+            abstract = lambda tree: jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+            i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)
+            kv = (abstract(self.pool_k), abstract(self.pool_v))
+            lint_engine_programs({
+                "prefill_chunk_paged": (chunk_sm, (
+                    abstract(self.params), i32(2, prefill_chunk), i32(2),
+                    i32(2), *kv, i32(2, pages_per_seq))),
+                "decode_multistep_paged": (dec_sm, (
+                    abstract(self.params), i32(2, B), i32(2, B), *kv,
+                    i32(2, B, pages_per_seq), i32(2, B))),
+                "migrate_pages": (mig_f, (
+                    i32(pmax), i32(pmax), i32(1), i32(), *kv)),
+            }, type(self).__name__)
+
         self.channel = PageMigrationChannel(
             self._migrate, pmax, reserved=1, metrics=self.metrics,
             consumer=DECODE_ROLE, plan=fault_plan,

@@ -50,6 +50,7 @@ at every horizon (tests/test_serving.py asserts both for K in {1, 4}).
 from __future__ import annotations
 
 import json
+import os
 import time
 from collections import deque
 
@@ -257,6 +258,26 @@ class ServingEngine:
             else:
                 self._chunk_step = jax.jit(chunk, donate_argnums=(4,),
                                            **chunk_kw)
+
+        # TDT_SIGCHECK=1: lint the engine's compiled programs against the
+        # trace-determinism contract at BUILD time (sigcheck rung 0 — see
+        # docs/debugging.md). Trace-only on abstract args; a rank-count-
+        # dependent reduction or host callback in the hot path raises here,
+        # before any request is admitted.
+        if os.environ.get("TDT_SIGCHECK") == "1":
+            from triton_dist_tpu.analysis.lint import lint_engine_programs
+            abstract = lambda tree: jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+            i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)
+            programs = {"decode_multistep_paged": (step, (
+                abstract(self.params), i32(num_slots), i32(num_slots),
+                abstract(self.pool), i32(num_slots, pages_per_seq),
+                i32(num_slots)))}
+            if prefill_chunk is not None:
+                programs["prefill_chunk_paged"] = (chunk, (
+                    abstract(self.params), i32(prefill_chunk), i32(), i32(),
+                    abstract(self.pool), i32(pages_per_seq)))
+            lint_engine_programs(programs, type(self).__name__)
 
     def _sync_mirrors(self) -> None:
         """Upload the host slot mirrors to the device copies. The sharded

@@ -45,6 +45,7 @@ from jax.experimental import pallas as pl  # noqa: F401  (re-exported for kernel
 from jax.experimental.pallas import tpu as pltpu
 
 from . import faults
+from . import trace
 
 
 # -- producer-delay fuzzing --------------------------------------------------
@@ -73,6 +74,8 @@ def producer_noise(src_ref) -> None:
     An active :class:`~triton_dist_tpu.shmem.faults.FaultPlan` with
     ``device_put_delay=k`` adds ``k`` flat extra trips on top — the
     "delay a put by extra noise trips" fault of the protocol matrix."""
+    if trace.active_tracer() is not None:
+        return  # busywork has no protocol meaning; skip under event capture
     trips = _noise_trips()
     plan = faults.active_plan()
     extra = plan.device_put_delay if plan is not None else 0
@@ -199,6 +202,9 @@ def putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, pe,):
     ``wait_recv`` hangs exactly like a dead link would (host-side
     deadlines are what bound that hang; see docs/robustness.md).
     """
+    tracer = trace.active_tracer()
+    if tracer is not None:
+        return tracer.putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, pe)
     plan = faults.active_plan()
     if plan is not None and plan.device_peer_dead:
         return _COMPLETED_DMA
@@ -237,6 +243,9 @@ def signal_op(sem_ref, inc, pe=None):
     An active FaultPlan may drop the signal (nothing emitted — the
     consumer's counted wait starves) or duplicate it (doubled increment —
     the over-signal poison the ledger layer must detect)."""
+    tracer = trace.active_tracer()
+    if tracer is not None:
+        return tracer.signal_op(sem_ref, inc, pe)
     plan = faults.active_plan()
     if plan is not None:
         inc = plan.device_signal_inc(inc)
@@ -255,6 +264,9 @@ def signal_wait_until(sem_ref, value):
     ``signal_wait_until`` which leaves the flag set; protocols in ``ops/``
     are designed around consumption). DMA delivery waits use ``wait_recv``.
     """
+    tracer = trace.active_tracer()
+    if tracer is not None:
+        return tracer.signal_wait_until(sem_ref, value)
     pltpu.semaphore_wait(sem_ref, value)
 
 
@@ -263,12 +275,27 @@ def wait_recv(dst_ref, recv_sem):
     (a DMA semaphore). DMA semaphores count transferred bytes, so the wait
     is phrased through a descriptor of the expected shape — the standard
     same-ref trick."""
+    tracer = trace.active_tracer()
+    if tracer is not None:
+        return tracer.wait_recv(dst_ref, recv_sem)
     pltpu.make_async_copy(dst_ref, dst_ref, recv_sem).wait()
 
 
 def signal_read(sem_ref):
-    """Non-destructive read of the semaphore count (debug/poll)."""
-    return pl.semaphore_read(sem_ref)
+    """Non-destructive read of the semaphore count (debug/poll).
+
+    ``semaphore_read`` moved from ``pltpu`` to ``pl`` across jax releases;
+    resolve whichever this jax exposes."""
+    tracer = trace.active_tracer()
+    if tracer is not None:
+        return tracer.signal_read(sem_ref)
+    read = getattr(pl, "semaphore_read", None) or getattr(
+        pltpu, "semaphore_read", None)
+    if read is None:
+        raise NotImplementedError(
+            "neither pl.semaphore_read nor pltpu.semaphore_read exists on "
+            f"jax {jax.__version__}")
+    return read(sem_ref)
 
 
 # -- ordering ---------------------------------------------------------------
@@ -276,6 +303,9 @@ def signal_read(sem_ref):
 def quiet(*rdmas):
     """Wait until our outstanding puts have left this device (local send
     completion). Analog of ``libshmem_device.quiet``."""
+    tracer = trace.active_tracer()
+    if tracer is not None:
+        return tracer.quiet(*rdmas)
     for r in rdmas:
         r.wait_send()
 
@@ -285,6 +315,9 @@ def fence():
     TPU remote DMAs carry their own completion semaphores; ordering is
     expressed by waiting those, so ``fence`` is a no-op kept for API parity.
     """
+    tracer = trace.active_tracer()
+    if tracer is not None:
+        return tracer.fence()
     return None
 
 
@@ -307,6 +340,9 @@ def barrier_all(axis_names: Sequence[str], mesh_axes: Sequence[str] | None = Non
     if isinstance(axis_names, str):
         axis_names = (axis_names,)
     mesh_axes = tuple(mesh_axes) if mesh_axes is not None else tuple(axis_names)
+    tracer = trace.active_tracer()
+    if tracer is not None:
+        return tracer.barrier_all(axis_names, mesh_axes)
     sem = pltpu.get_barrier_semaphore()
     npes = n_pes(axis_names)
     me = my_pe(axis_names)
@@ -326,6 +362,9 @@ def barrier_all(axis_names: Sequence[str], mesh_axes: Sequence[str] | None = Non
 
 def barrier_pair(axis_names: Sequence[str], peer):
     """Two-device barrier with flat-id ``peer`` (ring neighbors etc.)."""
+    tracer = trace.active_tracer()
+    if tracer is not None:
+        return tracer.barrier_pair(axis_names, peer)
     sem = pltpu.get_barrier_semaphore()
     pltpu.semaphore_signal(sem, inc=1, device_id=peer,
                            device_id_type=pltpu.DeviceIdType.LOGICAL)

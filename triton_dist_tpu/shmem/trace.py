@@ -1,0 +1,30 @@
+"""Event-recording seam for the device-side shmem primitives.
+
+``analysis.capture`` installs a tracer here while it replays a kernel's
+Python body per rank; every ``shmem.device`` primitive first asks for the
+active tracer and, when one is installed, appends a symbolic protocol
+event instead of emitting a Mosaic op. The indirection lives in its own
+tiny module (no jax imports) so ``device.py`` pays one attribute read per
+call when tracing is off and ``analysis`` never becomes an import cycle.
+
+This is NOT the runtime fault-injection hook (``shmem.faults``) — faults
+perturb the real lowering; the tracer replaces it entirely.
+"""
+
+from __future__ import annotations
+
+_TRACER = None
+
+
+def active_tracer():
+    """The installed event tracer, or None (the usual case)."""
+    return _TRACER
+
+
+def set_tracer(tracer) -> None:
+    """Install ``tracer`` (or None to clear). The tracer must provide the
+    device-primitive hooks ``analysis.capture.RankTracer`` implements:
+    putmem_nbi, signal_op, signal_wait_until, wait_recv, signal_read,
+    quiet, fence, barrier_all, barrier_pair, producer_noise."""
+    global _TRACER
+    _TRACER = tracer

@@ -9,6 +9,25 @@ import jax
 import numpy as np
 
 
+def interpret_race_state():
+    """Best-effort handle on the interpret-mode race detector's module-level
+    result state. This is a PRIVATE jax surface
+    (``jax._src.pallas.mosaic.interpret.interpret_pallas_call``) that tests
+    use to assert the ``TDT_DETECT_RACES`` plumbing actually ran the
+    detector; a jax upgrade may move or rename it at any time. Returns the
+    object exposing ``.races`` (``None`` until a detection pass ran, then a
+    result with ``.races_found``), or ``None`` when the private layout is
+    gone — callers should skip with a reason, not fail."""
+    try:
+        from jax._src.pallas.mosaic.interpret import (
+            interpret_pallas_call as ipc)
+    except ImportError:
+        return None
+    if not hasattr(ipc, "races"):
+        return None
+    return ipc
+
+
 def dist_print(*args, allowed_ranks="all", prefix: bool = False, file=None,
                **kwargs):
     """Print from one or more host processes. In single-controller jax there
