@@ -1485,6 +1485,116 @@ def bench_serving_sharded(ctx, num_requests: int = 24, num_slots: int = 4,
     }
 
 
+def bench_cluster(ctx, num_requests: int = 2000, templates: int = 32,
+                  zipf: float = 1.1, max_new: int = 8, num_slots: int = 8,
+                  page_size: int = 8, num_pages: int = 48,
+                  pages_per_seq: int = 8) -> dict:
+    """Cluster serving rows (ISSUE 12): the deterministic prefix-affinity
+    router over N ``SimEngine`` replicas on a Zipf template workload —
+    ``cluster_tok_per_s`` / ``cluster_ttft_p50_us`` / ``cluster_ttft_p99_us``
+    per replica count in {1, 2, 4}, EVERY trace asserted bit-identical to
+    the closed-form ``expected_tokens`` golden (a scaling row that changed
+    tokens would be pricing a broken router), plus ``cluster_failover_us``:
+    wall time of a full kill → journal-reload → fresh-engine →
+    checkpoint-restore → replay cycle on the 4-replica cluster.
+
+    The SimEngine is the honest vehicle here: the rows price the CONTROL
+    plane (routing, admission, paged growth/preemption, journaling,
+    harvest) without the device dispatch noise — exactly what changes
+    with replica count. Knobs mirror ``scripts/cluster_sim.py``.
+    """
+    import numpy as _np
+
+    from triton_dist_tpu.serving import (Cluster, SimEngine,
+                                         expected_tokens)
+
+    rng0 = _np.random.RandomState(0)
+    max_plen = pages_per_seq * page_size - max_new
+    tpls = [rng0.randint(1, 32000,
+                         size=int(rng0.randint(3, min(max_plen - 4, 17)))
+                         ).tolist()
+            for _ in range(templates)]
+    ranks = _np.arange(1, templates + 1, dtype=_np.float64)
+    zp = ranks ** -zipf
+    zp /= zp.sum()
+
+    def _workload():
+        rng = _np.random.RandomState(1)
+        out = []
+        for _ in range(num_requests):
+            t = int(rng.choice(templates, p=zp))
+            tail = rng.randint(1, 32000,
+                               size=int(rng.randint(1, 5))).tolist()
+            out.append(((tpls[t] + tail)[:max_plen],
+                        int(rng.randint(2, max_new + 1))))
+        return out
+
+    def factory(journal):
+        return SimEngine(num_slots=num_slots, page_size=page_size,
+                         num_pages=num_pages, pages_per_seq=pages_per_seq,
+                         journal=journal)
+
+    rows = {}
+    for n_rep in (1, 2, 4):
+        cl = Cluster(factory, replicas=n_rep)
+        reqs = {}
+        arrive = 2 * n_rep
+        t0 = time.perf_counter()
+        for i, (prompt, mnt) in enumerate(_workload()):
+            reqs[cl.submit(prompt, mnt)] = (prompt, mnt)
+            if i % arrive == arrive - 1:
+                cl.step()
+        res = cl.drain()
+        wall = time.perf_counter() - t0
+        assert len(res) == num_requests and not cl.failed_gids
+        for gid, toks in res.items():
+            assert toks == expected_tokens(*reqs[gid]), (
+                f"gid {gid} diverged from the closed-form golden at "
+                f"{n_rep} replicas — the router added nondeterminism")
+        ttft = cl.metrics.hist["ttft_s"]
+        toks_total = sum(len(t) for t in res.values())
+        rows[f"replicas={n_rep}"] = {
+            "cluster_tok_per_s": round(toks_total / wall, 1),
+            "cluster_ttft_p50_us": round(
+                (ttft.percentile(50) or 0.0) * 1e6, 1),
+            "cluster_ttft_p99_us": round(
+                (ttft.percentile(99) or 0.0) * 1e6, 1),
+        }
+
+    # failover: kill replica 1 mid-run on the 4-replica cluster (journals
+    # on disk this time — the reload path is part of what's being timed),
+    # run a while longer, then time the restore ladder end to end
+    import tempfile as _tf
+    with _tf.TemporaryDirectory(prefix="bench-cluster-") as jdir:
+        cl = Cluster(factory, replicas=4, journal_dir=jdir)
+        reqs = {}
+        failover_s = None
+        for i, (prompt, mnt) in enumerate(_workload()):
+            reqs[cl.submit(prompt, mnt)] = (prompt, mnt)
+            if i == num_requests // 2:
+                cl.kill(1)
+            if i == num_requests // 2 + num_requests // 10:
+                tk = time.perf_counter()
+                stats = cl.restore(1)
+                failover_s = time.perf_counter() - tk
+            if i % 8 == 7:
+                cl.step()
+        res = cl.drain()
+        assert len(res) == num_requests and not cl.failed_gids
+        for gid, toks in res.items():
+            assert toks == expected_tokens(*reqs[gid]), (
+                f"gid {gid} diverged across the kill/restore cycle")
+    return {
+        "cluster": rows,
+        "cluster_failover_us": round(failover_s * 1e6, 1),
+        "cluster_failover_replayed": stats["replayed"],
+        "cluster_knobs": {
+            "num_requests": num_requests, "templates": templates,
+            "zipf": zipf, "num_slots": num_slots,
+            "page_size": page_size, "num_pages": num_pages},
+    }
+
+
 # --- EP-dispatch wire model (the DeepEP-comparison analog) -----------------
 #
 # The reference's headline 137 µs dispatch (README.md:55) is 32 H800 ranks,
@@ -1781,6 +1891,14 @@ def main(a2a_primary: bool = False):
             **(dict(num_requests=24) if on_cpu() else {})))
 
     attempt("serving_sharded", _serving_sharded)
+
+    def _cluster():
+        # router + replica control plane vs replica count, and the full
+        # kill/restore failover cycle, all bit-identity-asserted against
+        # the closed-form SimEngine golden (ISSUE 12)
+        extras.update(bench_cluster(ctx))
+
+    attempt("cluster", _cluster)
 
     def _attn():
         ash = dict(s_loc=256, Hq=4, Hkv=2) if on_cpu() else {}

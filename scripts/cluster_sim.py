@@ -1,0 +1,219 @@
+"""Cluster-scale serving simulation (ISSUE 12 rung 3): a deterministic
+prefix-affinity router over N engine replicas, driven by a Zipf workload
+of hundreds of thousands of requests, with a mid-run replica kill +
+restore through the crash-consistency ladder — and EVERY surviving
+request's trace verified bit-identical to its single-replica golden.
+
+    python scripts/cluster_sim.py                          # 100k over 4
+    python scripts/cluster_sim.py --requests 250000 --replicas 8
+    python scripts/cluster_sim.py --requests 200 --engine colocated
+    python scripts/cluster_sim.py --no-kill                # fault-free
+
+The default engine is ``SimEngine`` (serving/cluster.py): the REAL page
+ledger / scheduler / journal / checkpoint control plane with a closed-
+form token function, so the workload exercises admission, growth-driven
+preemption, routing, journaling and kill/restore at a scale the device
+engines cannot reach on CPU — and ``expected_tokens`` IS the golden, no
+second run needed. ``--engine colocated`` swaps in the real jitted
+``ServingEngine`` (tiny Llama) for a small-scale cross-check that the
+replica/router layer is engine-agnostic; goldens then come from a
+single-replica reference run of the same engine configuration.
+
+Workload: ``--templates`` distinct prompt prefixes, Zipf-ranked
+(``--zipf``), each request = template prefix + a unique tail. The router
+hashes the first 8 tokens, so one template's requests land on one
+replica (KV locality) until it dies — rendezvous hashing then moves only
+its keys. Prints one JSON summary line: aggregate tok/s, TTFT p50/p99,
+per-replica placement, failover timing, verification counts.
+"""
+import argparse
+import json
+import sys
+import tempfile
+import time
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+p.add_argument("--requests", type=int, default=100_000,
+               help="total requests to route through the cluster")
+p.add_argument("--replicas", type=int, default=4)
+p.add_argument("--engine", choices=("sim", "colocated"), default="sim",
+               help="'sim' = host-only SimEngine (scale); 'colocated' = "
+                    "the real jitted ServingEngine (small cross-check)")
+p.add_argument("--slots", type=int, default=8, help="slots per replica")
+p.add_argument("--page-size", type=int, default=8)
+p.add_argument("--pages", type=int, default=48,
+               help="usable KV pool pages per replica")
+p.add_argument("--pages-per-seq", type=int, default=8)
+p.add_argument("--templates", type=int, default=64,
+               help="distinct Zipf-ranked prompt prefixes")
+p.add_argument("--zipf", type=float, default=1.1,
+               help="Zipf exponent over the templates")
+p.add_argument("--max-new", type=int, default=8,
+               help="decode budget per request (uniform 2..max-new)")
+p.add_argument("--arrive-per-step", type=int, default=None,
+               help="requests submitted per cluster step (default: "
+                    "2 per replica)")
+p.add_argument("--seed", type=int, default=0)
+p.add_argument("--journal-dir", default=None,
+               help="directory for the per-replica journal-r{i}.jsonl "
+                    "files (default: a fresh temp dir)")
+p.add_argument("--checkpoint-every", type=int, default=0, metavar="N",
+               help="checkpoint cadence in engine steps; 0 (default) "
+                    "cuts NO checkpoints — the restore then replays the "
+                    "ENTIRE journal (the slowest, most honest rung)")
+p.add_argument("--kill-at", type=int, default=None, metavar="REQ",
+               help="kill a replica after this many submissions "
+                    "(default: requests // 2); --no-kill disables")
+p.add_argument("--restore-after", type=int, default=None, metavar="REQ",
+               help="restore it after this many further submissions "
+                    "(default: requests // 10)")
+p.add_argument("--kill-replica", type=int, default=1, metavar="I")
+p.add_argument("--no-kill", action="store_true",
+               help="fault-free run (no kill/restore cycle)")
+args = p.parse_args()
+
+kill_at = args.kill_at if args.kill_at is not None else args.requests // 2
+restore_after = (args.restore_after if args.restore_after is not None
+                 else max(args.requests // 10, 1))
+arrive = args.arrive_per_step or 2 * args.replicas
+ckpt_every = args.checkpoint_every or None
+
+from triton_dist_tpu.serving.cluster import (Cluster, SimEngine,  # noqa: E402
+                                             expected_tokens)
+
+if args.engine == "sim":
+    VOCAB = 32000
+
+    def factory(journal):
+        return SimEngine(num_slots=args.slots, page_size=args.page_size,
+                         num_pages=args.pages,
+                         pages_per_seq=args.pages_per_seq,
+                         journal=journal, checkpoint_every=ckpt_every)
+
+    def golden(prompt, mnt):
+        return expected_tokens(prompt, mnt)
+else:
+    # the real jitted engine, replica/router layer unchanged. Goldens
+    # come from one single-replica reference engine fed every request —
+    # the engine's own determinism contract (tokens are a pure function
+    # of (params, prompt)) makes per-request traces placement-invariant.
+    import jax  # noqa: E402
+
+    from triton_dist_tpu.models.llama import LlamaConfig, init_params  # noqa: E402
+    from triton_dist_tpu.serving import ServingEngine  # noqa: E402
+
+    cfg = LlamaConfig.tiny(n_layers=2)
+    params = init_params(jax.random.PRNGKey(args.seed), cfg)
+    VOCAB = cfg.vocab_size
+
+    def factory(journal):
+        return ServingEngine(params, cfg, num_slots=args.slots,
+                             page_size=args.page_size,
+                             num_pages=args.pages,
+                             pages_per_seq=args.pages_per_seq,
+                             prefill_chunk=args.page_size,
+                             journal=journal, checkpoint_every=ckpt_every)
+
+    _ref = ServingEngine(params, cfg, num_slots=args.slots,
+                         page_size=args.page_size, num_pages=args.pages,
+                         pages_per_seq=args.pages_per_seq,
+                         prefill_chunk=args.page_size)
+    _ref_cache: dict = {}
+
+    def golden(prompt, mnt):
+        key = (tuple(prompt), mnt)
+        if key not in _ref_cache:
+            rid = _ref.submit(prompt, mnt)
+            out = _ref.run(max_steps=200_000)
+            _ref_cache[key] = out[rid]
+        return _ref_cache[key]
+
+rng = np.random.RandomState(args.seed)
+max_plen = args.pages_per_seq * args.page_size - args.max_new
+tpl_lens = rng.randint(3, max(4, min(max_plen - 4, 17)),
+                       size=args.templates)
+templates = [rng.randint(1, VOCAB, size=int(n)).tolist()
+             for n in tpl_lens]
+ranks = np.arange(1, args.templates + 1, dtype=np.float64)
+zipf_p = ranks ** -args.zipf
+zipf_p /= zipf_p.sum()
+
+journal_dir = args.journal_dir or tempfile.mkdtemp(prefix="cluster-sim-")
+cluster = Cluster(factory, replicas=args.replicas, journal_dir=journal_dir)
+
+reqs: dict[int, tuple[list[int], int]] = {}
+killed_step = restored_step = None
+failover_s = None
+t0 = time.perf_counter()
+submitted = 0
+while submitted < args.requests:
+    burst = min(arrive, args.requests - submitted)
+    for _ in range(burst):
+        t = int(rng.choice(args.templates, p=zipf_p))
+        tail = rng.randint(1, VOCAB,
+                           size=int(rng.randint(1, 5))).tolist()
+        prompt = (templates[t] + tail)[:max_plen]
+        mnt = int(rng.randint(2, args.max_new + 1))
+        gid = cluster.submit(prompt, mnt)
+        reqs[gid] = (prompt, mnt)
+        submitted += 1
+        if not args.no_kill and submitted == kill_at:
+            cluster.kill(args.kill_replica)
+            killed_step = submitted
+            tk = time.perf_counter()
+        if (not args.no_kill and killed_step is not None
+                and restored_step is None
+                and submitted == kill_at + restore_after):
+            stats = cluster.restore(args.kill_replica)
+            restored_step = submitted
+            failover_s = time.perf_counter() - tk
+            print(json.dumps({"restore": stats,
+                              "failover_us": round(failover_s * 1e6, 1)}),
+                  file=sys.stderr)
+    cluster.step()
+results = cluster.drain()
+wall = time.perf_counter() - t0
+
+# -- verification: every surviving trace vs its single-replica golden ----
+missing = sorted(set(reqs) - set(results) - cluster.failed_gids)
+mismatched = [g for g, toks in results.items()
+              if toks != golden(*reqs[g])]
+ok = not missing and not mismatched
+
+per_replica = [0] * args.replicas
+for gid, (ri, _) in cluster._placement.items():
+    per_replica[ri] += 1
+toks_total = sum(len(t) for t in results.values())
+ttft = cluster.metrics.hist["ttft_s"]
+us = lambda v: None if v is None else round(v * 1e6, 1)  # noqa: E731
+print(json.dumps({
+    "engine": args.engine,
+    "replicas": args.replicas,
+    "requests": args.requests,
+    "finished": len(results),
+    "failed": len(cluster.failed_gids),
+    "verified_bit_identical": len(results) - len(mismatched),
+    "mismatched": len(mismatched),
+    "missing": len(missing),
+    "wall_s": round(wall, 3),
+    "agg_tok_per_s": round(toks_total / wall, 1) if wall else None,
+    "ttft_p50_us": us(ttft.percentile(50)),
+    "ttft_p99_us": us(ttft.percentile(99)),
+    "per_replica_requests": per_replica,
+    "kill": None if args.no_kill else {
+        "replica": args.kill_replica, "at_request": killed_step,
+        "restored_at_request": restored_step,
+        "failover_us": None if failover_s is None
+        else round(failover_s * 1e6, 1)},
+    "journal_dir": journal_dir,
+}))
+if not ok:
+    print(json.dumps({"error": "trace verification failed",
+                      "missing": missing[:10],
+                      "mismatched": mismatched[:10]}), file=sys.stderr)
+    sys.exit(1)

@@ -8,6 +8,7 @@ matching knobs (--slots/--page-size/--layers mirror bench_serving's).
     python scripts/serve_sim.py --sim 50
     python scripts/serve_sim.py --sim 20 --slots 8 --pages 12  # preempts
     python scripts/serve_sim.py --sim 20 --model moe --mesh 1x2x2
+    python scripts/serve_sim.py --sim 20 --disagg --mesh 1x2x1  # composed
     python scripts/serve_sim.py --sim 30 --crash-at 25 --recover  # ISSUE 9
     python scripts/serve_sim.py --sim 40 --queue-cap 6 --ttl 50  # overload
 
@@ -72,7 +73,9 @@ p.add_argument("--mesh", default=None, metavar="TPxSPxEP",
                     "2x2x2 (implies --model moe; spins up tp*sp*ep "
                     "virtual CPU devices when hardware has fewer; "
                     "--prefill-chunk defaults to 8 — the sharded engine "
-                    "REQUIRES the chunked path)")
+                    "REQUIRES the chunked path). Combine with --disagg "
+                    "for the COMPOSED engine: disaggregated prefill "
+                    "feeding a sharded decode fleet on this one mesh")
 p.add_argument("--wire", choices=("auto", "fp8", "none"), default="auto",
                help="A2A wire dtype for --mesh: 'auto' (wire-fit driven, "
                     "resolves PER RANK COUNT), 'fp8' (pinned e4m3 — use "
@@ -119,12 +122,6 @@ if args.mesh is not None:
     args.model = "moe"
 elif args.model == "moe":
     args.mesh = "1x1x1"
-if args.mesh is not None and args.disagg:
-    # the SP-sharded pool owns page placement; disaggregation's page
-    # migration is a different (single-axis) pool contract — refused,
-    # see docs/serving.md "Sharded serving"
-    p.error("--mesh and --disagg are mutually exclusive")
-
 if args.prefill_buckets == "pow2":
     buckets = "pow2"
 elif args.prefill_buckets == "exact":
@@ -132,15 +129,17 @@ elif args.prefill_buckets == "exact":
 else:
     buckets = tuple(int(b) for b in args.prefill_buckets.split(","))
 
-if args.disagg:
+if args.mesh is not None:
+    # with --disagg on top, the composed engine runs BOTH fleets on this
+    # one mesh (ISSUE 12) — the device count is still tp*sp*ep
+    tp, sp, ep = (int(d) for d in args.mesh.lower().split("x"))
+    from triton_dist_tpu.utils.env import force_virtual_cpu_devices  # noqa: E402
+    force_virtual_cpu_devices(tp * sp * ep)
+elif args.disagg:
     # the role mesh needs 2 ranks; on fewer (e.g. plain-CPU jax) fall
     # back to the 2-device virtual CPU simulator — real chips are kept
     from triton_dist_tpu.utils.env import force_virtual_cpu_devices  # noqa: E402
     force_virtual_cpu_devices(2)
-elif args.mesh is not None:
-    tp, sp, ep = (int(d) for d in args.mesh.lower().split("x"))
-    from triton_dist_tpu.utils.env import force_virtual_cpu_devices  # noqa: E402
-    force_virtual_cpu_devices(tp * sp * ep)
 
 if args.model == "moe":
     from triton_dist_tpu.models.moe import MoEConfig, init_moe_params  # noqa: E402
@@ -184,7 +183,26 @@ def mk_engine(fresh=False):
                   decode_horizon=args.decode_horizon, journal=journal,
                   checkpoint_every=ckpt_every, queue_cap=args.queue_cap,
                   ttl_steps=args.ttl, fault_plan=_fault_plan())
-    if args.mesh is not None:
+    if args.mesh is not None and args.disagg:
+        # ISSUE 12: the composed engine — disaggregated prefill feeding a
+        # ShardedServingEngine decode fleet on ONE TP/SP/EP mesh (the
+        # unified pool contract made the old mutual exclusion obsolete)
+        import jax.numpy as jnp  # noqa: E402
+
+        from triton_dist_tpu.serving import (DisaggShardedEngine,  # noqa: E402
+                                             serving_mesh)
+        wire = {"auto": "auto", "fp8": jnp.float8_e4m3fn,
+                "none": None}[args.wire]
+        eng = DisaggShardedEngine(params, cfg, serving_mesh(tp, sp, ep),
+                                  prefill_chunk=args.prefill_chunk or 8,
+                                  wire_dtype=wire, **common)
+        if not fresh:
+            print(json.dumps({"mesh": eng.mesh_desc, "disagg": True,
+                              "wire": eng.wire_dtype}), file=sys.stderr)
+        if args.chaos is not None and not fresh:
+            print(json.dumps({"chaos": eng._fault_plan.describe()}),
+                  file=sys.stderr)
+    elif args.mesh is not None:
         import jax.numpy as jnp  # noqa: E402
 
         from triton_dist_tpu.serving import (ShardedServingEngine,  # noqa: E402

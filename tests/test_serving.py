@@ -138,6 +138,84 @@ def test_pool_deterministic_replay():
 
 
 # ---------------------------------------------------------------------------
+# ONE pool contract (ISSUE 12): SP-sharded AND migratable, same ledger
+# ---------------------------------------------------------------------------
+
+def test_pool_sp_padding_never_migratable():
+    """An SP-aware pool pads the DEVICE array to a multiple of sp_ranks
+    but the allocator never hands the pad ids out — and
+    ``check_migratable`` refuses them loudly, so no migration can land
+    KV in a padding slot no block table will ever expose."""
+    pool = KVPagePool(num_pages=10, page_size=8, reserved=1, sp_ranks=4)
+    assert pool.device_pages == 12                  # 10 padded up to 12
+    got = pool.alloc("a", 3)
+    pool.check_migratable("a", got)                 # real pages pass
+    for pad_id in (10, 11):                         # the two padding slots
+        with pytest.raises(PageLedgerError, match="padding"):
+            pool.check_migratable("a", [pad_id])
+    with pytest.raises(PageLedgerError, match="padding"):
+        pool.check_migratable("a", [12])            # out of range entirely
+    # the shard map covers the PADDED range: every device page has a home
+    assert [pool.page_shard(p) for p in (0, 2, 3, 5, 6, 8, 9, 11)] == \
+        [0, 0, 1, 1, 2, 2, 3, 3]
+    with pytest.raises(PageLedgerError, match="outside"):
+        pool.page_shard(12)
+
+
+@pytest.mark.parametrize("sp_ranks", [1, 2, 4])
+def test_pool_digest_layout_independent_across_sp_ranks(sp_ranks):
+    """The FNV-1a control digest hashes page OWNERSHIP, not device
+    layout: the same alloc / landed_row / free_tail trace digests
+    identically at every sp_ranks — which is what lets the sharded
+    engine's replicated-decision guard and the disagg journal compare
+    digests across differently-laid-out pools."""
+    def trace(n_sp):
+        p = KVPagePool(num_pages=10, page_size=8, reserved=1,
+                       sp_ranks=n_sp)
+        a = p.alloc("a", 4)
+        p.alloc("b", 2)
+        out = [p.digest()]
+        assert p.landed_row("a", set(a[:2]), 6) == a[:2] + [0] * 4
+        p.free_tail("a", keep=2)
+        p.free_seq("b")
+        out.append(p.digest())
+        out.append(p.snapshot())
+        return out
+    assert trace(sp_ranks) == trace(1)
+
+
+def test_pool_free_tail_after_cross_mesh_migration():
+    """The disagg-on-sharded handoff shape (compose.py): pages migrate
+    from a prefill-side ledger into an SP-sharded decode-side ledger,
+    then the SOURCE is partially reclaimed mid-prefill (free_tail). Both
+    ledgers must stay audit-clean and the destination's landed_row must
+    expose exactly the migrated prefix."""
+    src = KVPagePool(num_pages=10, page_size=8, reserved=1, sp_ranks=2)
+    dst = KVPagePool(num_pages=10, page_size=8, reserved=1, sp_ranks=4)
+    s = src.alloc("r", 4)
+    d = dst.alloc("r", 4)                   # remote reservation at admit
+    src.check_migratable("r", s[:2])        # chunk 0 finalized 2 pages
+    dst.check_migratable("r", d[:2])
+    covered = set(d[:2])                    # ...and their signals fired
+    assert dst.landed_row("r", covered, 6) == d[:2] + [0] * 4
+    # mid-prefill preemption on the source: keep the 2 migrated pages
+    freed = src.free_tail("r", keep=2)
+    assert freed == 2 and src.pages_of("r") == s[:2]
+    src.check()
+    dst.check()
+    # the already-migrated pages are still re-sendable (retry rung)...
+    src.check_migratable("r", s[:2])
+    # ...but the freed tail is not: those ids went back to the free list
+    with pytest.raises(PageLedgerError, match="foreign"):
+        src.check_migratable("r", s[2:])
+    # full reclaim on finish frees the reservation on both sides
+    src.free_seq("r")
+    dst.free_seq("r")
+    assert src.free_pages == src.num_pages - src.reserved
+    assert dst.landed_row("r", covered, 6) == [0] * 6
+
+
+# ---------------------------------------------------------------------------
 # scheduler invariants
 # ---------------------------------------------------------------------------
 

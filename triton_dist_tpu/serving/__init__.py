@@ -11,6 +11,13 @@ EP/SP overlap ops (see docs/serving.md).
                kernel (signal-gated admission + the ISSUE-7 recovery
                ladder: deadline → retry/backoff → local re-prefill →
                typed per-request failure)
+- compose    — disagg × sharded (ISSUE 12): a disaggregated prefill fleet
+               feeding a ShardedServingEngine decode fleet on ONE
+               TP/SP/EP mesh, over the unified pool contract
+- cluster    — N engine replicas behind a deterministic prefix-affinity
+               router, each with a private path-namespaced journal and
+               kill/restore through the ISSUE-9 ladder; SimEngine is the
+               host-only scale vehicle (scripts/cluster_sim.py)
 - deadline   — Deadline/Backoff helpers + EngineStallError (the global
                progress watchdog both engines share)
 - journal    — append-only WAL of control-plane events (ISSUE 9)
@@ -22,6 +29,10 @@ EP/SP overlap ops (see docs/serving.md).
 from triton_dist_tpu.serving.checkpoint import (Checkpoint,
                                                 CheckpointIntegrityError,
                                                 capture, latest, restore)
+from triton_dist_tpu.serving.cluster import (Cluster, EngineReplica,
+                                             SimEngine, expected_tokens,
+                                             sim_token)
+from triton_dist_tpu.serving.compose import DisaggShardedEngine
 from triton_dist_tpu.serving.deadline import (Backoff, Deadline,
                                               EngineStallError)
 from triton_dist_tpu.serving.disagg import (ChunkSignalLedger,
@@ -32,8 +43,9 @@ from triton_dist_tpu.serving.disagg import (ChunkSignalLedger,
 from triton_dist_tpu.serving.engine import ServingEngine
 from triton_dist_tpu.serving.journal import EVENT_KINDS, ControlJournal
 from triton_dist_tpu.serving.kv_pool import (KVPagePool, PageLedgerError,
-                                             cache_to_pages,
-                                             page_pool_pspec, pages_to_cache)
+                                             cache_to_pages, page_pool_pspec,
+                                             pages_to_cache,
+                                             shard_pool_arrays)
 from triton_dist_tpu.serving.metrics import Histogram, ServingMetrics
 from triton_dist_tpu.serving.scheduler import (AdmissionRejected,
                                                ContinuousBatchingScheduler,
@@ -51,6 +63,13 @@ __all__ = [
     "serving_mesh",
     "MESH_AXES",
     "DisaggServingEngine",
+    "DisaggShardedEngine",
+    "Cluster",
+    "EngineReplica",
+    "SimEngine",
+    "expected_tokens",
+    "sim_token",
+    "shard_pool_arrays",
     "PageMigrationChannel",
     "ChunkSignalLedger",
     "MigrationSignalTimeout",

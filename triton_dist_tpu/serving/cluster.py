@@ -1,0 +1,612 @@
+"""Cluster serving (ISSUE 12 rungs 2+3): replicas + a deterministic
+router.
+
+The reference's L7 seam — "user code" above the overlap library — is
+where serving becomes a FLEET problem: N independent engine replicas
+behind a router, each replica its own failure domain (ISSUE 7) with its
+own crash-consistency journal (ISSUE 9). This module supplies the two
+host-side abstractions:
+
+- :class:`EngineReplica` wraps ANY of the serving engines (colocated,
+  disagg, sharded, composed, or the host-only :class:`SimEngine`) with a
+  PRIVATE, path-namespaced journal (``journal-r{i}.jsonl`` — N replicas
+  sharing one ``ControlJournal`` path would interleave their entries and
+  cross-replay each other's requests on restore), load/occupancy/queue-
+  depth signals read duck-typed off the engine's intake scheduler and
+  pool ledger, and a ``kill()``/``restore()`` pair that drives the full
+  ISSUE 9 recovery ladder: reload the journal from disk, rebuild a fresh
+  engine, restore from the newest checkpoint (or replay the whole
+  journal when none was cut), re-attach the append handle.
+- :class:`Cluster` routes by **prefix affinity with a least-loaded
+  tie-break**, rendezvous style: every alive replica scores
+  ``fnv1a(index, prompt[:prefix_tokens])`` and the highest score wins,
+  so a shared prompt prefix lands on the same replica (KV/page locality)
+  WITHOUT a routing table — and when a replica dies, only its keys move
+  (classic highest-random-weight behaviour). Ties break to the least
+  loaded then the lowest index; an optional spill threshold diverts from
+  a hot affinity target to the least-loaded replica. Everything is a
+  pure function of (alive set, prompt, load) — the router adds no
+  nondeterminism, which is what lets cluster traces be verified
+  bit-identically against single-replica goldens.
+
+:class:`SimEngine` is the scale vehicle: a host-only engine with the
+REAL page ledger, the REAL scheduler (admission tickets, strict-FIFO
+head-of-line, growth-driven preemption, queue caps, TTLs) and the real
+journal/checkpoint surface, but a closed-form token function instead of
+device dispatches — ``sim_token(prompt, i)``, a pure function of the
+prompt and the token index, exactly the determinism contract the device
+engines pin (tokens are a function of (params, prompt) — here params
+degenerate to the hash seed). ``expected_tokens`` is therefore the
+single-replica golden in closed form, and ``scripts/cluster_sim.py``
+checks hundreds of thousands of routed, preempted, killed-and-restored
+requests against it bitwise.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+
+import numpy as np
+
+from triton_dist_tpu.serving import checkpoint as ckpt_mod
+from triton_dist_tpu.serving.deadline import Deadline
+from triton_dist_tpu.serving.engine import (mark_prefill_start,
+                                            record_first_token)
+from triton_dist_tpu.serving.journal import ControlJournal
+from triton_dist_tpu.serving.kv_pool import KVPagePool, _fnv1a
+from triton_dist_tpu.serving.metrics import ServingMetrics
+from triton_dist_tpu.serving.scheduler import (AdmissionRejected,
+                                               ContinuousBatchingScheduler,
+                                               Request, RequestState,
+                                               TtlExpired)
+from triton_dist_tpu.shmem import faults
+
+SIM_VOCAB = 32000
+
+
+def sim_token(prompt: tuple[int, ...], i: int, vocab: int = SIM_VOCAB
+              ) -> int:
+    """The SimEngine's "model": token ``i`` of a request is a pure
+    function of the prompt (first 8 tokens + length) and the index —
+    the same shape of determinism contract the device engines pin."""
+    return _fnv1a(0x811C9DC5, *prompt[:8], len(prompt), i) % vocab
+
+
+def expected_tokens(prompt, max_new_tokens: int, vocab: int = SIM_VOCAB
+                    ) -> list[int]:
+    """Closed-form single-replica golden for a SimEngine request."""
+    prompt = tuple(int(t) for t in prompt)
+    return [sim_token(prompt, i, vocab) for i in range(max_new_tokens)]
+
+
+class SimEngine:
+    """Host-only serving engine: real control plane (page ledger,
+    scheduler, journal, checkpoints, TTL/queue-cap shedding, growth-
+    driven preemption), closed-form tokens (``sim_token``) instead of
+    device dispatches. One token per ACTIVE slot per step; "prefill" is
+    instantaneous at admission (the first token appears the admitting
+    step, exactly like a one-chunk prompt). Exposes the same duck-typed
+    surface ``serving/checkpoint.py`` restores through, so an
+    :class:`EngineReplica` can kill/restore it like the device engines.
+    """
+
+    def __init__(self, num_slots: int = 4, page_size: int = 16,
+                 num_pages: int = 64, pages_per_seq: int = 8,
+                 metrics: ServingMetrics | None = None,
+                 eos_id: int | None = None, vocab: int = SIM_VOCAB,
+                 journal: ControlJournal | None = None,
+                 checkpoint_every: int | None = None,
+                 queue_cap: int | None = None,
+                 ttl_steps: int | None = None,
+                 fault_plan: "faults.FaultPlan | None" = None):
+        assert checkpoint_every is None or journal is not None
+        self.page_size = page_size
+        self.pages_per_seq = pages_per_seq
+        self.num_slots = num_slots
+        self.eos_id = eos_id
+        self.vocab = vocab
+        self.metrics = metrics or ServingMetrics()
+        self.alloc = KVPagePool(num_pages + 1, page_size, reserved=1)
+        self.sched = ContinuousBatchingScheduler(num_slots,
+                                                 queue_cap=queue_cap)
+        self.journal = journal
+        self.checkpoint_every = checkpoint_every
+        self.ttl_steps = ttl_steps
+        self._fault_plan = fault_plan
+        self._journal_muted = False
+        self._replaying = False
+        self._incarnation = 0
+        self._last_ckpt_step = -1
+        self._finished: list[Request] = []
+        self._failed: list[Request] = []
+        self._rejected: list[Request] = []
+        self._next_rid = 0
+        self._steps = 0
+
+    # -- intake (device engines' contract verbatim) ------------------------
+    def submit(self, prompt, max_new_tokens: int, rid: int | None = None
+               ) -> int:
+        prompt = tuple(int(t) for t in np.asarray(prompt).reshape(-1))
+        assert prompt and max_new_tokens >= 1
+        total = len(prompt) + max_new_tokens - 1
+        need = -(-total // self.page_size)
+        assert need <= self.pages_per_seq, (
+            f"request needs {need} pages > pages_per_seq "
+            f"{self.pages_per_seq}")
+        assert need <= self.alloc.num_pages - self.alloc.reserved
+        if rid is None:
+            rid = self._next_rid
+        self._next_rid = max(self._next_rid, rid + 1)
+        req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
+                      eos_token=self.eos_id, submit_step=self._steps,
+                      submit_time=time.perf_counter())
+        self.metrics.inc("requests_submitted")
+        if self.sched.at_capacity and not self._replaying:
+            req.state = RequestState.REJECTED
+            req.failure = AdmissionRejected(
+                f"admission queue full (cap {self.sched.queue_cap}) — "
+                f"request {rid} rejected")
+            self._rejected.append(req)
+            self.metrics.inc("rejections")
+            self._jlog("reject", rid=rid, reason=str(req.failure))
+            return rid
+        if self.ttl_steps is not None:
+            req.deadline = Deadline(self.ttl_steps, req.submit_step)
+        self.sched.submit(req)
+        self._jlog("submit", rid=rid, prompt=list(prompt),
+                   max_new_tokens=max_new_tokens)
+        return rid
+
+    # -- one step ----------------------------------------------------------
+    @property
+    def idle(self) -> bool:
+        return self.sched.idle
+
+    def step(self) -> bool:
+        if self.ttl_steps is not None:
+            self._expire_queued()
+        progressed = self._step_impl()
+        if progressed:
+            self._maybe_checkpoint()
+        return progressed
+
+    def _can_hold(self, req: Request) -> bool:
+        need = -(-len(req.prompt) // self.page_size)
+        need -= len(self.alloc.pages_of(req.rid))
+        return self.alloc.free_pages >= max(need, 0)
+
+    def _step_impl(self) -> bool:
+        if self.sched.idle:
+            return False
+        # admissions: instant "prefill" — first token the admitting step
+        while True:
+            adm = self.sched.admissible(self._can_hold)
+            if adm is None:
+                break
+            slot, req = adm
+            need = -(-len(req.prompt) // self.page_size)
+            have = len(self.alloc.pages_of(req.rid))
+            if need > have:
+                got = self.alloc.alloc(req.rid, need - have)
+                assert got is not None
+            self.sched.activate(slot, req)
+            self._jlog("admit", rid=req.rid, slot=slot)
+            req.state = RequestState.PREFILLING
+            mark_prefill_start(req, self.metrics, self._steps)
+            self.metrics.inc("prefills")
+            self.metrics.inc("prefill_chunks")
+            req.prefill_cursor = len(req.prompt)
+            req.state = RequestState.ACTIVE
+            req.first_token = sim_token(req.prompt, 0, self.vocab)
+            req.generated.append(req.first_token)
+            record_first_token(req, self.metrics, self._steps)
+            self.metrics.inc("tokens_generated")
+            if req.done:
+                self._finish(slot)
+        # growth + decode: one token per ACTIVE slot, paged growth with
+        # the real eviction ladder when the pool runs dry. Token i's KV
+        # lands at position len(prompt)+i and the LAST token's KV is
+        # never written (the request finishes on emission) — so the max
+        # footprint is len(prompt)+max_new_tokens-1, the submit() bound.
+        for slot in range(self.num_slots):
+            req = self.sched.slots[slot]
+            if req is None or req.state is not RequestState.ACTIVE:
+                continue
+            kv_len = len(req.prompt) + len(req.generated)
+            ok = self.alloc.ensure(req.rid, kv_len)
+            while not ok:
+                victim = self.sched.pick_victim(exclude_slot=slot)
+                if victim is None:
+                    break   # nobody to evict — this slot waits a step
+                self._preempt(victim)
+                ok = self.alloc.ensure(req.rid, kv_len)
+            if not ok:
+                continue
+            req.generated.append(
+                sim_token(req.prompt, len(req.generated), self.vocab))
+            self.metrics.inc("tokens_generated")
+            self.metrics.inc("decode_steps")
+            if req.done:
+                self._finish(slot)
+        self.metrics.observe("queue_depth", self.sched.queue_depth)
+        self.metrics.observe("pool_occupancy", self.alloc.occupancy())
+        self._steps += 1
+        return True
+
+    def _finish(self, slot: int) -> None:
+        req = self.sched.finish(slot)
+        self.alloc.free_seq(req.rid)
+        req.finish_step = self._steps
+        self._finished.append(req)
+        self.metrics.inc("requests_finished")
+        self._jlog("finish", rid=req.rid, tokens=list(req.generated),
+                   submit_step=req.submit_step,
+                   first_token_step=req.first_token_step,
+                   preemptions=req.preemptions)
+
+    def _preempt(self, slot: int) -> None:
+        req = self.sched.slots[slot]
+        self.alloc.free_seq(req.rid)
+        req.prefill_cursor = 0
+        req.first_token = None
+        self.sched.evict(slot)
+        self.metrics.inc("preemptions")
+        self._jlog("preempt", rid=req.rid, slot=slot)
+
+    def _expire_queued(self) -> None:
+        for req in self.sched.expire(self._steps):
+            req.failure = TtlExpired(
+                f"request {req.rid} queued past its TTL "
+                f"({self.ttl_steps} steps from step {req.submit_step}) "
+                "without admission")
+            self._rejected.append(req)
+            self.metrics.inc("expirations")
+            self._jlog("expire", rid=req.rid, reason=str(req.failure))
+
+    def run(self, max_steps: int | None = None, arrivals=None,
+            recover=None) -> dict[int, list[int]]:
+        if recover:
+            assert self.journal is not None
+            ck = recover if isinstance(recover, ckpt_mod.Checkpoint) \
+                else ckpt_mod.latest(self.journal)
+            ckpt_mod.restore(self, ck, self.journal)
+        pending = deque(arrivals or [])
+        i = 0
+        while max_steps is None or i < max_steps:
+            while pending and pending[0][0] <= i:
+                _, prompt, mnt = pending.popleft()
+                self.submit(prompt, mnt)
+            if not self.step() and not pending:
+                break
+            i += 1
+            plan = self._fault_plan if self._fault_plan is not None \
+                else faults.active_plan()
+            if plan is not None and plan.crash(self._steps,
+                                               self._incarnation):
+                self.metrics.inc("faults_injected")
+                raise faults.InjectedCrash(
+                    f"injected crash at step {self._steps} "
+                    f"(incarnation {self._incarnation})")
+        return {req.rid: list(req.generated) for req in self._finished}
+
+    # -- crash consistency (checkpoint.py duck-typed surface) --------------
+    def control_digest(self) -> int:
+        # cheap by design: folded counters, not the full ledgers — at
+        # cluster_sim scale (100k+ requests) an O(pages+queue) digest per
+        # journal entry dominates the run. The checkpoint audit still
+        # hashes the REAL pool ledger (pool_digest below).
+        return _fnv1a(0x811C9DC5, self._steps, self._next_rid,
+                      self.alloc.used_pages, self.sched.queue_depth,
+                      self.sched._admit_ticket,
+                      self.metrics.counters["requests_finished"])
+
+    def _jlog(self, kind: str, **payload) -> None:
+        if self.journal is None or self._journal_muted:
+            return
+        self.journal.append(kind, self._steps, self.control_digest(),
+                            **payload)
+
+    def _maybe_checkpoint(self) -> None:
+        if (self.journal is None or not self.checkpoint_every
+                or self._steps == 0
+                or self._steps % self.checkpoint_every
+                or self._steps == self._last_ckpt_step):
+            return
+        self.checkpoint()
+
+    def checkpoint(self) -> "ckpt_mod.Checkpoint":
+        assert self.journal is not None
+        ck = ckpt_mod.capture(self)
+        self.journal.record_checkpoint(ck.step, ck.digest, ck.state,
+                                       ck.journal_seq)
+        self._last_ckpt_step = self._steps
+        self.metrics.inc("checkpoints")
+        return ck
+
+    def _capture_state(self) -> dict:
+        live: list[Request] = []
+        seen: set[int] = set()
+        for _, req in sorted(((r.admitted_seq, r)
+                              for _, r in self.sched.active),
+                             key=lambda t: t[0]):
+            seen.add(req.rid)
+            live.append(req)
+        for req in self.sched.queue:
+            if req.rid not in seen:
+                live.append(req)
+        return {
+            "engine": "sim",
+            "step": self._steps,
+            "next_rid": self._next_rid,
+            "admit_ticket": self.sched._admit_ticket,
+            "pool": self.alloc.snapshot(),
+            "pool_digest": self.alloc.digest(),
+            "live": [ckpt_mod.snapshot_request(r) for r in live],
+            "finished": [ckpt_mod.snapshot_finished(r)
+                         for r in self._finished],
+            "rejected": [{"rid": r.rid, "kind": "expire"
+                          if isinstance(r.failure, TtlExpired) else "reject",
+                          "reason": str(r.failure)} for r in self._rejected],
+            "counters": dict(self.metrics.counters),
+        }
+
+    def _restore_state(self, state: dict | None) -> None:
+        self.alloc = KVPagePool(self.alloc.num_pages, self.page_size,
+                                reserved=1)
+        self.sched = ContinuousBatchingScheduler(
+            self.sched.num_slots, queue_cap=self.sched.queue_cap)
+        self._finished = []
+        self._failed = []
+        self._rejected = []
+        if state is None:
+            return
+        ckpt_mod.audit_pool_snapshot(state["pool"], state["pool_digest"],
+                                     self.alloc.num_pages, self.page_size, 1)
+        self._steps = state["step"]
+        self._next_rid = state["next_rid"]
+        self.sched._admit_ticket = state["admit_ticket"]
+        for snap in state["live"]:
+            req = ckpt_mod.rebuild_request(snap)
+            req.submit_time = time.perf_counter()
+            if self.ttl_steps is not None:
+                req.deadline = Deadline(self.ttl_steps, req.submit_step)
+            self.sched.submit(req)
+        for f in state["finished"]:
+            self._restore_finished(f["rid"], f["tokens"], meta=f)
+        for f in state["rejected"]:
+            self._restore_terminal(f["rid"], f["kind"], f["reason"])
+
+    def _restore_finished(self, rid: int, tokens: list[int],
+                          meta: dict | None = None) -> None:
+        req = self._pop_queued(rid)
+        if req is None:
+            prompt = tuple((meta or {}).get("prompt", (0,)))
+            req = Request(rid=rid, prompt=prompt,
+                          max_new_tokens=len(tokens), eos_token=self.eos_id)
+        req.state = RequestState.FINISHED
+        req.generated = list(tokens)
+        for k in ("submit_step", "first_token_step", "preemptions"):
+            if meta is not None and k in meta:
+                setattr(req, k, meta[k])
+        self._finished.append(req)
+
+    def _restore_terminal(self, rid: int, kind: str, reason: str,
+                          error_type: str | None = None) -> None:
+        req = self._pop_queued(rid)
+        if req is None:
+            req = Request(rid=rid, prompt=(0,), max_new_tokens=1,
+                          eos_token=self.eos_id)
+        req.state = RequestState.REJECTED
+        req.failure = (TtlExpired(reason) if kind == "expire"
+                       else AdmissionRejected(reason))
+        self._rejected.append(req)
+
+    def _pop_queued(self, rid: int) -> Request | None:
+        for r in self.sched.queue:
+            if r.rid == rid:
+                self.sched.queue.remove(r)
+                return r
+        return None
+
+    @property
+    def failed(self) -> list[Request]:
+        return list(self._failed) + list(self._rejected)
+
+
+class EngineReplica:
+    """One engine + one PRIVATE journal + one failure domain.
+
+    ``factory(journal)`` builds the engine; the replica derives its own
+    journal path (``journal-r{index}.jsonl`` under ``journal_dir``) so N
+    replicas in one directory never interleave entries — the namespacing
+    the two-replica restart test pins (no cross-replica replay bleed).
+    ``journal_dir=None`` keeps the journal in memory (kill/restore then
+    replays the retained object instead of re-reading disk).
+    """
+
+    def __init__(self, index: int, factory, journal_dir: str | None = None):
+        self.index = index
+        self._factory = factory
+        self.journal_path = (os.path.join(journal_dir,
+                                          f"journal-r{index}.jsonl")
+                             if journal_dir is not None else None)
+        self.journal = ControlJournal(path=self.journal_path)
+        self.engine = factory(self.journal)
+        self.alive = True
+        self.failovers = 0
+
+    # load signals, duck-typed off the engine's intake scheduler and the
+    # pool the decode work actually occupies
+    @property
+    def _sched(self):
+        return getattr(self.engine, "sched_p", None) or self.engine.sched
+
+    @property
+    def _alloc(self):
+        return getattr(self.engine, "alloc_d", None) or self.engine.alloc
+
+    @property
+    def queue_depth(self) -> int:
+        return self._sched.queue_depth
+
+    @property
+    def occupancy(self) -> float:
+        return self._alloc.occupancy()
+
+    @property
+    def load(self) -> int:
+        """Routing load: queued + seated requests on the intake side."""
+        s = self._sched
+        return s.queue_depth + sum(r is not None for r in s.slots)
+
+    @property
+    def idle(self) -> bool:
+        e = self.engine
+        v = getattr(e, "idle", None)
+        return bool(v) if v is not None else e.sched.idle
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        assert self.alive, f"replica {self.index} is dead"
+        return self.engine.submit(prompt, max_new_tokens)
+
+    def step(self) -> bool:
+        assert self.alive, f"replica {self.index} is dead"
+        return self.engine.step()
+
+    def kill(self) -> None:
+        """Fail the replica: close the journal's append handle (the
+        on-disk jsonl is the surviving truth) and drop the engine."""
+        assert self.alive, f"replica {self.index} is already dead"
+        self.journal.close()
+        self.engine = None
+        self.alive = False
+        self.failovers += 1
+
+    def restore(self) -> dict:
+        """The full ISSUE 9 ladder: reload the journal (from disk when
+        path-backed), rebuild a fresh engine through the factory, restore
+        from the newest checkpoint — or replay the ENTIRE journal when
+        none was cut — then re-attach the append handle so post-restore
+        events keep journaling to the same file."""
+        assert not self.alive, f"replica {self.index} is alive"
+        if self.journal_path is not None:
+            j = ControlJournal.load(self.journal_path)
+            # .load() returns an in-memory journal: re-attach the file so
+            # the restored replica keeps appending where it left off
+            j.path = self.journal_path
+            j._fh = open(self.journal_path, "a", encoding="utf-8")
+        else:
+            j = self.journal
+        self.journal = j
+        self.engine = self._factory(j)
+        stats = ckpt_mod.restore(self.engine, ckpt_mod.latest(j), j)
+        self.alive = True
+        return stats
+
+
+class Cluster:
+    """Deterministic router over N replicas (module docstring): prefix-
+    affinity rendezvous hashing, least-loaded tie-break, optional spill
+    threshold, kill/restore through each replica's private journal."""
+
+    def __init__(self, factory, replicas: int = 4,
+                 journal_dir: str | None = None, prefix_tokens: int = 8,
+                 spill_threshold: int | None = None):
+        assert replicas >= 1
+        self.replicas = [EngineReplica(i, factory, journal_dir)
+                         for i in range(replicas)]
+        self.prefix_tokens = prefix_tokens
+        self.spill_threshold = spill_threshold
+        self.metrics = ServingMetrics()
+        self._placement: dict[int, tuple[int, int]] = {}  # gid -> (ri, rid)
+        self._rindex: dict[tuple[int, int], int] = {}     # (ri, rid) -> gid
+        self._requests: dict[int, tuple[tuple[int, ...], int]] = {}
+        self._results: dict[int, list[int]] = {}
+        self._failed: set[int] = set()
+        self._next_gid = 0
+
+    def route(self, prompt) -> EngineReplica:
+        prompt = tuple(int(t) for t in prompt)
+        alive = [r for r in self.replicas if r.alive]
+        assert alive, "no alive replicas"
+        pick = max(alive, key=lambda r: (
+            _fnv1a(0x811C9DC5, r.index, *prompt[:self.prefix_tokens]),
+            -r.load, -r.index))
+        if (self.spill_threshold is not None
+                and pick.load > self.spill_threshold):
+            pick = min(alive, key=lambda r: (r.load, r.index))
+        return pick
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        rep = self.route(prompt)
+        rid = rep.submit(prompt, max_new_tokens)
+        gid = self._next_gid
+        self._next_gid += 1
+        self._placement[gid] = (rep.index, rid)
+        self._rindex[(rep.index, rid)] = gid
+        self._requests[gid] = (tuple(int(t) for t in prompt),
+                               max_new_tokens)
+        self.metrics.inc("requests_submitted")
+        return gid
+
+    def step(self) -> bool:
+        progressed = False
+        for rep in self.replicas:
+            if rep.alive:
+                progressed |= rep.step()
+        self._harvest()
+        return progressed
+
+    def _harvest(self) -> None:
+        for rep in self.replicas:
+            if not rep.alive:
+                continue
+            fin = rep.engine._finished
+            if fin:
+                for req in fin:
+                    gid = self._rindex.get((rep.index, req.rid))
+                    if gid is None:
+                        continue
+                    if gid not in self._results:
+                        self.metrics.inc("requests_finished")
+                        if (req.first_token_time is not None
+                                and req.submit_time is not None):
+                            self.metrics.observe(
+                                "ttft_s",
+                                req.first_token_time - req.submit_time)
+                    self._results[gid] = list(req.generated)
+                rep.engine._finished = []
+            for req in rep.engine.failed:
+                gid = self._rindex.get((rep.index, req.rid))
+                if gid is not None and gid not in self._failed:
+                    self._failed.add(gid)
+                    self.metrics.inc("failed_requests")
+
+    def kill(self, index: int) -> None:
+        self.replicas[index].kill()
+        self.metrics.inc("faults_injected")
+
+    def restore(self, index: int) -> dict:
+        stats = self.replicas[index].restore()
+        self.metrics.inc("restores")
+        self._harvest()   # replayed finishes reappear — re-record them
+        return stats
+
+    def drain(self, max_steps: int = 1_000_000) -> dict[int, list[int]]:
+        for _ in range(max_steps):
+            if not self.step():
+                break
+        return self.results()
+
+    def results(self) -> dict[int, list[int]]:
+        return dict(self._results)
+
+    @property
+    def failed_gids(self) -> set[int]:
+        return set(self._failed)
+
+
+__all__ = ["Cluster", "EngineReplica", "SimEngine", "expected_tokens",
+           "sim_token", "SIM_VOCAB"]

@@ -175,7 +175,12 @@ class ServingEngine:
         self.prefill_buckets = prefill_buckets
 
         self.pool = init_page_pool(cfg, num_pages + 1, page_size)
-        self.alloc = KVPagePool(num_pages + 1, page_size, reserved=1)
+        # unified pool contract (ISSUE 12): subclasses that shard the pool
+        # arrays over SP set _pool_sp_ranks BEFORE super().__init__ so the
+        # ledger knows the padded device page range (padding pages are
+        # never handed out and never check_migratable-accepted)
+        self.alloc = KVPagePool(num_pages + 1, page_size, reserved=1,
+                                sp_ranks=getattr(self, "_pool_sp_ranks", 1))
         self.sched = ContinuousBatchingScheduler(num_slots,
                                                  queue_cap=queue_cap)
         self._next_rid = 0
@@ -193,6 +198,7 @@ class ServingEngine:
         self._journal_muted = False     # True while replaying (restore)
         self._replaying = False         # replayed submits bypass the cap
         self._incarnation = 0           # bumped per restore (crash keying)
+        self._preempt_hook = None       # composition override (ISSUE 12)
         self._last_ckpt_step = -1
         self._rejected: list[Request] = []
 
@@ -269,14 +275,24 @@ class ServingEngine:
             abstract = lambda tree: jax.tree_util.tree_map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
             i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)
+            # lint with the shapes the programs actually run on: the
+            # sharded subclass pads the pool's page dim up to a multiple
+            # of |sp| right after this ctor returns (unified pool
+            # contract), so fold the same padding into the abstract args
+            sp = getattr(self, "_pool_sp_ranks", 1)
+            pool_abs = {
+                k: jax.ShapeDtypeStruct(
+                    v.shape[:1] + (v.shape[1] + (-v.shape[1]) % sp,)
+                    + v.shape[2:], v.dtype)
+                for k, v in self.pool.items()}
             programs = {"decode_multistep_paged": (step, (
                 abstract(self.params), i32(num_slots), i32(num_slots),
-                abstract(self.pool), i32(num_slots, pages_per_seq),
+                pool_abs, i32(num_slots, pages_per_seq),
                 i32(num_slots)))}
             if prefill_chunk is not None:
                 programs["prefill_chunk_paged"] = (chunk, (
                     abstract(self.params), i32(prefill_chunk), i32(), i32(),
-                    abstract(self.pool), i32(pages_per_seq)))
+                    pool_abs, i32(pages_per_seq)))
             lint_engine_programs(programs, type(self).__name__)
 
     def _sync_mirrors(self) -> None:
@@ -496,6 +512,12 @@ class ServingEngine:
 
     def _preempt(self, slot: int) -> None:
         req = self.sched.slots[slot]
+        # composition hook (ISSUE 12): a wrapping engine (compose.py) may
+        # own this slot's request — MIGRATING seats hold pages in a pool
+        # this engine cannot see — and takes over the eviction when so
+        hook = self._preempt_hook
+        if hook is not None and hook(slot, req):
+            return
         if req.state is RequestState.PREFILLING and req.prefill_cursor > 0:
             filled = -(-req.prefill_cursor // self.page_size)
             if filled < len(self.alloc.pages_of(req.rid)):
@@ -826,7 +848,8 @@ class ServingEngine:
         its prompt, and re-prefill rewrites a page's KV before any decode
         read of it, so stale device bytes are unreachable."""
         self.alloc = KVPagePool(self.alloc.num_pages, self.page_size,
-                                reserved=self.alloc.reserved)
+                                reserved=self.alloc.reserved,
+                                sp_ranks=self.alloc.sp_ranks)
         self.sched = ContinuousBatchingScheduler(
             self.num_slots, queue_cap=self.sched.queue_cap)
         self._finished = []

@@ -17,8 +17,23 @@ array is the paged twin of the ``[L, B, Hkv, S, D]`` cache whose S dim is
 dim: each SP rank owns the pages of its sequence shard and runs an
 identical (replicated-decision) allocator instance, so block tables stay
 host-replicated control plane — same split as ``decode_step_sp``'s cache.
-This PR's engine drives the single-device pool; the spec is the contract
-later SP-serving PRs build on.
+
+ONE pool contract (ISSUE 12): a single ``KVPagePool`` is simultaneously
+
+- **shard_map-visible**: construct with ``sp_ranks=n`` and place the
+  device arrays with ``shard_pool_arrays`` — the page dim is padded up to
+  a multiple of ``n`` so ``page_pool_pspec`` splits it evenly. The
+  allocator never hands out a padding id (``device_pages`` > ids ≥
+  ``num_pages`` exist only on device), so allocation/preemption schedules
+  are identical at every mesh size; and
+- **a valid ``migrate_pages`` target**: ``check_migratable`` refuses
+  scratch AND padding ids, and ``landed_row`` exposes only the signal-
+  covered prefix of real owned pages — both independent of ``sp_ranks``.
+
+``digest()`` deliberately EXCLUDES ``sp_ranks``/``device_pages``: the
+ledger digest describes allocation DECISIONS, which the device layout
+must never influence — pools driving meshes of different SP widths over
+the same trace digest identically (test-pinned at n ∈ {1, 2, 4}).
 
 ``cache_to_pages`` / ``pages_to_cache`` convert between the head-major
 contiguous ``init_kv_cache`` layout and the page pool — pure data
@@ -30,6 +45,7 @@ and hand the pages off to the pool.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
@@ -74,13 +90,28 @@ class KVPagePool:
       unwind on preemption).
     The free list is LIFO so allocation order is deterministic — replay
     of the same trace allocates the same pages.
+
+    ``sp_ranks`` (ISSUE 12, the unified pool contract) declares the SP
+    width of the DEVICE arrays this ledger fronts: the device page dim is
+    padded up to ``device_pages`` (a multiple of ``sp_ranks`` so
+    ``page_pool_pspec`` splits evenly), but the allocator's id space stays
+    ``[reserved, num_pages)`` — padding ids exist only on device, are
+    never handed out, and are refused by ``check_migratable``. Every
+    allocation DECISION (and hence ``digest()``) is independent of
+    ``sp_ranks``; only ``page_shard`` / ``device_pages`` see the layout.
     """
 
-    def __init__(self, num_pages: int, page_size: int, reserved: int = 0):
+    def __init__(self, num_pages: int, page_size: int, reserved: int = 0,
+                 sp_ranks: int = 1):
         assert num_pages > reserved >= 0
+        assert sp_ranks >= 1
         self.num_pages = num_pages
         self.page_size = page_size
         self.reserved = reserved
+        self.sp_ranks = sp_ranks
+        # device page count: padded up so the page dim splits evenly over
+        # the SP axis (the padding pages are invisible to the allocator)
+        self.device_pages = num_pages + (-num_pages) % sp_ranks
         # LIFO: lowest ids on top, so fresh pools allocate reserved, 1, 2…
         self._free = list(range(num_pages - 1, reserved - 1, -1))
         self._owned: dict[object, list[int]] = {}
@@ -103,6 +134,17 @@ class KVPagePool:
 
     def holds(self, seq_id) -> bool:
         return seq_id in self._owned
+
+    def page_shard(self, page_id: int) -> int:
+        """Which SP rank's device shard holds ``page_id`` under the
+        ``page_pool_pspec`` even split of the padded page dim. Pure layout
+        introspection — no allocation decision may depend on it (that
+        would fork the replicated control plane across mesh sizes)."""
+        if not 0 <= page_id < self.device_pages:
+            raise PageLedgerError(
+                f"page {page_id} outside the device range "
+                f"[0, {self.device_pages})")
+        return page_id // (self.device_pages // self.sp_ranks)
 
     def digest(self) -> int:
         """Cheap order-sensitive ledger digest (32-bit FNV-1a) over the
@@ -135,11 +177,12 @@ class KVPagePool:
 
     @classmethod
     def from_snapshot(cls, snap: dict, num_pages: int, page_size: int,
-                      reserved: int = 0) -> "KVPagePool":
+                      reserved: int = 0, sp_ranks: int = 1) -> "KVPagePool":
         """Rebuild a ledger from ``snapshot()`` output (geometry is not in
         the snapshot — it comes from the engine's own configuration, which
-        a restore never changes)."""
-        pool = cls(num_pages, page_size, reserved)
+        a restore never changes; ``sp_ranks`` is device layout only and
+        does not affect the rebuilt digest)."""
+        pool = cls(num_pages, page_size, reserved, sp_ranks=sp_ranks)
         pool._free = [int(p) for p in snap["free"]]
         pool._owned = {sid: [int(p) for p in pages]
                        for sid, pages in snap["owned"]}
@@ -203,16 +246,26 @@ class KVPagePool:
     # -- migration support (disaggregated serving, ISSUE 6) ---------------
     def check_migratable(self, seq_id, page_ids) -> None:
         """Migration precondition: every id in ``page_ids`` must be owned
-        by ``seq_id`` and non-reserved. The scratch page(s) are
-        engine-local parking — inactive rows WRITE to them every dispatch,
-        so shipping one to a peer pool would plant live-mutating garbage
-        there. Raises ``PageLedgerError`` (loud, not silent corruption)."""
+        by ``seq_id``, non-reserved, and a REAL page (< ``num_pages``).
+        The scratch page(s) are engine-local parking — inactive rows WRITE
+        to them every dispatch, so shipping one to a peer pool would plant
+        live-mutating garbage there. SP padding ids (``num_pages`` ≤ id <
+        ``device_pages``) exist only to even the device shard split —
+        migrating one would write KV into a slot no block table can ever
+        expose (a silent data loss). Raises ``PageLedgerError`` (loud,
+        not silent corruption)."""
         owned = set(self._owned.get(seq_id, ()))
         for p in page_ids:
             if p < self.reserved:
                 raise PageLedgerError(
                     f"page {p} is a reserved scratch page — scratch pages "
                     f"are never migrated (seq {seq_id!r})")
+            if p >= self.num_pages:
+                raise PageLedgerError(
+                    f"page {p} is an SP padding/out-of-range id (real "
+                    f"pages end at {self.num_pages}, device shard pads to "
+                    f"{self.device_pages}) — padding pages are never "
+                    f"migrated (seq {seq_id!r})")
             if p not in owned:
                 raise PageLedgerError(
                     f"page {p} is not owned by seq {seq_id!r} — refusing "
@@ -319,6 +372,34 @@ class KVPagePool:
 
 
 # ---------------------------------------------------------------------------
+# device-side pool layout (the shard_map half of the one pool contract)
+# ---------------------------------------------------------------------------
+
+def shard_pool_arrays(pool: dict, sp_ranks: int, sharding=None) -> dict:
+    """Pad the ``{"k", "v"}`` pool arrays' page dim (axis 1) up to a
+    multiple of ``sp_ranks`` and (optionally) commit them to ``sharding``
+    — the one place the SP device layout is materialized, shared by the
+    sharded engine and the composed disagg-on-mesh prefill fleet so both
+    sides of a cross-mesh migration carry the SAME array shapes and
+    placement (one pjit executable serves both pools).
+
+    Zero-init padding matches the live pages' init; the allocator never
+    hands a padding id out (``KVPagePool(sp_ranks=...)``), so every
+    block-table fill entry stays the scratch page and the padding is
+    unreachable from any compiled program's reads."""
+    pad = (-pool["k"].shape[1]) % sp_ranks
+    if pad:
+        pool = {
+            k: jnp.concatenate(
+                [v, jnp.zeros(v.shape[:1] + (pad,) + v.shape[2:],
+                              v.dtype)], axis=1)
+            for k, v in pool.items()}
+    if sharding is not None:
+        pool = {k: jax.device_put(v, sharding) for k, v in pool.items()}
+    return pool
+
+
+# ---------------------------------------------------------------------------
 # contiguous cache <-> page pool converters
 # ---------------------------------------------------------------------------
 
@@ -356,4 +437,5 @@ def pages_to_cache(pages: jax.Array, block_table: jax.Array) -> jax.Array:
 
 
 __all__ = ["KVPagePool", "PageLedgerError", "page_pool_pspec",
-           "cache_to_pages", "pages_to_cache", "_fnv1a"]
+           "shard_pool_arrays", "cache_to_pages", "pages_to_cache",
+           "_fnv1a"]

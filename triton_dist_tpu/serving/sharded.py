@@ -53,7 +53,7 @@ from triton_dist_tpu.ops.flash_decode import sp_paged_attend_write
 from triton_dist_tpu.serving import checkpoint as ckpt_mod
 from triton_dist_tpu.serving.engine import ServingEngine
 from triton_dist_tpu.serving.journal import ControlJournal
-from triton_dist_tpu.serving.kv_pool import page_pool_pspec
+from triton_dist_tpu.serving.kv_pool import page_pool_pspec, shard_pool_arrays
 from triton_dist_tpu.serving.metrics import ServingMetrics
 from triton_dist_tpu.shmem import faults as faults_mod
 from triton_dist_tpu.shmem.context import ShmemContext, initialize_distributed
@@ -101,10 +101,12 @@ class ShardedServingEngine(ServingEngine):
     contract; see ``tp_column_linear``). ``digest_every=k`` runs the
     replicated-decision guard every k-th step (0 disables).
 
-    Disaggregation does NOT compose with this engine yet: the migration
-    channel moves whole pages between two SINGLE-rank pools, while this
-    pool is page-sharded over SP — refused explicitly (docs/serving.md)
-    rather than silently migrating one shard.
+    Disaggregation COMPOSES with this engine (ISSUE 12): the pool carries
+    the unified contract — ``sp_ranks``-aware ledger (padding pages are
+    allocator-invisible AND ``check_migratable``-refused) over the same
+    SP-sharded arrays — so ``DisaggShardedEngine`` (serving/compose.py)
+    runs this engine as the decode role of a disaggregated pair, landing
+    migrated prefill pages into the sharded pool host-side.
     """
 
     def __init__(self, params: dict, cfg: MoEConfig, ctx: ShmemContext,
@@ -180,6 +182,11 @@ class ShardedServingEngine(ServingEngine):
         # commits every upload so pjit's executable cache sees ONE input
         # signature across all dispatches)
         self._rep_sharding = jax.sharding.NamedSharding(ctx.mesh, P())
+        # unified pool contract (ISSUE 12): the base engine threads this
+        # into KVPagePool(sp_ranks=...) so the ledger knows the device
+        # page range (real + SP padding) and refuses padding ids in
+        # check_migratable while the allocator never hands them out.
+        self._pool_sp_ranks = n_sp
 
         super().__init__(params, cfg.base, num_slots=num_slots,
                          page_size=page_size, num_pages=num_pages,
@@ -201,15 +208,8 @@ class ShardedServingEngine(ServingEngine):
         # fill entry stays the scratch page — so allocation/preemption
         # schedules are identical at every mesh size (part of the bitwise
         # contract). Zero-init padding matches the live pages' init.
-        pad = (-self.pool["k"].shape[1]) % n_sp
-        if pad:
-            self.pool = {
-                k: jnp.concatenate(
-                    [v, jnp.zeros(v.shape[:1] + (pad,) + v.shape[2:],
-                                  v.dtype)], axis=1)
-                for k, v in self.pool.items()}
-        self.pool = {k: jax.device_put(v, self._pool_out_sharding)
-                     for k, v in self.pool.items()}
+        self.pool = shard_pool_arrays(self.pool, n_sp,
+                                      self._pool_out_sharding)
 
         # replicated-decision guard: every rank carries (conceptually) its
         # own copy of the host control plane; the check all-gathers the
