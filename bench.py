@@ -1595,6 +1595,95 @@ def bench_cluster(ctx, num_requests: int = 2000, templates: int = 32,
     }
 
 
+def bench_prefix_cache(ctx, num_requests: int = 40, templates: int = 4,
+                       zipf: float = 1.1, num_slots: int = 4,
+                       page_size: int = 8, num_pages: int = 14,
+                       pages_per_seq: int = 8, n_layers: int = 2) -> dict:
+    """Prefix-cache rows (ISSUE 13): the same Zipf template workload run
+    through ``ServingEngine`` twice — cache OFF (the golden) and cache ON
+    — with every token asserted bit-identical between the two runs and
+    the compiled-program counts asserted equal (the cache adds zero
+    programs: adoption and COW are host ledger ops plus eager copies).
+
+    - ``serving_cache_hit_rate``: admissions that adopted >=1 cached page
+      over all admissions; the Zipf head templates should push this past
+      0.5 even at 4 templates.
+    - ``serving_ttft_cached_us`` vs ``serving_ttft_cold_us``: the split
+      the cache exists to move — adopted prompts skip whole pages of
+      prefill compute.
+    - ``serving_prefix_evictions`` / ``serving_cow_copies``: LRU
+      reclaim + divergence-copy traffic at a pool deliberately too small
+      to hold every template resident.
+    """
+    import numpy as _np
+
+    from triton_dist_tpu.models.llama import LlamaConfig, init_params
+    from triton_dist_tpu.serving import ServingEngine
+
+    cfg = LlamaConfig.tiny(n_layers=n_layers)
+    params = init_params(jax.random.key(7), cfg)
+
+    # page-aligned Zipf-ranked template prefixes + tiny unique tails, the
+    # serve_sim --prompt-zipf shape: full-page runs are what the radix
+    # index can actually share
+    rng0 = _np.random.RandomState(0)
+    tpls = [rng0.randint(1, cfg.vocab_size, size=3 * page_size).tolist()
+            for _ in range(templates)]
+    ranks = _np.arange(1, templates + 1, dtype=_np.float64)
+    zp = ranks ** -zipf
+    zp /= zp.sum()
+
+    def _workload():
+        rng = _np.random.RandomState(1)
+        out = []
+        for _ in range(num_requests):
+            t = int(rng.choice(templates, p=zp))
+            tail = rng.randint(1, cfg.vocab_size,
+                               size=int(rng.randint(1, 5))).tolist()
+            out.append((tpls[t] + tail, int(rng.randint(2, 7))))
+        return out
+
+    def _run(cache_on: bool):
+        eng = ServingEngine(params, cfg, num_slots=num_slots,
+                            page_size=page_size, num_pages=num_pages,
+                            pages_per_seq=pages_per_seq,
+                            prefill_chunk=2 * page_size,
+                            prefix_cache=cache_on)
+        res = {}
+        # waves of num_slots: finished requests park their pages on the
+        # cached list before the next wave admits, so the hit-rate row
+        # measures the cache, not the arrival overlap
+        work = _workload()
+        for i in range(0, len(work), num_slots):
+            for prompt, mnt in work[i:i + num_slots]:
+                eng.submit(prompt, mnt)
+            res.update(eng.run(max_steps=100_000))
+        return eng, res, eng.metrics.snapshot()
+
+    eng_off, res_off, _ = _run(False)
+    eng_on, res_on, snap = _run(True)
+    assert res_on == res_off, (
+        "prefix cache changed tokens — adoption/COW broke bit-identity")
+    assert eng_on.compile_stats == eng_off.compile_stats, (
+        f"prefix cache compiled extra programs: {eng_on.compile_stats} "
+        f"vs {eng_off.compile_stats}")
+    hits, misses = snap["prefix_hits"], snap["prefix_misses"]
+    us = lambda h: round((h["mean"] or 0.0) * 1e6, 1)  # noqa: E731
+    return {
+        "serving_cache_hit_rate": round(hits / max(hits + misses, 1), 3),
+        "serving_cache_hit_tokens": snap["prefix_hit_tokens"],
+        "serving_ttft_cached_us": us(snap["ttft_cached_s"]),
+        "serving_ttft_cold_us": us(snap["ttft_cold_s"]),
+        "serving_prefix_evictions": snap["prefix_evictions"],
+        "serving_cow_copies": snap["cow_copies"],
+        "serving_cache_bit_identical": len(res_on),
+        "serving_cache_knobs": {
+            "num_requests": num_requests, "templates": templates,
+            "zipf": zipf, "num_slots": num_slots, "page_size": page_size,
+            "num_pages": num_pages, "n_layers": n_layers},
+    }
+
+
 # --- EP-dispatch wire model (the DeepEP-comparison analog) -----------------
 #
 # The reference's headline 137 µs dispatch (README.md:55) is 32 H800 ranks,
@@ -1899,6 +1988,15 @@ def main(a2a_primary: bool = False):
         extras.update(bench_cluster(ctx))
 
     attempt("cluster", _cluster)
+
+    def _prefix_cache():
+        # ref-counted prefix cache vs the cache-off golden on a Zipf
+        # template workload: hit rate, cached/cold TTFT split, eviction
+        # and COW traffic, tokens asserted bit-identical (ISSUE 13)
+        psh = dict(n_layers=1) if on_cpu() else {}
+        extras.update(bench_prefix_cache(ctx, **psh))
+
+    attempt("prefix_cache", _prefix_cache)
 
     def _attn():
         ash = dict(s_loc=256, Hq=4, Hkv=2) if on_cpu() else {}

@@ -75,7 +75,16 @@ p.add_argument("--restore-after", type=int, default=None, metavar="REQ",
 p.add_argument("--kill-replica", type=int, default=1, metavar="I")
 p.add_argument("--no-kill", action="store_true",
                help="fault-free run (no kill/restore cycle)")
+p.add_argument("--prefix-cache", action="store_true",
+               help="ref-counted prefix caching inside each replica "
+                    "(ISSUE 13; --engine colocated only — SimEngine has "
+                    "no KV to cache). The router's radix index already "
+                    "sends shared-template prompts to one replica, so "
+                    "its cache sees them all; prints an aggregate "
+                    "hit-rate + cached/cold TTFT line to stderr")
 args = p.parse_args()
+if args.prefix_cache and args.engine != "colocated":
+    p.error("--prefix-cache needs --engine colocated")
 
 kill_at = args.kill_at if args.kill_at is not None else args.requests // 2
 restore_after = (args.restore_after if args.restore_after is not None
@@ -117,7 +126,8 @@ else:
                              num_pages=args.pages,
                              pages_per_seq=args.pages_per_seq,
                              prefill_chunk=args.page_size,
-                             journal=journal, checkpoint_every=ckpt_every)
+                             journal=journal, checkpoint_every=ckpt_every,
+                             prefix_cache=args.prefix_cache)
 
     _ref = ServingEngine(params, cfg, num_slots=args.slots,
                          page_size=args.page_size, num_pages=args.pages,
@@ -188,6 +198,38 @@ ok = not missing and not mismatched
 per_replica = [0] * args.replicas
 for gid, (ri, _) in cluster._placement.items():
     per_replica[ri] += 1
+if args.prefix_cache:
+    # aggregate the per-replica engine caches; the reference engine is
+    # cache-off on purpose — bit-identity of verified traces IS the
+    # cache-transparency check at cluster scale
+    agg: dict[str, int] = {}
+    from triton_dist_tpu.serving.metrics import Histogram  # noqa: E402
+    tc, tk = Histogram(), Histogram()
+    for rep in cluster.replicas:
+        if rep.engine is None:
+            continue
+        c = rep.engine.metrics.counters
+        for k in ("prefix_hits", "prefix_misses", "prefix_hit_tokens",
+                  "cow_copies", "prefix_evictions"):
+            agg[k] = agg.get(k, 0) + c[k]
+        for h, dst in (("ttft_cached_s", tc), ("ttft_cold_s", tk)):
+            src = rep.engine.metrics.hist[h]
+            for v in src._samples:
+                dst.observe(v)
+    hm = lambda h: (None if h.mean is None  # noqa: E731
+                    else round(h.mean * 1e6, 1))
+    print(json.dumps({
+        "prefix_cache": True,
+        **agg,
+        "hit_rate": round(agg["prefix_hits"]
+                          / max(agg["prefix_hits"]
+                                + agg["prefix_misses"], 1), 3),
+        "router_radix_hits": cluster.metrics.counters["router_radix_hits"],
+        "router_radix_misses":
+            cluster.metrics.counters["router_radix_misses"],
+        "ttft_cached_us_mean": hm(tc),
+        "ttft_cold_us_mean": hm(tk),
+    }), file=sys.stderr)
 toks_total = sum(len(t) for t in results.values())
 ttft = cluster.metrics.hist["ttft_s"]
 us = lambda v: None if v is None else round(v * 1e6, 1)  # noqa: E731

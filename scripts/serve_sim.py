@@ -113,6 +113,19 @@ p.add_argument("--ttl", type=int, default=None, metavar="STEPS",
                help="per-request TTL in engine steps: queued requests "
                     "never admitted within the budget EXPIRE with a typed "
                     "terminal (counted in 'expirations')")
+p.add_argument("--prefix-cache", action="store_true",
+               help="ref-counted copy-on-write prefix caching (ISSUE 13): "
+                    "finished prompts' full KV pages stay indexed in a "
+                    "radix trie and later shared-prefix prompts adopt them "
+                    "instead of re-prefilling; prints a hit-rate + "
+                    "cached/cold TTFT summary line to stderr (implies the "
+                    "chunked prefill path)")
+p.add_argument("--prompt-zipf", default=None, metavar="ALPHA:POOL",
+               help="Zipf-shared-prompt generator: draw each request's "
+                    "prefix from a POOL of shared page-aligned prefixes "
+                    "with Zipf(ALPHA) popularity and append a short "
+                    "random tail — the workload prefix caching exists "
+                    "for (e.g. 1.1:8). Deterministic per --seed")
 args = p.parse_args()
 if args.recover and args.crash_at is None:
     p.error("--recover needs --crash-at")
@@ -122,6 +135,10 @@ if args.mesh is not None:
     args.model = "moe"
 elif args.model == "moe":
     args.mesh = "1x1x1"
+if (args.prefix_cache and args.prefill_chunk is None
+        and not args.disagg and args.mesh is None):
+    # the cache rides the chunked path (adoption = cursor jump)
+    args.prefill_chunk = 2 * args.page_size
 if args.prefill_buckets == "pow2":
     buckets = "pow2"
 elif args.prefill_buckets == "exact":
@@ -182,7 +199,8 @@ def mk_engine(fresh=False):
                   num_pages=args.pages, pages_per_seq=args.pages_per_seq,
                   decode_horizon=args.decode_horizon, journal=journal,
                   checkpoint_every=ckpt_every, queue_cap=args.queue_cap,
-                  ttl_steps=args.ttl, fault_plan=_fault_plan())
+                  ttl_steps=args.ttl, fault_plan=_fault_plan(),
+                  prefix_cache=args.prefix_cache)
     if args.mesh is not None and args.disagg:
         # ISSUE 12: the composed engine — disaggregated prefill feeding a
         # ShardedServingEngine decode fleet on ONE TP/SP/EP mesh (the
@@ -234,12 +252,35 @@ eng = mk_engine()
 rng = np.random.RandomState(args.seed)
 max_plen = min(args.pages_per_seq * args.page_size - args.max_new, 24)
 arrivals = []
-for i in range(args.sim):
-    plen = int(rng.randint(3, max(4, max_plen)))
-    mnt = int(rng.randint(2, max(3, args.max_new + 1)))
-    prompt = rng.randint(1, vocab, size=plen).tolist()
-    arrivals.append((i * args.arrive_every // max(args.arrive_every, 1),
-                     prompt, mnt))
+if args.prompt_zipf is not None:
+    # the shared-prompt workload: page-aligned prefixes drawn from a
+    # small pool with Zipf popularity, plus a short random tail — head
+    # prefixes repeat often enough that a prefix cache serves most of
+    # their prompt tokens from adopted pages
+    alpha_s, pool_s = args.prompt_zipf.split(":")
+    alpha, pool_n = float(alpha_s), int(pool_s)
+    assert alpha > 0 and pool_n >= 1, "--prompt-zipf wants ALPHA:POOL > 0"
+    prefix_len = max(args.page_size,
+                     (max(max_plen - 5, args.page_size)
+                      // args.page_size) * args.page_size)
+    pool = [rng.randint(1, vocab, size=prefix_len).tolist()
+            for _ in range(pool_n)]
+    w = np.arange(1, pool_n + 1, dtype=np.float64) ** -alpha
+    w /= w.sum()
+    for i in range(args.sim):
+        k = int(rng.choice(pool_n, p=w))
+        tail = rng.randint(1, vocab,
+                           size=int(rng.randint(1, 5))).tolist()
+        mnt = int(rng.randint(2, max(3, args.max_new + 1)))
+        arrivals.append((i * args.arrive_every // max(args.arrive_every, 1),
+                         pool[k] + tail, mnt))
+else:
+    for i in range(args.sim):
+        plen = int(rng.randint(3, max(4, max_plen)))
+        mnt = int(rng.randint(2, max(3, args.max_new + 1)))
+        prompt = rng.randint(1, vocab, size=plen).tolist()
+        arrivals.append((i * args.arrive_every // max(args.arrive_every, 1),
+                         prompt, mnt))
 
 if args.crash_at is not None:
     from triton_dist_tpu.shmem.faults import InjectedCrash  # noqa: E402
@@ -309,6 +350,24 @@ print(json.dumps({"compile_stats": eng.compile_stats}), file=sys.stderr)
 # (per-step decode stall bound, queue-vs-prefill TTFT split)
 snap = eng.metrics.snapshot()
 us = lambda v: None if v is None else round(v * 1e6, 1)
+if args.prefix_cache:
+    # hit-rate + cached/cold TTFT split (ISSUE 13): the point of the
+    # cache is the cached-TTFT column sitting far below the cold one on
+    # shared-prefix workloads (--prompt-zipf)
+    hits, misses = snap["prefix_hits"], snap["prefix_misses"]
+    print(json.dumps({
+        "prefix_cache": True,
+        "hits": hits, "misses": misses,
+        "hit_rate": round(hits / max(hits + misses, 1), 3),
+        "hit_tokens": snap["prefix_hit_tokens"],
+        "cow_copies": snap["cow_copies"],
+        "evictions": snap["prefix_evictions"],
+        "skipped_chunks": snap["prefix_skipped_chunks"],
+        "ttft_cached_us": {k: us(snap["ttft_cached_s"][k])
+                           for k in ("mean", "p99")},
+        "ttft_cold_us": {k: us(snap["ttft_cold_s"][k])
+                         for k in ("mean", "p99")},
+    }), file=sys.stderr)
 if args.disagg:
     # two panels: TTFT lives on the prefill worker, ITL/stall on the
     # decode worker — whose decode stall carries ZERO prefill work (the

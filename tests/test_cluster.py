@@ -329,6 +329,58 @@ def test_router_skips_dead_replicas_and_rendezvous_moves_only_their_keys():
             assert after[k] != dead
 
 
+def test_router_radix_routing_deterministic_with_hits():
+    """Cache-aware routing (ISSUE 13): a template workload routes by
+    radix-index hit after the first submit of each template, the whole
+    placement map is still a pure function of the submission sequence,
+    and affinity holds — every request of a template lands on ONE
+    replica."""
+    def run():
+        cl = _mk_cluster()
+        rng = np.random.RandomState(12)
+        tpls = [rng.randint(1, 1000, size=16).tolist() for _ in range(4)]
+        placements = []
+        for i in range(40):
+            prompt = tpls[i % 4] + rng.randint(1, 1000, size=2).tolist()
+            gid = cl.submit(prompt, 2)
+            placements.append(cl._placement[gid][0])
+            cl.step()
+        cl.drain()
+        return placements, dict(cl.metrics.counters)
+
+    p1, c1 = run()
+    p2, c2 = run()
+    assert p1 == p2, "radix routing broke router determinism"
+    assert c1["router_radix_hits"] == c2["router_radix_hits"]
+    # first submit of each template misses (rendezvous), the rest hit
+    assert c1["router_radix_misses"] == 4
+    assert c1["router_radix_hits"] == 36
+    for k in range(4):
+        assert len({p1[i] for i in range(40) if i % 4 == k}) == 1
+
+
+def test_router_radix_affinity_survives_kill_restore(tmp_path):
+    """A routed prompt's prefix sticks to the replica that first served
+    it; while that replica is dead the same prefix falls back to
+    rendezvous (entries are never dropped), and the affinity returns the
+    moment the replica is restored."""
+    cl = _mk_cluster(tmp_path)
+    rng = np.random.RandomState(11)
+    pre = rng.randint(1, 1000, size=8).tolist()
+    gid = cl.submit(pre + [7], 2)
+    home = cl._placement[gid][0]
+    assert cl.metrics.counters["router_radix_misses"] == 1
+    for _ in range(3):
+        g = cl.submit(pre + rng.randint(1, 1000, size=2).tolist(), 2)
+        assert cl._placement[g][0] == home, "radix affinity broken"
+    assert cl.metrics.counters["router_radix_hits"] == 3
+    cl.drain()
+    cl.kill(home)
+    assert cl.route(pre + [9]).index != home
+    cl.restore(home)
+    assert cl.route(pre + [9]).index == home, "affinity did not return"
+
+
 def test_cluster_kill_restore_traces_bit_identical(tmp_path):
     """The cluster_sim contract in miniature: a routed workload with a
     mid-run kill/restore; every trace matches the closed-form golden."""

@@ -253,6 +253,20 @@ def test_pool_snapshot_audit_catches_tamper():
         ckpt_mod.audit_pool_snapshot(snap, pool.digest(), 8, 4, 1)
 
 
+def test_prefix_snapshot_audit_catches_tamper():
+    from triton_dist_tpu.serving import PrefixCache
+
+    pool = KVPagePool(8, 4, reserved=1)
+    cache = PrefixCache(pool, 4)
+    pages = pool.alloc(0, 2)
+    cache.insert([1, 2, 3, 4, 5, 6, 7, 8], pages)
+    snap, dig = cache.snapshot(), cache.digest()
+    ckpt_mod.audit_prefix_snapshot(snap, dig)               # clean
+    snap[1][1][0] ^= 1                   # tamper one token of one run
+    with pytest.raises(CheckpointIntegrityError, match="torn or tampered"):
+        ckpt_mod.audit_prefix_snapshot(snap, dig)
+
+
 def test_fault_plan_engine_tier():
     p = FaultPlan(seed=1, crash_at=(5,), digest_skew_at=(3,))
     assert p.crash(5, incarnation=0) and not p.crash(5, incarnation=1)
@@ -280,6 +294,33 @@ def test_colocated_crash_sweep_quick(tiny_model):
     stride = max(1, total // 8)
     points = list(range(1, total, stride))
     for s in points:
+        res = _crash_then_recover(mk, arrivals, s)
+        assert res is not None, f"crash at step {s} never fired"
+        assert res == golden, f"crash at step {s}: not bit-identical"
+
+
+def test_colocated_crash_sweep_prefix_cache(tiny_model):
+    """Strided crash sweep with the prefix cache ON over a template-
+    sharing trace (so adoption/COW state is live at most crash points).
+    The restore contract — fresh pool, EMPTY cache, KV re-earned via
+    re-prefill — must keep every crash+recover bit-identical to the
+    fault-free cache-on golden, which itself must equal the cache-off
+    golden (the ISSUE 13 transparency contract composed with ISSUE 9)."""
+    rng = np.random.RandomState(13)
+    tpls = [rng.randint(1, 128, size=16).tolist() for _ in range(3)]
+    arrivals = []
+    for i in range(24):
+        t = int(rng.randint(0, 3))
+        tail = rng.randint(1, 128, size=int(rng.randint(1, 5))).tolist()
+        arrivals.append((2 * i, tpls[t] + tail, int(rng.randint(2, 6))))
+    mk = lambda **kw: _colocated(tiny_model, prefix_cache=True,  # noqa: E731
+                                 **kw)
+    total, golden, _ = _journaled_steps(mk, arrivals)
+    _, golden_off, _ = _journaled_steps(
+        lambda **kw: _colocated(tiny_model, **kw), arrivals)
+    assert golden == golden_off, "prefix cache changed tokens"
+    stride = max(1, total // 6)
+    for s in range(1, total, stride):
         res = _crash_then_recover(mk, arrivals, s)
         assert res is not None, f"crash at step {s} never fired"
         assert res == golden, f"crash at step {s}: not bit-identical"

@@ -18,6 +18,10 @@ EP/SP overlap ops (see docs/serving.md).
                router, each with a private path-namespaced journal and
                kill/restore through the ISSUE-9 ladder; SimEngine is the
                host-only scale vehicle (scripts/cluster_sim.py)
+- prefix_cache — token-keyed radix index over KVPagePool pages (ISSUE
+               13): refcounted adoption of cached prefixes, copy-on-
+               write on divergence, LRU eviction of refcount-0 pages,
+               and the router-side ReplicaPrefixIndex twin
 - deadline   — Deadline/Backoff helpers + EngineStallError (the global
                progress watchdog both engines share)
 - journal    — append-only WAL of control-plane events (ISSUE 9)
@@ -47,6 +51,8 @@ from triton_dist_tpu.serving.kv_pool import (KVPagePool, PageLedgerError,
                                              pages_to_cache,
                                              shard_pool_arrays)
 from triton_dist_tpu.serving.metrics import Histogram, ServingMetrics
+from triton_dist_tpu.serving.prefix_cache import (PrefixCache,
+                                                  ReplicaPrefixIndex)
 from triton_dist_tpu.serving.scheduler import (AdmissionRejected,
                                                ContinuousBatchingScheduler,
                                                Request, RequestState,
@@ -88,6 +94,8 @@ __all__ = [
     "TtlExpired",
     "KVPagePool",
     "PageLedgerError",
+    "PrefixCache",
+    "ReplicaPrefixIndex",
     "page_pool_pspec",
     "cache_to_pages",
     "pages_to_cache",

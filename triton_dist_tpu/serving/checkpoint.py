@@ -117,6 +117,19 @@ def audit_pool_snapshot(snap: dict[str, Any], digest: int, num_pages: int,
             f"0x{digest & 0xFFFFFFFF:08x} — checkpoint is torn or tampered")
 
 
+def audit_prefix_snapshot(entries: list, digest: int) -> None:
+    """Check a prefix-index snapshot (ISSUE 13) against its recorded
+    digest. Like the pool audit, the index is never restored — a rebuilt
+    engine re-earns KV via re-prefill and starts with an empty cache —
+    but a torn/tampered snapshot must still fail loudly."""
+    from triton_dist_tpu.serving.prefix_cache import PrefixCache
+    got = PrefixCache.snapshot_digest(entries)
+    if got != (digest & 0xFFFFFFFF):
+        raise CheckpointIntegrityError(
+            f"prefix-index snapshot digest 0x{got:08x} != recorded "
+            f"0x{digest & 0xFFFFFFFF:08x} — checkpoint is torn or tampered")
+
+
 # ------------------------------------------------------------------ capture
 def capture(engine: Any) -> Checkpoint:
     """Snapshot an engine's control plane.  Pure host work, no dispatches."""

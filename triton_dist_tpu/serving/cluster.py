@@ -57,6 +57,7 @@ from triton_dist_tpu.serving.engine import (mark_prefill_start,
 from triton_dist_tpu.serving.journal import ControlJournal
 from triton_dist_tpu.serving.kv_pool import KVPagePool, _fnv1a
 from triton_dist_tpu.serving.metrics import ServingMetrics
+from triton_dist_tpu.serving.prefix_cache import ReplicaPrefixIndex
 from triton_dist_tpu.serving.scheduler import (AdmissionRejected,
                                                ContinuousBatchingScheduler,
                                                Request, RequestState,
@@ -507,9 +508,10 @@ class EngineReplica:
 
 
 class Cluster:
-    """Deterministic router over N replicas (module docstring): prefix-
-    affinity rendezvous hashing, least-loaded tie-break, optional spill
-    threshold, kill/restore through each replica's private journal."""
+    """Deterministic router over N replicas (module docstring): cache-
+    aware radix-hit affinity first (ISSUE 13), rendezvous hashing as the
+    fallback, least-loaded tie-break, optional spill threshold,
+    kill/restore through each replica's private journal."""
 
     def __init__(self, factory, replicas: int = 4,
                  journal_dir: str | None = None, prefix_tokens: int = 8,
@@ -519,6 +521,12 @@ class Cluster:
                          for i in range(replicas)]
         self.prefix_tokens = prefix_tokens
         self.spill_threshold = spill_threshold
+        # cache-aware routing (ISSUE 13): token runs of routed prompts
+        # map to the replica that first served them, so a shared-prefix
+        # prompt follows its KV. Entries are never dropped — a dead
+        # replica's keys fall back to rendezvous below and the affinity
+        # returns the moment the replica is restored.
+        self.prefix_index = ReplicaPrefixIndex(prefix_tokens)
         self.metrics = ServingMetrics()
         self._placement: dict[int, tuple[int, int]] = {}  # gid -> (ri, rid)
         self._rindex: dict[tuple[int, int], int] = {}     # (ri, rid) -> gid
@@ -528,12 +536,22 @@ class Cluster:
         self._next_gid = 0
 
     def route(self, prompt) -> EngineReplica:
+        """Longest radix-index hit wins (the deepest run's replica most
+        likely holds the prefix KV); rendezvous hashing with least-loaded
+        tie-break handles misses and dead affinity targets. Pure function
+        of (index state, alive set, prompt, load) — still deterministic."""
         prompt = tuple(int(t) for t in prompt)
         alive = [r for r in self.replicas if r.alive]
         assert alive, "no alive replicas"
-        pick = max(alive, key=lambda r: (
-            _fnv1a(0x811C9DC5, r.index, *prompt[:self.prefix_tokens]),
-            -r.load, -r.index))
+        _, owner = self.prefix_index.match(prompt)
+        if owner is not None and self.replicas[owner].alive:
+            pick = self.replicas[owner]
+            self.metrics.inc("router_radix_hits")
+        else:
+            pick = max(alive, key=lambda r: (
+                _fnv1a(0x811C9DC5, r.index, *prompt[:self.prefix_tokens]),
+                -r.load, -r.index))
+            self.metrics.inc("router_radix_misses")
         if (self.spill_threshold is not None
                 and pick.load > self.spill_threshold):
             pick = min(alive, key=lambda r: (r.load, r.index))
@@ -541,6 +559,9 @@ class Cluster:
 
     def submit(self, prompt, max_new_tokens: int) -> int:
         rep = self.route(prompt)
+        # first-writer-wins: runs this prompt ADDS stick to the replica
+        # that actually received it, existing runs keep their owner
+        self.prefix_index.insert(tuple(int(t) for t in prompt), rep.index)
         rid = rep.submit(prompt, max_new_tokens)
         gid = self._next_gid
         self._next_gid += 1

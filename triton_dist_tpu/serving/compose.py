@@ -76,6 +76,7 @@ from triton_dist_tpu.serving.journal import ControlJournal
 from triton_dist_tpu.serving.kv_pool import (KVPagePool, _fnv1a,
                                              shard_pool_arrays)
 from triton_dist_tpu.serving.metrics import ServingMetrics
+from triton_dist_tpu.serving.prefix_cache import PrefixCache
 from triton_dist_tpu.serving.scheduler import (AdmissionRejected,
                                                ContinuousBatchingScheduler,
                                                Request, RequestState,
@@ -120,7 +121,8 @@ class DisaggShardedEngine:
                  checkpoint_every: int | None = None,
                  queue_cap: int | None = None,
                  ttl_steps: int | None = None,
-                 fault_plan: "faults.FaultPlan | None" = None):
+                 fault_plan: "faults.FaultPlan | None" = None,
+                 prefix_cache: bool = False):
         assert prefill_chunk is not None, (
             "the composed engine requires prefill_chunk: chunks are the "
             "migration unit AND the sharded engine's only prefill path")
@@ -157,7 +159,8 @@ class DisaggShardedEngine:
             metrics=self.metrics_decode, decode_horizon=decode_horizon,
             eos_id=eos_id, prefill_chunk=prefill_chunk,
             wire_dtype=wire_dtype, tp_impl=tp_impl, tp_cfg=tp_cfg,
-            moe_block_m=moe_block_m, digest_every=digest_every)
+            moe_block_m=moe_block_m, digest_every=digest_every,
+            prefix_cache=prefix_cache)
         self.decode._preempt_hook = self._on_decode_preempt
         self.mesh_desc = self.decode.mesh_desc
         self.wire_dtype = self.decode.wire_dtype
@@ -174,6 +177,13 @@ class DisaggShardedEngine:
             self.decode._pool_out_sharding)
         self.sched_p = ContinuousBatchingScheduler(num_prefill_slots,
                                                    queue_cap=queue_cap)
+        # prefix cache (ISSUE 13), disagg-shaped: one index per fleet.
+        # The PREFILL-fleet cache adopts solely-owned pages and skips the
+        # chunk compute inside the hit (every page still migrates); the
+        # decode fleet's own cache — constructed above — serves the
+        # degradation rung's local re-prefills.
+        self.prefix_cache = (PrefixCache(self.alloc_p, page_size)
+                             if prefix_cache else None)
 
         # -- the DCN-tier migration program: one jitted gather/scatter
         # copying up to pmax (src → dst) pages between the two pools, with
@@ -302,18 +312,55 @@ class DisaggShardedEngine:
         need = -(-len(req.prompt) // self.page_size)
         need_p = need - len(self.alloc_p.pages_of(req.rid))
         need_d = need - len(self.alloc_d.pages_of(req.rid))
-        return (self.alloc_p.free_pages >= max(need_p, 0)
-                and self.alloc_d.free_pages >= max(need_d, 0))
+        # refcount-0 cached pages count as reclaimable capacity on BOTH
+        # fleets: the prefill fleet evicts through its own index, the
+        # decode fleet through the sharded engine's (degradation-rung
+        # re-prefills populate it) — otherwise a full cached pool would
+        # wedge remote admission forever
+        avail_p = self.alloc_p.free_pages + (
+            self.prefix_cache.evictable if self.prefix_cache else 0)
+        avail_d = self.alloc_d.free_pages + (
+            self.decode.prefix_cache.evictable
+            if self.decode.prefix_cache else 0)
+        return avail_p >= max(need_p, 0) and avail_d >= max(need_d, 0)
+
+    def _cache_adopt(self, req: Request) -> None:
+        """Disagg-shaped adoption (sole-ownership rule): adopt the
+        longest prefix of the hit whose pages are ALL refcount-0, so the
+        acquired pages are solely owned and ``check_migratable`` accepts
+        them when their chunks migrate."""
+        cache = self.prefix_cache
+        if (cache is None or req.prefill_cursor > 0
+                or self.alloc_p.holds(req.rid)):
+            return
+        solo = []
+        for p in cache.match(req.prompt):
+            if self.alloc_p.refcount(p) != 0:
+                break
+            solo.append(p)
+        if not solo:
+            self.metrics.inc("prefix_misses")
+            return
+        self.alloc_p.acquire(req.rid, solo)
+        req.cache_hit_tokens = len(solo) * self.page_size
+        self.metrics.inc("prefix_hits")
+        self.metrics.inc("prefix_hit_tokens", req.cache_hit_tokens)
 
     def _admit_prefill(self, slot: int, req: Request) -> None:
+        self._cache_adopt(req)
         sp = len(req.prompt)
         need = -(-sp // self.page_size)
         have_p = len(self.alloc_p.pages_of(req.rid))
         if need > have_p:
+            short = (need - have_p) - self.alloc_p.free_pages
+            if short > 0 and self.prefix_cache is not None:
+                self.metrics.inc("prefix_evictions",
+                                 self.prefix_cache.evict(short))
             got = self.alloc_p.alloc(req.rid, need - have_p)
             assert got is not None, "admissible() guaranteed the pages"
         have_d = len(self.alloc_d.pages_of(req.rid))
         if need > have_d:
+            self.decode._reclaim(need - have_d)   # no-op when cache off
             got = self.alloc_d.alloc(req.rid, need - have_d)
             assert got is not None, "admissible() guaranteed the pages"
         self.sched_p.activate(slot, req)
@@ -339,27 +386,47 @@ class DisaggShardedEngine:
         C = self.prefill_chunk
         sp = len(req.prompt)
         start = req.prefill_cursor
-        toks = np.zeros(C, np.int32)
         part = req.prompt[start:start + C]
-        toks[:len(part)] = part
-        row = np.asarray(self.alloc_p.block_table_row(
-            req.rid, self.pages_per_seq), np.int32)
-        t0 = time.perf_counter()
-        tok_dev, self.pool_p = self.decode._chunk_step(
-            self.params, jnp.asarray(toks), jnp.asarray(start, jnp.int32),
-            jnp.asarray(sp, jnp.int32), self.pool_p, jnp.asarray(row))
-        tok0 = int(tok_dev)
-        dt = time.perf_counter() - t0
+        # cache-hit fast path (ISSUE 13, disagg semantics): a chunk fully
+        # inside the adopted prefix skips the device compute — the pages
+        # already hold that KV — but still migrates; the final chunk
+        # always computes (fused first-token argmax)
+        skip = start + C <= req.cache_hit_tokens and start + C < sp
+        tok0 = None
+        if not skip:
+            toks = np.zeros(C, np.int32)
+            toks[:len(part)] = part
+            row = np.asarray(self.alloc_p.block_table_row(
+                req.rid, self.pages_per_seq), np.int32)
+            t0 = time.perf_counter()
+            tok_dev, self.pool_p = self.decode._chunk_step(
+                self.params, jnp.asarray(toks),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(sp, jnp.int32), self.pool_p, jnp.asarray(row))
+            tok0 = int(tok_dev)
+            dt = time.perf_counter() - t0
         cursor_new = min(start + C, sp)
         req.prefill_cursor = cursor_new
-        self.metrics.inc("prefill_chunks")
-        self.metrics.observe("prefill_stall_s", dt)
+        if skip:
+            self.metrics.inc("prefix_skipped_chunks")
+        else:
+            self.metrics.inc("prefill_chunks")
+            self.metrics.observe("prefill_stall_s", dt)
         self._jlog("chunk", rid=req.rid, cursor=cursor_new)
         try:
             self._migrate_finalized(req, start, cursor_new)
         except SignalProtocolError as e:
             self._poison(slot, req, e)
         if req.state is RequestState.PREFILLING and cursor_new >= sp:
+            if self.prefix_cache is not None:
+                self.prefix_cache.insert(
+                    req.prompt,
+                    self.alloc_p.pages_of(req.rid)[:sp // self.page_size])
+                if req.first_token_time is None:
+                    self.metrics.observe(
+                        "ttft_cached_s" if req.cache_hit_tokens
+                        else "ttft_cold_s",
+                        time.perf_counter() - req.submit_time)
             req.first_token = tok0
             record_first_token(req, self.metrics, self._steps)
             self.metrics.inc("tokens_generated")
@@ -630,6 +697,7 @@ class DisaggShardedEngine:
         req.generated.clear()
         req.prefill_cursor = 0
         req.first_token = None
+        req.cache_hit_tokens = 0
         self.alloc_d.free_seq(rid)
         if self.alloc_p.holds(rid):
             self.alloc_p.free_seq(rid)
@@ -853,6 +921,14 @@ class DisaggShardedEngine:
             "pool_p_digest": self.alloc_p.digest(),
             "pool_d": self.alloc_d.snapshot(),
             "pool_d_digest": self.alloc_d.digest(),
+            "prefix_index": (None if self.prefix_cache is None
+                             else self.prefix_cache.snapshot()),
+            "prefix_digest": (None if self.prefix_cache is None
+                              else self.prefix_cache.digest()),
+            "prefix_index_d": (None if self.decode.prefix_cache is None
+                               else self.decode.prefix_cache.snapshot()),
+            "prefix_digest_d": (None if self.decode.prefix_cache is None
+                                else self.decode.prefix_cache.digest()),
             "live": [ckpt_mod.snapshot_request(r) for r in live],
             "finished": [ckpt_mod.snapshot_finished(r)
                          for r in self._finished],
@@ -878,6 +954,11 @@ class DisaggShardedEngine:
                                   reserved=1, sp_ranks=n_sp)
         self.sched_p = ContinuousBatchingScheduler(
             self.sched_p.num_slots, queue_cap=self.sched_p.queue_cap)
+        if self.prefix_cache is not None:
+            # empty cache on the fresh ledger: cached KV is device state,
+            # re-earned by re-prefill (the decode fleet's cache resets
+            # inside decode._restore_state the same way)
+            self.prefix_cache = PrefixCache(self.alloc_p, self.page_size)
         self.decode._restore_state(None)
         self._handoff.clear()
         self._dslot.clear()
@@ -899,6 +980,10 @@ class DisaggShardedEngine:
         ckpt_mod.audit_pool_snapshot(
             state["pool_d"], state["pool_d_digest"],
             self.alloc_d.num_pages, self.page_size, 1)
+        for ix, dg in (("prefix_index", "prefix_digest"),
+                       ("prefix_index_d", "prefix_digest_d")):
+            if state.get(ix) is not None:
+                ckpt_mod.audit_prefix_snapshot(state[ix], state[dg])
         self._steps = state["step"]
         self._next_rid = state["next_rid"]
         self.sched_p._admit_ticket = state["admit_ticket_p"]

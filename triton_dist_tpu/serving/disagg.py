@@ -76,6 +76,7 @@ from triton_dist_tpu.serving.journal import ControlJournal
 from triton_dist_tpu.serving.kv_pool import (KVPagePool, PageLedgerError,
                                              _fnv1a)
 from triton_dist_tpu.serving.metrics import ServingMetrics
+from triton_dist_tpu.serving.prefix_cache import PrefixCache
 from triton_dist_tpu.serving.scheduler import (AdmissionRejected,
                                                ContinuousBatchingScheduler,
                                                Request, RequestState,
@@ -426,7 +427,8 @@ class DisaggServingEngine:
                  journal: ControlJournal | None = None,
                  checkpoint_every: int | None = None,
                  queue_cap: int | None = None,
-                 ttl_steps: int | None = None):
+                 ttl_steps: int | None = None,
+                 prefix_cache: bool = False):
         assert prefill_chunk >= 1 and decode_horizon >= 1
         assert signal_deadline_steps >= 1 and max_retries >= 0
         assert checkpoint_every is None or checkpoint_every >= 1
@@ -474,6 +476,14 @@ class DisaggServingEngine:
         self.pool_v = ctx.create_symm_tensor(local, ref["v"].dtype, axis=axis)
         self.alloc_p = KVPagePool(num_pages + 1, page_size, reserved=1)
         self.alloc_d = KVPagePool(num_pages + 1, page_size, reserved=1)
+        # prefix cache (ISSUE 13) lives on the PREFILL pool only: hits
+        # skip the chunk compute but every page still migrates, so the
+        # decode worker never needs to know a prefix was cached. Adopted
+        # pages must be solely owned (check_migratable's refcount clause),
+        # so adoption stops at the first matched page another live
+        # request still references.
+        self.prefix_cache = (PrefixCache(self.alloc_p, page_size)
+                             if prefix_cache else None)
         # the bounded admission queue (ISSUE 9) guards the PREFILL worker's
         # intake — that is where fresh arrivals wait; preemption requeues
         # (front=True) are exempt by scheduler construction
@@ -644,14 +654,50 @@ class DisaggServingEngine:
         need = -(-len(req.prompt) // self.page_size)
         need_p = need - len(self.alloc_p.pages_of(req.rid))
         need_d = need - len(self.alloc_d.pages_of(req.rid))
-        return (self.alloc_p.free_pages >= max(need_p, 0)
+        # refcount-0 cached pages are reclaimable capacity on the prefill
+        # side (no hit discount: adoption trades evictable for owed 1:1,
+        # so the bound stays valid whether or not the prompt hits)
+        avail_p = self.alloc_p.free_pages + (
+            self.prefix_cache.evictable if self.prefix_cache else 0)
+        return (avail_p >= max(need_p, 0)
                 and self.alloc_d.free_pages >= max(need_d, 0))
 
+    def _cache_adopt(self, req: Request) -> None:
+        """Match the prompt against the prefix index and adopt the
+        longest SOLELY-ADOPTABLE prefix of the hit: every adopted page
+        must be refcount-0 (on the cached LRU list) so that after
+        ``acquire`` it is solely owned and ``check_migratable`` accepts
+        it. A matched page another live request still references
+        truncates the adoption there — correctness never depends on the
+        truncation, the chunks just recompute."""
+        cache = self.prefix_cache
+        if (cache is None or req.prefill_cursor > 0
+                or self.alloc_p.holds(req.rid)):
+            return
+        hit = cache.match(req.prompt)
+        solo = []
+        for p in hit:
+            if self.alloc_p.refcount(p) != 0:
+                break
+            solo.append(p)
+        if not solo:
+            self.metrics.inc("prefix_misses")
+            return
+        self.alloc_p.acquire(req.rid, solo)
+        req.cache_hit_tokens = len(solo) * self.page_size
+        self.metrics.inc("prefix_hits")
+        self.metrics.inc("prefix_hit_tokens", req.cache_hit_tokens)
+
     def _admit_prefill(self, slot: int, req: Request) -> None:
+        self._cache_adopt(req)
         sp = len(req.prompt)
         need = -(-sp // self.page_size)
         have_p = len(self.alloc_p.pages_of(req.rid))
         if need > have_p:
+            short = (need - have_p) - self.alloc_p.free_pages
+            if short > 0 and self.prefix_cache is not None:
+                self.metrics.inc("prefix_evictions",
+                                 self.prefix_cache.evict(short))
             got = self.alloc_p.alloc(req.rid, need - have_p)
             assert got is not None, "admissible() guaranteed the pages"
         # remote reservation: the decode worker's pages for this prompt
@@ -742,33 +788,48 @@ class DisaggServingEngine:
         if slot_p is None and local is None:
             return 0
         C = self.prefill_chunk
-        toks = np.zeros((2, C), np.int32)
-        starts = np.zeros(2, np.int32)
-        plens = np.zeros(2, np.int32)
-        bt = np.zeros((2, self.pages_per_seq), np.int32)
-        if req_p is not None:
-            part = req_p.prompt[req_p.prefill_cursor:
-                                req_p.prefill_cursor + C]
-            toks[PREFILL_ROLE, :len(part)] = part
-            starts[PREFILL_ROLE] = req_p.prefill_cursor
-            plens[PREFILL_ROLE] = len(req_p.prompt)
-            bt[PREFILL_ROLE] = np.asarray(self.alloc_p.block_table_row(
-                req_p.rid, self.pages_per_seq), np.int32)
-        if local is not None:
-            slot_d, req_d = local
-            part_d = req_d.prompt[req_d.prefill_cursor:
-                                  req_d.prefill_cursor + C]
-            toks[DECODE_ROLE, :len(part_d)] = part_d
-            starts[DECODE_ROLE] = req_d.prefill_cursor
-            plens[DECODE_ROLE] = len(req_d.prompt)
-            bt[DECODE_ROLE] = np.asarray(self.alloc_d.block_table_row(
-                req_d.rid, self.pages_per_seq), np.int32)
-        t0 = time.perf_counter()
-        tok_dev, self.pool_k, self.pool_v = self._chunk_step(
-            self.params, jnp.asarray(toks), jnp.asarray(starts),
-            jnp.asarray(plens), self.pool_k, self.pool_v, jnp.asarray(bt))
-        tok_np = np.asarray(tok_dev)                    # fence + maybe toks
-        dt = time.perf_counter() - t0
+        # cache-hit fast path (ISSUE 13): a chunk fully inside the
+        # adopted prefix skips the device compute — its pages already
+        # hold that KV — but still advances the cursor and still
+        # migrates, so the decode worker stays cache-oblivious. A chunk
+        # that straddles the hit boundary recomputes in full (a
+        # bit-identical rewrite into solely-owned pages, by greedy
+        # determinism), and the FINAL chunk always computes: its fused
+        # argmax produces the first token.
+        skip_p = (req_p is not None
+                  and req_p.prefill_cursor + C <= req_p.cache_hit_tokens
+                  and req_p.prefill_cursor + C < len(req_p.prompt))
+        tok_np = None
+        dt = 0.0
+        if not (skip_p and local is None):
+            toks = np.zeros((2, C), np.int32)
+            starts = np.zeros(2, np.int32)
+            plens = np.zeros(2, np.int32)
+            bt = np.zeros((2, self.pages_per_seq), np.int32)
+            if req_p is not None and not skip_p:
+                part = req_p.prompt[req_p.prefill_cursor:
+                                    req_p.prefill_cursor + C]
+                toks[PREFILL_ROLE, :len(part)] = part
+                starts[PREFILL_ROLE] = req_p.prefill_cursor
+                plens[PREFILL_ROLE] = len(req_p.prompt)
+                bt[PREFILL_ROLE] = np.asarray(self.alloc_p.block_table_row(
+                    req_p.rid, self.pages_per_seq), np.int32)
+            if local is not None:
+                slot_d, req_d = local
+                part_d = req_d.prompt[req_d.prefill_cursor:
+                                      req_d.prefill_cursor + C]
+                toks[DECODE_ROLE, :len(part_d)] = part_d
+                starts[DECODE_ROLE] = req_d.prefill_cursor
+                plens[DECODE_ROLE] = len(req_d.prompt)
+                bt[DECODE_ROLE] = np.asarray(self.alloc_d.block_table_row(
+                    req_d.rid, self.pages_per_seq), np.int32)
+            t0 = time.perf_counter()
+            tok_dev, self.pool_k, self.pool_v = self._chunk_step(
+                self.params, jnp.asarray(toks), jnp.asarray(starts),
+                jnp.asarray(plens), self.pool_k, self.pool_v,
+                jnp.asarray(bt))
+            tok_np = np.asarray(tok_dev)                # fence + maybe toks
+            dt = time.perf_counter() - t0
 
         ptoks = 0
         if req_p is not None:
@@ -777,8 +838,11 @@ class DisaggServingEngine:
             ptoks = min(C, sp - start)
             cursor_new = min(start + C, sp)
             req_p.prefill_cursor = cursor_new
-            self.metrics.inc("prefill_chunks")
-            self.metrics.observe("prefill_stall_s", dt)
+            if skip_p:
+                self.metrics.inc("prefix_skipped_chunks")
+            else:
+                self.metrics.inc("prefill_chunks")
+                self.metrics.observe("prefill_stall_s", dt)
             self._jlog("chunk", rid=req_p.rid, cursor=cursor_new)
             try:
                 self._migrate_finalized(req_p, start, cursor_new)
@@ -788,7 +852,19 @@ class DisaggServingEngine:
                 # prefill complete: the request leaves this worker's
                 # SCHEDULER, but its pages stay owned — they are the
                 # retry source until the decode side confirms coverage
-                # (released on the ACTIVE flip / degradation / failure)
+                # (released on the ACTIVE flip / degradation / failure).
+                # skip_p can't be set here (final chunks always compute),
+                # so tok_np is real.
+                if self.prefix_cache is not None:
+                    self.prefix_cache.insert(
+                        req_p.prompt,
+                        self.alloc_p.pages_of(req_p.rid)[
+                            :sp // self.page_size])
+                    if req_p.first_token_time is None:
+                        self.metrics.observe(
+                            "ttft_cached_s" if req_p.cache_hit_tokens
+                            else "ttft_cold_s",
+                            time.perf_counter() - req_p.submit_time)
                 req_p.first_token = int(tok_np[PREFILL_ROLE])
                 record_first_token(req_p, self.metrics, self._steps)
                 self.metrics.inc("tokens_generated")
@@ -850,6 +926,13 @@ class DisaggServingEngine:
             filled = -(-req.prefill_cursor // self.page_size)
             if filled < len(self.alloc_p.pages_of(req.rid)):
                 self.alloc_p.free_tail(req.rid, keep=filled)
+                # adopted pages past the kept prefix were just released
+                # (back to the cached list — still indexed): the resumed
+                # prefill re-allocs FRESH pages there, so the skip window
+                # must shrink to what the kept pages actually cover, or
+                # empty pages would migrate as if they held the prefix
+                req.cache_hit_tokens = min(req.cache_hit_tokens,
+                                           filled * self.page_size)
             else:
                 # no unfilled tail to reclaim: full restart. The decode
                 # reservation keeps its ids, so the restarted prefill
@@ -857,9 +940,11 @@ class DisaggServingEngine:
                 # identical recomputed contents, re-counted signals).
                 self.alloc_p.free_seq(req.rid)
                 req.prefill_cursor = 0
+                req.cache_hit_tokens = 0
         else:
             self.alloc_p.free_seq(req.rid)
             req.prefill_cursor = 0
+            req.cache_hit_tokens = 0
         self.sched_p.evict(slot)
         self.metrics.inc("preemptions")
         self._jlog("preempt", rid=req.rid, slot=slot, worker="prefill")
@@ -1125,6 +1210,7 @@ class DisaggServingEngine:
         req.generated.clear()
         req.prefill_cursor = 0
         req.first_token = None
+        req.cache_hit_tokens = 0
         self.alloc_d.free_seq(req.rid)
         if self.alloc_p.holds(req.rid):
             self.alloc_p.free_seq(req.rid)
@@ -1447,6 +1533,10 @@ class DisaggServingEngine:
             "pool_p_digest": self.alloc_p.digest(),
             "pool_d": self.alloc_d.snapshot(),
             "pool_d_digest": self.alloc_d.digest(),
+            "prefix_index": (None if self.prefix_cache is None
+                             else self.prefix_cache.snapshot()),
+            "prefix_digest": (None if self.prefix_cache is None
+                              else self.prefix_cache.digest()),
             "live": [ckpt_mod.snapshot_request(r) for r in live],
             "finished": [ckpt_mod.snapshot_finished(r)
                          for r in self._finished],
@@ -1473,6 +1563,11 @@ class DisaggServingEngine:
                                   reserved=1)
         self.alloc_d = KVPagePool(self.alloc_d.num_pages, self.page_size,
                                   reserved=1)
+        if self.prefix_cache is not None:
+            # the cache restarts EMPTY on the fresh ledger: cached KV is
+            # device state, and restore's contract is that every page's
+            # bytes are re-earned by re-prefill before any read
+            self.prefix_cache = PrefixCache(self.alloc_p, self.page_size)
         self.sched_p = ContinuousBatchingScheduler(
             self.sched_p.num_slots, queue_cap=self.sched_p.queue_cap)
         self.sched_d = ContinuousBatchingScheduler(self.num_slots)
@@ -1502,6 +1597,9 @@ class DisaggServingEngine:
         ckpt_mod.audit_pool_snapshot(
             state["pool_d"], state["pool_d_digest"],
             self.alloc_d.num_pages, self.page_size, 1)
+        if state.get("prefix_index") is not None:
+            ckpt_mod.audit_prefix_snapshot(state["prefix_index"],
+                                           state["prefix_digest"])
         self._steps = state["step"]
         self._next_rid = state["next_rid"]
         self.sched_p._admit_ticket = state["admit_ticket_p"]

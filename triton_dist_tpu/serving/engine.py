@@ -67,6 +67,7 @@ from triton_dist_tpu.serving.deadline import Deadline, EngineStallError
 from triton_dist_tpu.serving.journal import ControlJournal
 from triton_dist_tpu.serving.kv_pool import KVPagePool, _fnv1a, cache_to_pages
 from triton_dist_tpu.serving.metrics import ServingMetrics
+from triton_dist_tpu.serving.prefix_cache import PrefixCache
 from triton_dist_tpu.serving.scheduler import (AdmissionRejected,
                                                ContinuousBatchingScheduler,
                                                Request, RequestState,
@@ -152,9 +153,14 @@ class ServingEngine:
                  checkpoint_every: int | None = None,
                  queue_cap: int | None = None,
                  ttl_steps: int | None = None,
-                 fault_plan=None):
+                 fault_plan=None,
+                 prefix_cache: bool = False):
         assert decode_horizon >= 1
         assert prefill_chunk is None or prefill_chunk >= 1
+        assert not prefix_cache or prefill_chunk is not None, (
+            "prefix_cache needs prefill_chunk set — a cache hit resumes "
+            "chunked prefill at its cursor; the bucketed inline path has "
+            "no cursor to resume at")
         assert stall_deadline_steps >= 1
         assert checkpoint_every is None or checkpoint_every >= 1
         assert queue_cap is None or queue_cap >= 1
@@ -181,6 +187,13 @@ class ServingEngine:
         # never handed out and never check_migratable-accepted)
         self.alloc = KVPagePool(num_pages + 1, page_size, reserved=1,
                                 sp_ranks=getattr(self, "_pool_sp_ranks", 1))
+        # prefix cache (ISSUE 13): a radix index over full-page token
+        # runs of this pool's pages. Host-side control plane only — it
+        # changes WHICH pages a block table points at, never what the
+        # compiled programs look like, so compile counts and the sigcheck
+        # lint are identical with it on or off.
+        self.prefix_cache = PrefixCache(self.alloc, page_size) \
+            if prefix_cache else None
         self.sched = ContinuousBatchingScheduler(num_slots,
                                                  queue_cap=queue_cap)
         self._next_rid = 0
@@ -421,16 +434,85 @@ class ServingEngine:
             self._finish(slot)
 
     # -- chunked paged prefill (the PREFILLING state machine) -------------
+    def _cache_adopt(self, req: Request) -> None:
+        """Prefix-cache admission half (ISSUE 13): match the prompt
+        against the radix index and ADOPT the hit pages — refcounts bump,
+        the block table will point at them, and chunked prefill resumes
+        at the first miss (the same cursor mechanics a mid-prefill
+        preemptee uses). On a whole-prompt hit only the LAST position is
+        recomputed (its fused argmax is the first token); that write
+        lands in the final adopted page, so the page is COWed first when
+        shared — the one organic divergence point on the colocated path.
+        Only a FRESH admission matches: a preemptee resuming at its
+        cursor already owns its pages."""
+        cache = self.prefix_cache
+        if cache is None or req.prefill_cursor > 0 \
+                or self.alloc.holds(req.rid):
+            return
+        hit = cache.match(req.prompt)
+        sp = len(req.prompt)
+        if not hit:
+            self.metrics.inc("prefix_misses")
+            return
+        self.alloc.acquire(req.rid, hit)
+        hit_tokens = len(hit) * self.page_size
+        if hit_tokens >= sp:
+            # whole prompt cached — resume at sp-1, never sp: the final
+            # chunk must still run for its on-device first-token argmax
+            req.prefill_cursor = sp - 1
+            self._cow_writable(req, (sp - 1) // self.page_size)
+        else:
+            req.prefill_cursor = hit_tokens
+        req.cache_hit_tokens = req.prefill_cursor
+        self.metrics.inc("prefix_hits")
+        self.metrics.inc("prefix_hit_tokens", req.prefill_cursor)
+
+    def _reclaim(self, n_pages: int) -> None:
+        """Refill the free list to ``n_pages`` by LRU-evicting cached
+        (refcount-0) pages — the reclaim that composes BEFORE
+        youngest-victim preemption. No-op when already covered or the
+        cache is off/empty."""
+        short = n_pages - self.alloc.free_pages
+        if short > 0 and self.prefix_cache is not None:
+            self.metrics.inc("prefix_evictions",
+                             self.prefix_cache.evict(short))
+
+    def _cow_writable(self, req: Request, page_index: int) -> None:
+        """Copy-on-write guard: ``req`` is about to WRITE into its
+        ``page_index``-th page. Shared (refcount > 1) pages get a fresh
+        page swapped into the ledger and their bytes copied on device —
+        eager array ops, NOT a jitted program, so the one-program-per-
+        path compile contract is untouched. Sole-owned pages write in
+        place (greedy determinism makes the rewrite bit-identical, so
+        the index mapping stays valid)."""
+        pid = self.alloc.pages_of(req.rid)[page_index]
+        if self.alloc.refcount(pid) <= 1:
+            return
+        self._reclaim(1)
+        res = self.alloc.cow_page(req.rid, page_index)
+        assert res is not None, "admissible() guaranteed a COW page"
+        old, new = res
+        # the chunk's attention reads this page's earlier rows through
+        # the patched block-table row, so the copy must precede dispatch
+        self.pool = {
+            "k": self.pool["k"].at[:, new].set(self.pool["k"][:, old]),
+            "v": self.pool["v"].at[:, new].set(self.pool["v"][:, old]),
+        }
+        self.metrics.inc("cow_copies")
+
     def _admit_chunked(self, slot: int, req: Request) -> None:
-        """Chunked admission does NO prefill math: allocate the prompt's
-        pages (only the ones the request does not already own — a
-        mid-prefill preemptee kept its filled pages and resumes at its
+        """Chunked admission does NO prefill math: adopt any cached
+        prefix pages (refcount bump + cursor jump), allocate the prompt's
+        remaining pages (only the ones the request does not already own —
+        a mid-prefill preemptee kept its filled pages and resumes at its
         cursor) and park the slot in PREFILLING. The chunks themselves
         run one per engine step, co-scheduled with decode."""
+        self._cache_adopt(req)
         sp = len(req.prompt)
         n_pages = -(-sp // self.page_size)
         have = len(self.alloc.pages_of(req.rid))
         if n_pages > have:
+            self._reclaim(n_pages - have)
             got = self.alloc.alloc(req.rid, n_pages - have)
             assert got is not None, "admissible() guaranteed the pages"
         self.sched.activate(slot, req)
@@ -462,6 +544,16 @@ class ServingEngine:
         toks = np.zeros(C, np.int32)
         part = req.prompt[start:start + C]
         toks[:len(part)] = part
+        if self.prefix_cache is not None:
+            # COW guard over the chunk's write range: the chunk program
+            # never touches a page with refcount > 1 (ISSUE 13). The
+            # admission-time guard already covered the whole-prompt-hit
+            # rewrite, so these are no-ops unless a new sharing path
+            # appears — cheap insurance on the invariant.
+            end = min(start + C, sp)
+            for i in range(start // self.page_size,
+                           (end - 1) // self.page_size + 1):
+                self._cow_writable(req, i)
         row = np.asarray(
             self.alloc.block_table_row(req.rid, self.pages_per_seq),
             np.int32)
@@ -485,6 +577,19 @@ class ServingEngine:
         req.state = RequestState.ACTIVE
         req.generated.append(tok0)
         self.metrics.inc("tokens_generated")
+        if self.prefix_cache is not None:
+            # index the finished prompt's full pages BEFORE decode grows
+            # the sequence — later identical prompts adopt them. The
+            # partial last page (still being written by decode) is never
+            # indexed; already-indexed runs keep their existing mapping.
+            self.prefix_cache.insert(
+                req.prompt,
+                self.alloc.pages_of(req.rid)[:sp // self.page_size])
+            if req.first_token_time is None:
+                self.metrics.observe(
+                    "ttft_cached_s" if req.cache_hit_tokens
+                    else "ttft_cold_s",
+                    time.perf_counter() - req.submit_time)
         record_first_token(req, self.metrics, self._steps)
         self._token[slot] = tok0
         self._pos[slot] = sp
@@ -541,6 +646,20 @@ class ServingEngine:
         self.metrics.inc("preemptions")
         self._jlog("preempt", rid=req.rid, slot=slot)
 
+    def _ensure_pages(self, rid, kv_len: int) -> bool:
+        """``KVPagePool.ensure`` with cache headroom: LRU-evict cached
+        pages before declaring the pool dry, so eviction composes BEFORE
+        youngest-victim preemption (a refcount-0 cached page is always a
+        cheaper reclaim than restarting a live request)."""
+        while not self.alloc.ensure(rid, kv_len):
+            if self.prefix_cache is None:
+                return False
+            freed = self.prefix_cache.evict(1)
+            if not freed:
+                return False
+            self.metrics.inc("prefix_evictions", freed)
+        return True
+
     def _park(self, slot: int) -> None:
         """Point an empty slot at the scratch page: its row writes land on
         page 0 (reserved — never a live sequence's), its reads mask out."""
@@ -589,7 +708,13 @@ class ServingEngine:
             if self.prefill_chunk is not None:
                 # a mid-prefill preemptee kept its filled pages
                 need -= len(self.alloc.pages_of(req.rid))
-            return self.alloc.free_pages >= need
+            avail = self.alloc.free_pages
+            if self.prefix_cache is not None:
+                # cached (refcount-0) pages are reclaimable on demand —
+                # admission evicts them as needed, and any page the hit
+                # ADOPTS instead was counted in ``need`` anyway
+                avail += self.prefix_cache.evictable
+            return avail >= need
 
         admitted = 0
         prefilled_tokens = 0
@@ -625,7 +750,7 @@ class ServingEngine:
             if req is None or req.state is not RequestState.ACTIVE:
                 continue            # mid-prefill slots do not decode
             pos = int(self._pos[slot])
-            while not self.alloc.ensure(req.rid, pos + 1):
+            while not self._ensure_pages(req.rid, pos + 1):
                 victim = self.sched.pick_victim(exclude_slot=slot)
                 if victim is None:
                     raise RuntimeError(
@@ -634,7 +759,7 @@ class ServingEngine:
                 self._preempt(victim)
             want = min(self.decode_horizon, req.remaining)
             lim = 1
-            while lim < want and self.alloc.ensure(req.rid, pos + lim + 1):
+            while lim < want and self._ensure_pages(req.rid, pos + lim + 1):
                 lim += 1
             limits[slot] = lim
             # refresh AFTER growth — the kernel writes this scan's (k, v)
@@ -832,6 +957,13 @@ class ServingEngine:
             "admit_ticket": self.sched._admit_ticket,
             "pool": self.alloc.snapshot(),
             "pool_digest": self.alloc.digest(),
+            # prefix index (ISSUE 13): integrity artifact, like the pool
+            # snapshot — restore starts with an EMPTY cache (re-prefill
+            # re-earns KV; pre-crash device bytes are never adopted)
+            "prefix_index": None if self.prefix_cache is None
+            else self.prefix_cache.snapshot(),
+            "prefix_digest": None if self.prefix_cache is None
+            else self.prefix_cache.digest(),
             "live": [ckpt_mod.snapshot_request(r) for r in live],
             "finished": [ckpt_mod.snapshot_finished(r)
                          for r in self._finished],
@@ -850,6 +982,10 @@ class ServingEngine:
         self.alloc = KVPagePool(self.alloc.num_pages, self.page_size,
                                 reserved=self.alloc.reserved,
                                 sp_ranks=self.alloc.sp_ranks)
+        if self.prefix_cache is not None:
+            # fresh pool → fresh (empty) index: every cached mapping
+            # pointed at KV the restored process never computed
+            self.prefix_cache = PrefixCache(self.alloc, self.page_size)
         self.sched = ContinuousBatchingScheduler(
             self.num_slots, queue_cap=self.sched.queue_cap)
         self._finished = []
@@ -865,6 +1001,9 @@ class ServingEngine:
         ckpt_mod.audit_pool_snapshot(
             state["pool"], state["pool_digest"], self.alloc.num_pages,
             self.page_size, self.alloc.reserved)
+        if state.get("prefix_index") is not None:
+            ckpt_mod.audit_prefix_snapshot(state["prefix_index"],
+                                           state["prefix_digest"])
         self._steps = state["step"]
         self._next_rid = state["next_rid"]
         self.sched._admit_ticket = state["admit_ticket"]

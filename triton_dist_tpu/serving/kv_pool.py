@@ -115,6 +115,15 @@ class KVPagePool:
         # LIFO: lowest ids on top, so fresh pools allocate reserved, 1, 2…
         self._free = list(range(num_pages - 1, reserved - 1, -1))
         self._owned: dict[object, list[int]] = {}
+        # prefix caching (ISSUE 13): every referenced page carries a
+        # refcount (1 for a plain allocation; >1 when the prefix cache
+        # shares it across sequences). ``_cacheable`` marks pages the
+        # prefix index holds; a cacheable page whose last reference drops
+        # is RETAINED on the ``_cached`` LRU list (oldest first) instead
+        # of returning to the free list — reclaimable, never a leak.
+        self._refs: dict[int, int] = {}
+        self._cached: list[int] = []
+        self._cacheable: set[int] = set()
 
     # -- introspection ----------------------------------------------------
     @property
@@ -134,6 +143,24 @@ class KVPagePool:
 
     def holds(self, seq_id) -> bool:
         return seq_id in self._owned
+
+    def refcount(self, page_id: int) -> int:
+        """How many sequences hold ``page_id`` right now (0 = free or
+        cached). The COW guard: a writer must never touch a page whose
+        refcount exceeds 1."""
+        return self._refs.get(page_id, 0)
+
+    @property
+    def cached_pages(self) -> int:
+        """Refcount-0 pages retained for the prefix index — reclaimable
+        on demand (LRU), counted as used by ``occupancy`` because they
+        hold live KV bytes."""
+        return len(self._cached)
+
+    def lru_cached(self) -> list[int]:
+        """Cached (refcount-0, index-retained) pages, oldest first — the
+        eviction scan order. Copy; mutations go through ``uncache``."""
+        return list(self._cached)
 
     def page_shard(self, page_id: int) -> int:
         """Which SP rank's device shard holds ``page_id`` under the
@@ -162,6 +189,13 @@ class KVPagePool:
         h = _fnv1a(h, len(self._free), *self._free)
         for sid, pages in self._owned.items():
             h = _fnv1a(h, hash(sid) & 0xFFFFFFFF, len(pages), *pages)
+        # prefix-cache state (ISSUE 13): refcounts by page id, the cached
+        # LRU order, and the index-retention marks — all allocation
+        # DECISIONS, all still independent of ``sp_ranks``
+        for p in sorted(self._refs):
+            h = _fnv1a(h, p, self._refs[p])
+        h = _fnv1a(h, len(self._cached), *self._cached)
+        h = _fnv1a(h, len(self._cacheable), *sorted(self._cacheable))
         return h
 
     # -- checkpointing (ISSUE 9) ------------------------------------------
@@ -173,7 +207,11 @@ class KVPagePool:
         recorded at capture time (a torn snapshot fails loudly instead of
         silently double-owning pages after a restore)."""
         return {"free": list(self._free),
-                "owned": [[sid, list(pages)] for sid, pages in self._owned.items()]}
+                "owned": [[sid, list(pages)]
+                          for sid, pages in self._owned.items()],
+                "refs": [[p, self._refs[p]] for p in sorted(self._refs)],
+                "cached": list(self._cached),
+                "cacheable": sorted(self._cacheable)}
 
     @classmethod
     def from_snapshot(cls, snap: dict, num_pages: int, page_size: int,
@@ -186,6 +224,18 @@ class KVPagePool:
         pool._free = [int(p) for p in snap["free"]]
         pool._owned = {sid: [int(p) for p in pages]
                        for sid, pages in snap["owned"]}
+        # restored VERBATIM (not re-derived from ownership multiplicity):
+        # the checkpoint integrity audit digests the rebuilt pool against
+        # the capture-time value, so a tampered refcount/cache field must
+        # surface as a digest mismatch, not be silently repaired
+        if "refs" in snap:
+            pool._refs = {int(p): int(c) for p, c in snap["refs"]}
+        else:           # pre-cache snapshot: refcounts are the ownership
+            for pages in pool._owned.values():
+                for p in pages:
+                    pool._refs[p] = pool._refs.get(p, 0) + 1
+        pool._cached = [int(p) for p in snap.get("cached", ())]
+        pool._cacheable = {int(p) for p in snap.get("cacheable", ())}
         return pool
 
     # -- allocation -------------------------------------------------------
@@ -195,8 +245,60 @@ class KVPagePool:
         if n_pages > len(self._free):
             return None
         got = [self._free.pop() for _ in range(n_pages)]
+        for p in got:
+            self._refs[p] = 1
         self._owned.setdefault(seq_id, []).extend(got)
         return got
+
+    def acquire(self, seq_id, page_ids) -> None:
+        """Adopt ``page_ids`` for ``seq_id`` — the prefix-cache hit path
+        (ISSUE 13). Each page must already hold live KV: either cached
+        (refcount 0, retained for the index — it leaves the LRU list) or
+        referenced by other sequences (its refcount climbs). Appended to
+        the sequence's page list IN ORDER (pages are positional). All
+        checks run before any mutation, so a refused acquire changes
+        nothing."""
+        own = set(self._owned.get(seq_id, ()))
+        seen: set[int] = set()
+        for p in page_ids:
+            if not (self.reserved <= p < self.num_pages):
+                raise PageLedgerError(
+                    f"cannot adopt out-of-range page {p} (seq {seq_id!r})")
+            if p in own or p in seen:
+                raise PageLedgerError(
+                    f"seq {seq_id!r} already holds page {p}")
+            seen.add(p)
+            if self._refs.get(p, 0) == 0 and p not in self._cached:
+                raise PageLedgerError(
+                    f"page {p} holds no live KV (free?) — refusing to "
+                    f"adopt it for seq {seq_id!r}")
+        for p in page_ids:
+            if self._refs.get(p, 0) == 0:
+                self._cached.remove(p)
+            self._refs[p] = self._refs.get(p, 0) + 1
+            self._owned.setdefault(seq_id, []).append(p)
+
+    def _release_page(self, seq_id, p: int) -> bool:
+        """Drop one reference to ``p``. On the LAST reference the page
+        returns to the free list — unless the prefix index retains it
+        (``_cacheable``), in which case it parks on the cached LRU list.
+        True iff the page actually left the referenced set."""
+        r = self._refs.get(p, 0)
+        if r <= 0:
+            raise PageLedgerError(
+                f"refcount underflow on page {p} (seq {seq_id!r})")
+        if r > 1:
+            self._refs[p] = r - 1
+            return False
+        del self._refs[p]
+        if p in self._free:
+            raise PageLedgerError(
+                f"double free of page {p} (seq {seq_id!r})")
+        if p in self._cacheable:
+            self._cached.append(p)      # MRU position
+        else:
+            self._free.append(p)
+        return True
 
     def ensure(self, seq_id, kv_len: int) -> bool:
         """Allocate-on-decode growth: make ``seq_id`` own enough pages to
@@ -222,10 +324,7 @@ class KVPagePool:
                 f"owning {len(pages)} pages")
         tail = pages[keep:]
         for p in tail:
-            if p in self._free:
-                raise PageLedgerError(
-                    f"double free of page {p} (seq {seq_id!r})")
-            self._free.append(p)
+            self._release_page(seq_id, p)
         if keep:
             self._owned[seq_id] = pages[:keep]
         else:
@@ -237,11 +336,63 @@ class KVPagePool:
         ``seq_id`` to the free list. Returns how many were freed."""
         pages = self._owned.pop(seq_id, [])
         for p in pages:
-            if p in self._free:
-                raise PageLedgerError(
-                    f"double free of page {p} (seq {seq_id!r})")
-            self._free.append(p)
+            self._release_page(seq_id, p)
         return len(pages)
+
+    # -- prefix-cache retention + copy-on-write (ISSUE 13) ----------------
+    def mark_cacheable(self, page_id: int) -> None:
+        """Flag ``page_id`` as held by the prefix index: when its last
+        reference drops it parks on the cached LRU list instead of the
+        free list. Only live pages can be marked — a free page holds no
+        KV worth retaining."""
+        if not (self.reserved <= page_id < self.num_pages):
+            raise PageLedgerError(
+                f"cannot index out-of-range page {page_id}")
+        if page_id in self._free:
+            raise PageLedgerError(
+                f"cannot index free page {page_id} — it holds no KV")
+        self._cacheable.add(page_id)
+
+    def uncache(self, page_id: int) -> bool:
+        """Drop the index retention mark (eviction / index invalidation).
+        If the page is sitting on the cached LRU list it returns to the
+        free list NOW; if it is still referenced it simply frees normally
+        on its last release. True iff a cached page was reclaimed."""
+        self._cacheable.discard(page_id)
+        if page_id in self._cached:
+            self._cached.remove(page_id)
+            self._free.append(page_id)
+            return True
+        return False
+
+    def cow_page(self, seq_id, index: int) -> tuple[int, int] | None:
+        """Copy-on-write ledger half: ``seq_id`` is about to WRITE its
+        ``index``-th page but shares it (refcount > 1), so swap a fresh
+        page into its page list and drop one reference on the shared one
+        (which stays alive for its other holders / the index). Returns
+        ``(old_id, new_id)`` — the caller must copy the device page bytes
+        old → new before any read — or ``None`` when the pool is dry
+        (caller evicts or preempts, then retries). Refuses a COW of a
+        sole-owned page: writing in place is correct there, and a silent
+        pointless copy would hide an engine-side guard bug."""
+        pages = self._owned.get(seq_id, [])
+        if not 0 <= index < len(pages):
+            raise PageLedgerError(
+                f"cow_page(index={index}) out of range for seq "
+                f"{seq_id!r} owning {len(pages)} pages")
+        old = pages[index]
+        if self._refs.get(old, 0) <= 1:
+            raise PageLedgerError(
+                f"COW of page {old} with refcount "
+                f"{self._refs.get(old, 0)} — copy-on-write is only for "
+                f"shared pages (seq {seq_id!r})")
+        if not self._free:
+            return None
+        new = self._free.pop()
+        self._refs[new] = 1
+        pages[index] = new
+        self._refs[old] -= 1
+        return old, new
 
     # -- migration support (disaggregated serving, ISSUE 6) ---------------
     def check_migratable(self, seq_id, page_ids) -> None:
@@ -270,6 +421,12 @@ class KVPagePool:
                 raise PageLedgerError(
                     f"page {p} is not owned by seq {seq_id!r} — refusing "
                     "to migrate a foreign page")
+            if self._refs.get(p, 0) > 1:
+                raise PageLedgerError(
+                    f"page {p} is shared (refcount {self._refs[p]}) — "
+                    f"migration requires sole ownership; a migrated page "
+                    f"is rewritten at the destination while other "
+                    f"sequences still read it here (seq {seq_id!r})")
 
     def landed_row(self, seq_id, covered, pages_per_seq: int,
                    fill: int = 0) -> list[int]:
@@ -303,26 +460,47 @@ class KVPagePool:
         Invariants:
         - every free id is in range ``[reserved, num_pages)`` and listed
           exactly once;
-        - every owned id is in range, owned by exactly ONE sequence, and
-          not simultaneously free;
-        - free + owned together account for every non-reserved page
-          (count conservation — no leaked, no conjured pages);
+        - every owned id is in range, not simultaneously free, and held
+          by exactly ``refcount`` sequences (a page in two sequences'
+          lists without a matching refcount is corruption, with one it
+          is prefix sharing);
+        - every refcount is positive and matches the ownership
+          multiplicity; every cached page has refcount 0, carries the
+          index-retention mark, and is neither free nor owned;
+        - free + referenced + cached together account for every
+          non-reserved page (count conservation — cached pages are
+          reclaimable, never audited as leaks);
         - (with ``ledger``) every page a chunk expects to land for a
           sequence is owned by that sequence here, landed never exceeds
           expected per chunk, and the covered set never exceeds the
           sequence's allocation (landed prefix <= allocated).
         """
         owner: dict[int, object] = {}
+        mult: dict[int, int] = {}
         for sid, pages in self._owned.items():
+            seen: set[int] = set()
             for p in pages:
                 if not (self.reserved <= p < self.num_pages):
                     raise PageLedgerError(
                         f"seq {sid!r} owns out-of-range page {p}")
-                if p in owner:
+                if p in seen:
                     raise PageLedgerError(
-                        f"page {p} owned twice: seq {owner[p]!r} and "
-                        f"seq {sid!r}")
-                owner[p] = sid
+                        f"seq {sid!r} lists page {p} twice")
+                seen.add(p)
+                mult[p] = mult.get(p, 0) + 1
+                owner.setdefault(p, sid)
+        for p, n in mult.items():
+            if self._refs.get(p, 0) != n:
+                raise PageLedgerError(
+                    f"page {p} held by {n} sequence(s) but refcount is "
+                    f"{self._refs.get(p, 0)}")
+        for p, r in self._refs.items():
+            if r <= 0:
+                raise PageLedgerError(
+                    f"page {p} carries non-positive refcount {r}")
+            if p not in mult:
+                raise PageLedgerError(
+                    f"page {p} has refcount {r} but no owning sequence")
         free = set(self._free)
         if len(free) != len(self._free):
             raise PageLedgerError("duplicate ids on the free list")
@@ -332,12 +510,31 @@ class KVPagePool:
             if p in owner:
                 raise PageLedgerError(
                     f"page {p} is both free and owned by seq {owner[p]!r}")
-        total = len(free) + len(owner)
+            if p in self._cacheable:
+                raise PageLedgerError(
+                    f"page {p} is free yet still index-retained")
+        cached = set(self._cached)
+        if len(cached) != len(self._cached):
+            raise PageLedgerError("duplicate ids on the cached LRU list")
+        for p in cached:
+            if not (self.reserved <= p < self.num_pages):
+                raise PageLedgerError(
+                    f"out-of-range page {p} on the cached list")
+            if p in owner:
+                raise PageLedgerError(
+                    f"page {p} is cached (refcount 0) yet owned by seq "
+                    f"{owner[p]!r}")
+            if p in free:
+                raise PageLedgerError(f"page {p} is both cached and free")
+            if p not in self._cacheable:
+                raise PageLedgerError(
+                    f"page {p} is cached without an index-retention mark")
+        total = len(free) + len(owner) + len(cached)
         if total != self.num_pages - self.reserved:
             raise PageLedgerError(
                 f"page conservation violated: {len(free)} free + "
-                f"{len(owner)} owned != {self.num_pages - self.reserved} "
-                "non-reserved pages")
+                f"{len(owner)} referenced + {len(cached)} cached != "
+                f"{self.num_pages - self.reserved} non-reserved pages")
         if ledger is None:
             return
         for sid in ledger.rids():

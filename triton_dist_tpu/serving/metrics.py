@@ -132,6 +132,20 @@ class ServingMetrics:
             "digest_recoveries": 0,
             "rejections": 0,
             "expirations": 0,
+            # prefix caching (ISSUE 13): admissions that adopted at least
+            # one cached page vs admissions that matched nothing, total
+            # prompt tokens served straight from adopted pages (never
+            # recomputed), copy-on-write page copies (a writer diverged
+            # from a shared page), and cached pages reclaimed by LRU
+            # eviction to refill the free list
+            "prefix_hits": 0,
+            "prefix_misses": 0,
+            "prefix_hit_tokens": 0,
+            "cow_copies": 0,
+            "prefix_evictions": 0,
+            "prefix_skipped_chunks": 0,
+            "router_radix_hits": 0,
+            "router_radix_misses": 0,
         }
         self.hist = {
             "ttft_s": Histogram(),
@@ -181,6 +195,11 @@ class ServingMetrics:
             "checkpoint_s": Histogram(),
             "restore_s": Histogram(),
             "digest_recovery_s": Histogram(),
+            # prefix caching (ISSUE 13): the TTFT split the cache exists
+            # to move — first-token latency of admissions that adopted
+            # cached pages vs ones that prefilled from scratch
+            "ttft_cached_s": Histogram(),
+            "ttft_cold_s": Histogram(),
         }
         self._t0 = time.perf_counter()
 

@@ -1,0 +1,247 @@
+"""Prefix cache over ``KVPagePool`` (ISSUE 13): a token-keyed radix index
+mapping FULL-PAGE token runs to the page ids holding their computed KV.
+
+The million-user workload is dominated by shared prefixes (system
+prompts, few-shot headers). Greedy decode makes KV a pure function of
+the token prefix, so a page that holds the KV of tokens
+``[i*page_size, (i+1)*page_size)`` for one request holds it for EVERY
+request whose prompt starts with the same ``(i+1)*page_size`` tokens —
+repeated prefills become page-table pointer swaps. In the paper's
+producer/consumer-over-pages framing, a cached page is simply a page
+whose producer already ran.
+
+Division of labor:
+
+- ``KVPagePool`` (kv_pool.py) owns the refcount mechanics: ``acquire``
+  bumps a shared page's count, release parks the last reference of an
+  index-retained page on the cached LRU list instead of the free list,
+  ``cow_page`` swaps a fresh page under a would-be writer of a shared
+  one, and ``check()``/``digest()`` audit all of it.
+- ``PrefixCache`` (this module) owns the token-keyed index: a radix
+  trie whose edges are page-sized token runs, ``match`` walks the
+  longest cached prefix, ``insert`` registers a finished prefill's
+  pages, and ``evict`` reclaims refcount-0 cached pages in LRU order
+  (dropping each victim's whole subtree — a child run's KV is
+  meaningless without its parent's pages).
+
+First-writer-wins: if two requests compute the same prefix before
+either is indexed, the first ``insert`` claims the trie edge and the
+second request's duplicate pages free normally at finish — greedy
+determinism guarantees their bytes were identical anyway, which is also
+why adopting cached pages preserves the bit-identical trace contract.
+
+``ReplicaPrefixIndex`` is the cluster-router variant of the same trie
+(ISSUE 13 satellite): runs map to replica indices instead of page ids,
+so the router can send a prompt to the replica whose cache most likely
+holds its prefix — radix-hit routing with rendezvous-hash fallback.
+"""
+
+from __future__ import annotations
+
+from .kv_pool import KVPagePool, PageLedgerError, _fnv1a
+
+
+class _Node:
+    """One radix-trie node: the page holding the KV of ``run`` (the
+    page-sized token run on the edge above), its parent, and children
+    keyed by the NEXT run. Insertion-ordered children keep every walk
+    deterministic."""
+    __slots__ = ("page", "run", "parent", "children")
+
+    def __init__(self, page=None, run=None, parent=None):
+        self.page = page
+        self.run = run
+        self.parent = parent
+        self.children: dict[tuple, "_Node"] = {}
+
+
+class PrefixCache:
+    """Token-run radix index over one ``KVPagePool``.
+
+    Only FULL pages are indexed: a partially-filled last page is still
+    being written by its owner (decode appends there), so it can never
+    be shared. ``match`` therefore returns whole-page hits only, and the
+    engine resumes chunked prefill at the first missing token.
+    """
+
+    def __init__(self, pool: KVPagePool, page_size: int):
+        assert page_size >= 1
+        self.pool = pool
+        self.page_size = page_size
+        self._root = _Node()
+        self._node_of: dict[int, _Node] = {}
+
+    # -- token-run helpers ------------------------------------------------
+    def _runs(self, prompt) -> list[tuple]:
+        ps = self.page_size
+        return [tuple(prompt[i:i + ps])
+                for i in range(0, (len(prompt) // ps) * ps, ps)]
+
+    @property
+    def indexed_pages(self) -> int:
+        return len(self._node_of)
+
+    @property
+    def evictable(self) -> int:
+        """Refcount-0 cached pages reclaimable right now — the headroom
+        admission adds to the free-page count."""
+        return self.pool.cached_pages
+
+    # -- lookup / registration --------------------------------------------
+    def match(self, prompt) -> list[int]:
+        """Page ids of the longest indexed full-page prefix of
+        ``prompt``, in position order (may be empty)."""
+        node, out = self._root, []
+        for run in self._runs(prompt):
+            child = node.children.get(run)
+            if child is None:
+                break
+            out.append(child.page)
+            node = child
+        return out
+
+    def insert(self, prompt, pages) -> int:
+        """Index ``pages[i]`` as holding the KV of ``prompt``'s i-th
+        full-page run. Existing mappings win (first-writer-wins); newly
+        indexed pages are marked cacheable on the pool so their last
+        release parks them on the cached LRU list. Returns how many
+        pages were newly indexed."""
+        runs = self._runs(prompt)
+        if len(pages) > len(runs):
+            raise PageLedgerError(
+                f"insert: {len(pages)} pages for only {len(runs)} "
+                f"full-page runs of a {len(prompt)}-token prompt")
+        node, new = self._root, 0
+        for run, page in zip(runs, pages):
+            child = node.children.get(run)
+            if child is None:
+                if page in self._node_of:
+                    raise PageLedgerError(
+                        f"page {page} is already indexed under a "
+                        "different token run")
+                child = _Node(page, run, node)
+                node.children[run] = child
+                self._node_of[page] = child
+                self.pool.mark_cacheable(page)
+                new += 1
+            node = child
+        return new
+
+    # -- eviction (LRU, subtree-consistent) -------------------------------
+    def evict(self, want: int) -> int:
+        """Reclaim at least ``want`` pages for the free list by retiring
+        cached (refcount-0) pages in LRU order. Each victim's ENTIRE
+        subtree leaves the index — a child run's KV is unreachable
+        without its parent's pages — so one eviction may free several
+        cached pages (all counted). Subtree pages still referenced by
+        running sequences merely lose their retention mark: they free
+        normally on their last release. Returns pages actually freed;
+        less than ``want`` means the cache is out of evictable pages."""
+        freed = 0
+        while freed < want:
+            lru = self.pool.lru_cached()
+            if not lru:
+                break
+            node = self._node_of.get(lru[0])
+            if node is None:        # cached without an index entry —
+                raise PageLedgerError(   # uncache() should have run
+                    f"cached page {lru[0]} has no index node")
+            freed += self._drop_subtree(node)
+        return freed
+
+    def _drop_subtree(self, node: _Node) -> int:
+        if node.parent is not None:
+            del node.parent.children[node.run]
+            node.parent = None
+        freed, stack = 0, [node]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            n.children = {}
+            if n.page is not None:
+                self._node_of.pop(n.page, None)
+                if self.pool.uncache(n.page):
+                    freed += 1
+        return freed
+
+    def clear(self) -> int:
+        """Drop the whole index (restore path: a rebuilt pool re-earns
+        every page via re-prefill, so no pre-crash KV may be adopted)."""
+        return self._drop_subtree(self._root) if self._root.children \
+            else 0
+
+    # -- checkpoint audit (ISSUE 9 satellite) -----------------------------
+    def snapshot(self) -> list:
+        """JSON-able preorder edge list ``[parent_page, run, page]``
+        (root parent encoded as -1), deterministic given the insertion
+        history. Checkpoints record it next to the pool snapshot purely
+        as an integrity artifact: restore re-earns KV via re-prefill and
+        starts with an EMPTY cache, but a torn/tampered snapshot must
+        still fail the digest audit loudly."""
+        out, stack = [], [self._root]
+        while stack:
+            n = stack.pop()
+            for c in n.children.values():
+                out.append([-1 if n.page is None else n.page,
+                            list(c.run), c.page])
+                stack.append(c)
+        return out
+
+    def digest(self) -> int:
+        return self.snapshot_digest(self.snapshot())
+
+    @staticmethod
+    def snapshot_digest(entries) -> int:
+        """32-bit FNV-1a over a ``snapshot()`` edge list — order,
+        tokens, parentage and page ids all fold in, so any single-field
+        tamper shifts the digest."""
+        h = 0x811C9DC5
+        for parent, run, page in entries:
+            h = _fnv1a(h, parent, len(run), *run, page)
+        return h
+
+
+class ReplicaPrefixIndex:
+    """The cluster router's radix index (ISSUE 13 satellite): page-sized
+    token runs map to the replica that last served that prefix. Pure
+    host-side control plane — no pool, no refcounts — but the same
+    full-run granularity as ``PrefixCache`` so a router hit predicts an
+    engine-side cache hit. First-writer-wins keeps routing sticky and
+    deterministic; a dead replica's entries stay in place (the caller
+    falls back to rendezvous hashing and the affinity returns with the
+    replica)."""
+
+    def __init__(self, block: int):
+        assert block >= 1
+        self.block = block
+        self._root: dict = {}
+
+    def _runs(self, prompt) -> list[tuple]:
+        b = self.block
+        return [tuple(prompt[i:i + b])
+                for i in range(0, (len(prompt) // b) * b, b)]
+
+    def match(self, prompt) -> tuple[int, int | None]:
+        """(hit depth in runs, replica index of the DEEPEST hit node) —
+        ``(0, None)`` on a miss."""
+        node, depth, owner = self._root, 0, None
+        for run in self._runs(prompt):
+            child = node.get(run)
+            if child is None:
+                break
+            depth += 1
+            owner = child[0]
+            node = child[1]
+        return depth, owner
+
+    def insert(self, prompt, replica: int) -> None:
+        node = self._root
+        for run in self._runs(prompt):
+            child = node.get(run)
+            if child is None:
+                child = (replica, {})
+                node[run] = child
+            node = child[1]
+
+
+__all__ = ["PrefixCache", "ReplicaPrefixIndex"]

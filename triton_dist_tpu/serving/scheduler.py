@@ -117,6 +117,11 @@ class Request:
     # ``ttl_steps`` is configured; ``expire()`` sweeps never-admitted
     # queued requests whose deadline has passed. None = no TTL.
     deadline: Deadline | None = None
+    # prefix cache (ISSUE 13): prompt tokens served by adopting cached
+    # pages at first admission (0 = cold). Drives the cached-vs-cold
+    # TTFT split; re-admissions after preemption keep the original value
+    # (the clock, like the hit, belongs to the first admission).
+    cache_hit_tokens: int = 0
 
     @property
     def kv_len(self) -> int:
