@@ -1684,6 +1684,88 @@ def bench_prefix_cache(ctx, num_requests: int = 40, templates: int = 4,
     }
 
 
+def bench_slo(ctx, n: int = 48, num_slots: int = 4, page_size: int = 8,
+              num_pages: int = 16, pages_per_seq: int = 8,
+              n_layers: int = 2) -> dict:
+    """Multi-tenant SLO rows (ISSUE 14): the bursty two-class workload
+    (``serving/workload.py``) through ``ServingEngine`` under the
+    chat/batch WFQ policy, twice — chat arrivals alone (the uncontended
+    golden) and the full trace with the batch burst riding along — with
+    every admitted chat token asserted bit-identical between the runs
+    (isolation is a correctness claim here, not just a latency one).
+
+    - ``serving_ttft_p99_us{class=...}`` / ``serving_itl_p99_us{class=...}``
+      (and p50s): the per-class split the policy exists to separate —
+      chat latency under flood vs the batch tier absorbing the damage.
+    - ``serving_slo_shed{class=batch}``: typed batch terminals
+      (REJECTED + TtlExpired) while chat sheds nothing.
+    - ``serving_slo_quota_throttled`` / ``serving_slo_chunk_shrinks``:
+      token-bucket skips and deadline-aware prefill-chunk shrinks — both
+      through the already-compiled chunk program (compile_stats is
+      asserted flat across policy-off/policy-on).
+    """
+    from triton_dist_tpu.models.llama import LlamaConfig, init_params
+    from triton_dist_tpu.serving import ServingEngine, SLOPolicy
+    from triton_dist_tpu.serving.workload import (generate_arrivals,
+                                                  parse_workload)
+
+    cfg = LlamaConfig.tiny(n_layers=n_layers)
+    params = init_params(jax.random.key(7), cfg)
+    spec = parse_workload(
+        f"n={n},seed=11,chat=0.6,rate=0.8,burst_every=32,burst_len=8,"
+        "burst_x=4,zipf=1.2,prefixes=4,tenants=2,plen=4:20,mnt=2:8")
+    trace = generate_arrivals(spec, vocab=cfg.vocab_size,
+                              page_size=page_size)
+    slo = SLOPolicy.chat_batch(chat_weight=4, batch_weight=1,
+                               batch_queue_cap=8, batch_ttl_steps=60,
+                               chat_stall_budget=4, quotas={"b0": (1, 4)})
+
+    def _run(arrivals, policy):
+        eng = ServingEngine(params, cfg, num_slots=num_slots,
+                            page_size=page_size, num_pages=num_pages,
+                            pages_per_seq=pages_per_seq,
+                            prefill_chunk=page_size, slo=policy)
+        eng.run(max_steps=100_000, arrivals=arrivals)
+        chat = {tuple(r.prompt): list(r.generated)
+                for r in eng._finished if r.cls == "chat"}
+        return eng, chat
+
+    chat_only = [a for a in trace if a[4] == "chat"]
+    golden_eng, golden = _run(chat_only, slo)
+    eng, flooded_chat = _run(trace, slo)
+    assert flooded_chat == golden, (
+        "batch burst changed admitted chat tokens — WFQ isolation broke")
+    assert eng.compile_stats == golden_eng.compile_stats, (
+        f"policy compiled extra programs: {eng.compile_stats} vs "
+        f"{golden_eng.compile_stats}")
+    shed = eng._rejected
+    assert all(r.cls == "batch" for r in shed), "chat was shed under flood"
+
+    us = lambda v: None if v is None else round(v * 1e6, 1)  # noqa: E731
+    out = {}
+    for cls, row in sorted(eng.metrics.per_class().items()):
+        out[f"serving_ttft_p50_us{{class={cls}}}"] = us(row["ttft_p50_s"])
+        out[f"serving_ttft_p99_us{{class={cls}}}"] = us(row["ttft_p99_s"])
+        out[f"serving_itl_p50_us{{class={cls}}}"] = us(row["itl_p50_s"])
+        out[f"serving_itl_p99_us{{class={cls}}}"] = us(row["itl_p99_s"])
+        out[f"serving_slo_shed{{class={cls}}}"] = (
+            row["rejections"] + row["expirations"])
+    out.update({
+        "serving_slo_chat_bit_identical": len(flooded_chat),
+        "serving_slo_quota_throttled":
+            eng.metrics.counters["quota_throttled"],
+        "serving_slo_chunk_shrinks":
+            eng.metrics.counters["chunk_shrinks"],
+        "serving_slo_knobs": {
+            "n": n, "num_slots": num_slots, "page_size": page_size,
+            "num_pages": num_pages, "n_layers": n_layers,
+            "workload": "bursty chat/batch, seed 11",
+            "policy": "chat:4 batch:1, batch cap 8 ttl 60, "
+                      "chat stall 4, quota b0=1/4"},
+    })
+    return out
+
+
 # --- EP-dispatch wire model (the DeepEP-comparison analog) -----------------
 #
 # The reference's headline 137 µs dispatch (README.md:55) is 32 H800 ranks,
@@ -1997,6 +2079,15 @@ def main(a2a_primary: bool = False):
         extras.update(bench_prefix_cache(ctx, **psh))
 
     attempt("prefix_cache", _prefix_cache)
+
+    def _slo():
+        # multi-tenant WFQ isolation under the bursty two-class workload:
+        # per-class TTFT/ITL rows, typed batch shedding, chat tokens
+        # asserted bit-identical to the uncontended golden (ISSUE 14)
+        ssh = dict(n_layers=1) if on_cpu() else {}
+        extras.update(bench_slo(ctx, **ssh))
+
+    attempt("slo", _slo)
 
     def _attn():
         ash = dict(s_loc=256, Hq=4, Hkv=2) if on_cpu() else {}

@@ -82,9 +82,37 @@ p.add_argument("--prefix-cache", action="store_true",
                     "sends shared-template prompts to one replica, so "
                     "its cache sees them all; prints an aggregate "
                     "hit-rate + cached/cold TTFT line to stderr")
+p.add_argument("--workload", default=None, metavar="SPEC",
+               help="bursty two-class trace (ISSUE 14) replacing the "
+                    "template workload: key=value pairs (see serve_sim "
+                    "--workload) — every request stamped (tenant, class); "
+                    "overrides --requests/--templates/--zipf/--max-new. "
+                    "Bad fields fail loudly BY NAME")
+p.add_argument("--slo", default=None, metavar="SPEC",
+               help="per-replica multi-tenant SLO policy (ISSUE 14): "
+                    "chat/batch WFQ weights + per-class overrides + "
+                    "token-bucket quotas (see serve_sim --slo)")
 args = p.parse_args()
 if args.prefix_cache and args.engine != "colocated":
     p.error("--prefix-cache needs --engine colocated")
+
+# multi-tenant SLO scheduling (ISSUE 14): both specs fail loudly NAMING
+# the bad field instead of silently replaying a default-shaped trace
+slo_policy = None
+workload_spec = None
+if args.slo is not None:
+    from triton_dist_tpu.serving.workload import parse_slo  # noqa: E402
+    try:
+        slo_policy = parse_slo(args.slo)
+    except ValueError as e:
+        p.error(str(e))
+if args.workload is not None:
+    from triton_dist_tpu.serving.workload import parse_workload  # noqa: E402
+    try:
+        workload_spec = parse_workload(args.workload)
+    except ValueError as e:
+        p.error(str(e))
+    args.requests = workload_spec.n
 
 kill_at = args.kill_at if args.kill_at is not None else args.requests // 2
 restore_after = (args.restore_after if args.restore_after is not None
@@ -102,7 +130,8 @@ if args.engine == "sim":
         return SimEngine(num_slots=args.slots, page_size=args.page_size,
                          num_pages=args.pages,
                          pages_per_seq=args.pages_per_seq,
-                         journal=journal, checkpoint_every=ckpt_every)
+                         journal=journal, checkpoint_every=ckpt_every,
+                         slo=slo_policy)
 
     def golden(prompt, mnt):
         return expected_tokens(prompt, mnt)
@@ -127,7 +156,8 @@ else:
                              pages_per_seq=args.pages_per_seq,
                              prefill_chunk=args.page_size,
                              journal=journal, checkpoint_every=ckpt_every,
-                             prefix_cache=args.prefix_cache)
+                             prefix_cache=args.prefix_cache,
+                             slo=slo_policy)
 
     _ref = ServingEngine(params, cfg, num_slots=args.slots,
                          page_size=args.page_size, num_pages=args.pages,
@@ -159,33 +189,67 @@ cluster = Cluster(factory, replicas=args.replicas, journal_dir=journal_dir)
 reqs: dict[int, tuple[list[int], int]] = {}
 killed_step = restored_step = None
 failover_s = None
+tk = None
 t0 = time.perf_counter()
 submitted = 0
-while submitted < args.requests:
-    burst = min(arrive, args.requests - submitted)
-    for _ in range(burst):
-        t = int(rng.choice(args.templates, p=zipf_p))
-        tail = rng.randint(1, VOCAB,
-                           size=int(rng.randint(1, 5))).tolist()
-        prompt = (templates[t] + tail)[:max_plen]
-        mnt = int(rng.randint(2, args.max_new + 1))
-        gid = cluster.submit(prompt, mnt)
-        reqs[gid] = (prompt, mnt)
-        submitted += 1
-        if not args.no_kill and submitted == kill_at:
-            cluster.kill(args.kill_replica)
-            killed_step = submitted
-            tk = time.perf_counter()
-        if (not args.no_kill and killed_step is not None
-                and restored_step is None
-                and submitted == kill_at + restore_after):
-            stats = cluster.restore(args.kill_replica)
-            restored_step = submitted
-            failover_s = time.perf_counter() - tk
-            print(json.dumps({"restore": stats,
-                              "failover_us": round(failover_s * 1e6, 1)}),
-                  file=sys.stderr)
-    cluster.step()
+
+
+def _maybe_kill_restore() -> None:
+    """The mid-run kill/restore cycle, keyed on the submission count —
+    shared by the template loop and the --workload loop."""
+    global killed_step, restored_step, failover_s, tk
+    if not args.no_kill and submitted == kill_at:
+        cluster.kill(args.kill_replica)
+        killed_step = submitted
+        tk = time.perf_counter()
+    if (not args.no_kill and killed_step is not None
+            and restored_step is None
+            and submitted == kill_at + restore_after):
+        stats = cluster.restore(args.kill_replica)
+        restored_step = submitted
+        failover_s = time.perf_counter() - tk
+        print(json.dumps({"restore": stats,
+                          "failover_us": round(failover_s * 1e6, 1)}),
+              file=sys.stderr)
+
+
+if workload_spec is not None:
+    # bursty two-class arrivals (ISSUE 14): the generator's step stamps
+    # drive submission cadence; every request lands routed AND stamped
+    from collections import deque  # noqa: E402
+
+    from triton_dist_tpu.serving.workload import generate_arrivals  # noqa: E402
+    cap = args.pages_per_seq * args.page_size
+    if workload_spec.plen[1] + workload_spec.mnt[1] - 1 > cap:
+        p.error(f"workload spec field 'plen': plen+mnt-1 = "
+                f"{workload_spec.plen[1] + workload_spec.mnt[1] - 1} "
+                f"exceeds pages_per_seq*page_size = {cap}")
+    pending = deque(generate_arrivals(workload_spec, vocab=VOCAB,
+                                      page_size=args.page_size))
+    i = 0
+    while pending:
+        while pending and pending[0][0] <= i:
+            _, prompt, mnt, tenant, cls = pending.popleft()
+            gid = cluster.submit(prompt, mnt, tenant=tenant, cls=cls)
+            reqs[gid] = (prompt, mnt)
+            submitted += 1
+            _maybe_kill_restore()
+        cluster.step()
+        i += 1
+else:
+    while submitted < args.requests:
+        burst = min(arrive, args.requests - submitted)
+        for _ in range(burst):
+            t = int(rng.choice(args.templates, p=zipf_p))
+            tail = rng.randint(1, VOCAB,
+                               size=int(rng.randint(1, 5))).tolist()
+            prompt = (templates[t] + tail)[:max_plen]
+            mnt = int(rng.randint(2, args.max_new + 1))
+            gid = cluster.submit(prompt, mnt)
+            reqs[gid] = (prompt, mnt)
+            submitted += 1
+            _maybe_kill_restore()
+        cluster.step()
 results = cluster.drain()
 wall = time.perf_counter() - t0
 
@@ -230,6 +294,21 @@ if args.prefix_cache:
         "ttft_cached_us_mean": hm(tc),
         "ttft_cold_us_mean": hm(tk),
     }), file=sys.stderr)
+if workload_spec is not None or slo_policy is not None:
+    # per-class fleet aggregate (ISSUE 14): summed over alive replicas
+    agg_cls: dict[str, dict[str, int]] = {}
+    throttled = 0
+    for rep in cluster.replicas:
+        if rep.engine is None:
+            continue
+        throttled += rep.engine.metrics.counters.get("quota_throttled", 0)
+        for c, row in rep.engine.metrics.per_class().items():
+            dst = agg_cls.setdefault(c, {"finished": 0, "rejections": 0,
+                                         "expirations": 0})
+            for k in dst:
+                dst[k] += row[k]
+    print(json.dumps({"per_class": agg_cls,
+                      "quota_throttled": throttled}), file=sys.stderr)
 toks_total = sum(len(t) for t in results.values())
 ttft = cluster.metrics.hist["ttft_s"]
 us = lambda v: None if v is None else round(v * 1e6, 1)  # noqa: E731

@@ -126,6 +126,22 @@ p.add_argument("--prompt-zipf", default=None, metavar="ALPHA:POOL",
                     "with Zipf(ALPHA) popularity and append a short "
                     "random tail — the workload prefix caching exists "
                     "for (e.g. 1.1:8). Deterministic per --seed")
+p.add_argument("--workload", default=None, metavar="SPEC",
+               help="bursty two-class trace (ISSUE 14) replacing the "
+                    "uniform generator: key=value pairs, e.g. 'n=200,"
+                    "seed=7,chat=0.7,rate=0.5,burst_every=64,burst_len="
+                    "16,burst_x=4,zipf=1.2,prefixes=8,tenants=3,plen="
+                    "4:20,mnt=2:10' — Zipf prompt sharing x chat-vs-"
+                    "batch heterogeneity x diurnal bursts, every request "
+                    "stamped (tenant, class). Bad fields fail loudly BY "
+                    "NAME. Overrides --sim/--arrive-every/--prompt-zipf")
+p.add_argument("--slo", default=None, metavar="SPEC",
+               help="multi-tenant SLO policy (ISSUE 14): chat/batch WFQ "
+                    "weights, per-class overrides and token-bucket "
+                    "quotas, e.g. 'chat_weight=4,batch_weight=1,"
+                    "batch_cap=8,batch_ttl=40,chat_stall=4,quota="
+                    "b0:1:4|b1:2:8'. Adds a per-class summary panel "
+                    "(TTFT/ITL p50/p99, shed counts) to stderr")
 args = p.parse_args()
 if args.recover and args.crash_at is None:
     p.error("--recover needs --crash-at")
@@ -168,6 +184,24 @@ else:
     params = init_params(jax.random.PRNGKey(args.seed), cfg)
     vocab = cfg.vocab_size
 
+# multi-tenant SLO policy (ISSUE 14): both specs fail loudly NAMING the
+# bad field (argparse-style) instead of replaying a default-shaped trace
+slo_policy = None
+if args.slo is not None:
+    from triton_dist_tpu.serving import parse_slo  # noqa: E402
+    try:
+        slo_policy = parse_slo(args.slo)
+    except ValueError as e:
+        p.error(str(e))
+workload_spec = None
+if args.workload is not None:
+    from triton_dist_tpu.serving import parse_workload  # noqa: E402
+    try:
+        workload_spec = parse_workload(args.workload)
+    except ValueError as e:
+        p.error(str(e))
+    args.sim = workload_spec.n
+
 # crash-consistency plumbing: journaled runs get a WAL + periodic
 # checkpoints; --crash-at adds an engine-tier fault plan on top of any
 # --chaos signal-plane plan (the two tiers compose, see test_chaos.py)
@@ -200,7 +234,7 @@ def mk_engine(fresh=False):
                   decode_horizon=args.decode_horizon, journal=journal,
                   checkpoint_every=ckpt_every, queue_cap=args.queue_cap,
                   ttl_steps=args.ttl, fault_plan=_fault_plan(),
-                  prefix_cache=args.prefix_cache)
+                  prefix_cache=args.prefix_cache, slo=slo_policy)
     if args.mesh is not None and args.disagg:
         # ISSUE 12: the composed engine — disaggregated prefill feeding a
         # ShardedServingEngine decode fleet on ONE TP/SP/EP mesh (the
@@ -252,7 +286,18 @@ eng = mk_engine()
 rng = np.random.RandomState(args.seed)
 max_plen = min(args.pages_per_seq * args.page_size - args.max_new, 24)
 arrivals = []
-if args.prompt_zipf is not None:
+if workload_spec is not None:
+    # the bursty two-class trace (ISSUE 14): 5-tuple arrivals carrying
+    # (tenant, class) stamps; run() feeds them through submit()
+    from triton_dist_tpu.serving import generate_arrivals  # noqa: E402
+    cap = args.pages_per_seq * args.page_size
+    if workload_spec.plen[1] + workload_spec.mnt[1] - 1 > cap:
+        p.error(f"workload spec field 'plen': plen+mnt-1 = "
+                f"{workload_spec.plen[1] + workload_spec.mnt[1] - 1} "
+                f"exceeds pages_per_seq*page_size = {cap}")
+    arrivals = generate_arrivals(workload_spec, vocab=vocab,
+                                 page_size=args.page_size)
+elif args.prompt_zipf is not None:
     # the shared-prompt workload: page-aligned prefixes drawn from a
     # small pool with Zipf popularity, plus a short random tail — head
     # prefixes repeat often enough that a prefix cache serves most of
@@ -350,6 +395,34 @@ print(json.dumps({"compile_stats": eng.compile_stats}), file=sys.stderr)
 # (per-step decode stall bound, queue-vs-prefill TTFT split)
 snap = eng.metrics.snapshot()
 us = lambda v: None if v is None else round(v * 1e6, 1)
+
+# per-class panel (ISSUE 14): TTFT lives on the intake panel, ITL on the
+# decode panel for the split engines — merge both per_class() views
+# (ints sum, None yields) into one summary line
+per_cls = eng.metrics.per_class()
+_md = getattr(eng, "metrics_decode", None)
+if _md is not None:
+    for _c, _row in _md.per_class().items():
+        _base = per_cls.setdefault(_c, dict.fromkeys(_row))
+        for _k, _v in _row.items():
+            if isinstance(_v, int) and isinstance(_base.get(_k), int):
+                _base[_k] += _v
+            elif _base.get(_k) is None:
+                _base[_k] = _v
+if per_cls:
+    print(json.dumps({
+        "per_class": {
+            c: {"ttft_p50_us": us(r.get("ttft_p50_s")),
+                "ttft_p99_us": us(r.get("ttft_p99_s")),
+                "itl_p50_us": us(r.get("itl_p50_s")),
+                "itl_p99_us": us(r.get("itl_p99_s")),
+                "finished": r.get("finished"),
+                "rejections": r.get("rejections"),
+                "expirations": r.get("expirations")}
+            for c, r in per_cls.items()},
+        "quota_throttled": snap["quota_throttled"],
+        "chunk_shrinks": snap["chunk_shrinks"],
+    }), file=sys.stderr)
 if args.prefix_cache:
     # hit-rate + cached/cold TTFT split (ISSUE 13): the point of the
     # cache is the cached-TTFT column sitting far below the cold one on

@@ -28,6 +28,12 @@ EP/SP overlap ops (see docs/serving.md).
 - checkpoint — periodic control-plane snapshot + journal-suffix replay
                restore (crash recovery with zero new compiles)
 - metrics    — counters + histograms, JSON-lines wire format
+- scheduler (ISSUE 14) — also the multi-tenant SLO policy surface:
+               ClassSpec/SLOPolicy (priority classes, WFQ weights,
+               per-tenant token-bucket quotas, per-class caps/TTLs)
+- workload   — bursty two-class trace generation (ISSUE 14): Zipf prompt
+               sharing × chat-vs-batch × diurnal bursts, plus the
+               --workload / --slo CLI spec parsers
 """
 
 from triton_dist_tpu.serving.checkpoint import (Checkpoint,
@@ -45,7 +51,8 @@ from triton_dist_tpu.serving.disagg import (ChunkSignalLedger,
                                             PageMigrationChannel,
                                             SignalProtocolError)
 from triton_dist_tpu.serving.engine import ServingEngine
-from triton_dist_tpu.serving.journal import EVENT_KINDS, ControlJournal
+from triton_dist_tpu.serving.journal import (EVENT_KINDS, SCHEMA_VERSION,
+                                             ControlJournal)
 from triton_dist_tpu.serving.kv_pool import (KVPagePool, PageLedgerError,
                                              cache_to_pages, page_pool_pspec,
                                              pages_to_cache,
@@ -53,14 +60,17 @@ from triton_dist_tpu.serving.kv_pool import (KVPagePool, PageLedgerError,
 from triton_dist_tpu.serving.metrics import Histogram, ServingMetrics
 from triton_dist_tpu.serving.prefix_cache import (PrefixCache,
                                                   ReplicaPrefixIndex)
-from triton_dist_tpu.serving.scheduler import (AdmissionRejected,
+from triton_dist_tpu.serving.scheduler import (AdmissionRejected, ClassSpec,
                                                ContinuousBatchingScheduler,
                                                Request, RequestState,
-                                               TtlExpired)
+                                               SLOPolicy, TtlExpired)
 from triton_dist_tpu.serving.sharded import (MESH_AXES,
                                              ReplicatedDecisionError,
                                              ShardedServingEngine,
                                              serving_mesh)
+from triton_dist_tpu.serving.workload import (WorkloadSpec,
+                                              generate_arrivals,
+                                              parse_slo, parse_workload)
 
 __all__ = [
     "ServingEngine",
@@ -85,6 +95,7 @@ __all__ = [
     "EngineStallError",
     "ControlJournal",
     "EVENT_KINDS",
+    "SCHEMA_VERSION",
     "Checkpoint",
     "CheckpointIntegrityError",
     "capture",
@@ -92,6 +103,12 @@ __all__ = [
     "latest",
     "AdmissionRejected",
     "TtlExpired",
+    "ClassSpec",
+    "SLOPolicy",
+    "WorkloadSpec",
+    "parse_workload",
+    "generate_arrivals",
+    "parse_slo",
     "KVPagePool",
     "PageLedgerError",
     "PrefixCache",

@@ -72,6 +72,11 @@ def snapshot_request(req: Request) -> dict[str, Any]:
         "submit_step": req.submit_step,
         "retries": req.retries,
         "degradations": req.degradations,
+        # multi-tenant stamps (ISSUE 14): absent in pre-v2 snapshots —
+        # rebuild_request fills the defaults, so old checkpoints restore
+        "tenant": req.tenant,
+        "cls": req.cls,
+        "shed_level": req.shed_level,
     }
 
 
@@ -89,6 +94,9 @@ def rebuild_request(snap: dict[str, Any]) -> Request:
     req.submit_step = snap.get("submit_step", 0)
     req.retries = snap.get("retries", 0)
     req.degradations = snap.get("degradations", 0)
+    req.tenant = snap.get("tenant", "default")
+    req.cls = snap.get("cls", "default")
+    req.shed_level = snap.get("shed_level", 0)
     return req
 
 
@@ -175,7 +183,9 @@ def restore(engine: Any, ckpt: Checkpoint | None,
             last_step = max(last_step, e["step"])
             kind = e["kind"]
             if kind == "submit":
-                engine.submit(tuple(e["prompt"]), e["max_new_tokens"], rid=e["rid"])
+                engine.submit(tuple(e["prompt"]), e["max_new_tokens"],
+                              rid=e["rid"], tenant=e.get("tenant"),
+                              cls=e.get("cls"))
                 # re-stamp the ORIGINAL submit step (reporting only —
                 # replay-time submit() stamped the checkpoint step)
                 sched = getattr(engine, "sched_p", None) or engine.sched

@@ -52,7 +52,7 @@ import numpy as np
 
 from triton_dist_tpu.serving import checkpoint as ckpt_mod
 from triton_dist_tpu.serving.deadline import Deadline
-from triton_dist_tpu.serving.engine import (mark_prefill_start,
+from triton_dist_tpu.serving.engine import (class_label, mark_prefill_start,
                                             record_first_token)
 from triton_dist_tpu.serving.journal import ControlJournal
 from triton_dist_tpu.serving.kv_pool import KVPagePool, _fnv1a
@@ -61,7 +61,7 @@ from triton_dist_tpu.serving.prefix_cache import ReplicaPrefixIndex
 from triton_dist_tpu.serving.scheduler import (AdmissionRejected,
                                                ContinuousBatchingScheduler,
                                                Request, RequestState,
-                                               TtlExpired)
+                                               SLOPolicy, TtlExpired)
 from triton_dist_tpu.shmem import faults
 
 SIM_VOCAB = 32000
@@ -101,7 +101,8 @@ class SimEngine:
                  checkpoint_every: int | None = None,
                  queue_cap: int | None = None,
                  ttl_steps: int | None = None,
-                 fault_plan: "faults.FaultPlan | None" = None):
+                 fault_plan: "faults.FaultPlan | None" = None,
+                 slo: SLOPolicy | None = None):
         assert checkpoint_every is None or journal is not None
         self.page_size = page_size
         self.pages_per_seq = pages_per_seq
@@ -110,8 +111,10 @@ class SimEngine:
         self.vocab = vocab
         self.metrics = metrics or ServingMetrics()
         self.alloc = KVPagePool(num_pages + 1, page_size, reserved=1)
+        self.slo = slo
         self.sched = ContinuousBatchingScheduler(num_slots,
-                                                 queue_cap=queue_cap)
+                                                 queue_cap=queue_cap,
+                                                 policy=slo)
         self.journal = journal
         self.checkpoint_every = checkpoint_every
         self.ttl_steps = ttl_steps
@@ -127,8 +130,15 @@ class SimEngine:
         self._steps = 0
 
     # -- intake (device engines' contract verbatim) ------------------------
-    def submit(self, prompt, max_new_tokens: int, rid: int | None = None
-               ) -> int:
+    def _ttl_for(self, req: Request) -> int | None:
+        """Class TTL override (ISSUE 14) beats the engine-wide knob."""
+        spec = self.sched.class_spec(req)
+        if spec is not None and spec.ttl_steps is not None:
+            return spec.ttl_steps
+        return self.ttl_steps
+
+    def submit(self, prompt, max_new_tokens: int, rid: int | None = None,
+               tenant: str | None = None, cls: str | None = None) -> int:
         prompt = tuple(int(t) for t in np.asarray(prompt).reshape(-1))
         assert prompt and max_new_tokens >= 1
         total = len(prompt) + max_new_tokens - 1
@@ -143,21 +153,29 @@ class SimEngine:
         req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
                       eos_token=self.eos_id, submit_step=self._steps,
                       submit_time=time.perf_counter())
+        self.sched.stamp(req, tenant=tenant, cls=cls)
         self.metrics.inc("requests_submitted")
-        if self.sched.at_capacity and not self._replaying:
+        self.metrics.inc_class("requests_submitted", class_label(req))
+        if self.sched.at_capacity_for(req.cls) and not self._replaying:
+            cap = self.sched.queue_cap if self.sched.at_capacity else \
+                self.sched.policy.spec(req.cls).queue_cap
             req.state = RequestState.REJECTED
             req.failure = AdmissionRejected(
-                f"admission queue full (cap {self.sched.queue_cap}) — "
-                f"request {rid} rejected")
+                f"admission queue full for class {req.cls!r} (cap {cap}) "
+                f"— request {rid} rejected")
             self._rejected.append(req)
             self.metrics.inc("rejections")
-            self._jlog("reject", rid=rid, reason=str(req.failure))
+            self.metrics.inc_class("rejections", class_label(req))
+            self._jlog("reject", rid=rid, reason=str(req.failure),
+                       tenant=req.tenant, cls=req.cls)
             return rid
-        if self.ttl_steps is not None:
-            req.deadline = Deadline(self.ttl_steps, req.submit_step)
+        ttl = self._ttl_for(req)
+        if ttl is not None:
+            req.deadline = Deadline(ttl, req.submit_step)
         self.sched.submit(req)
         self._jlog("submit", rid=rid, prompt=list(prompt),
-                   max_new_tokens=max_new_tokens)
+                   max_new_tokens=max_new_tokens,
+                   tenant=req.tenant, cls=req.cls)
         return rid
 
     # -- one step ----------------------------------------------------------
@@ -166,9 +184,10 @@ class SimEngine:
         return self.sched.idle
 
     def step(self) -> bool:
-        if self.ttl_steps is not None:
-            self._expire_queued()
+        self.sched.tick(self._steps)
+        self._expire_queued()
         progressed = self._step_impl()
+        self.metrics.counters["quota_throttled"] = self.sched.quota_throttled
         if progressed:
             self._maybe_checkpoint()
         return progressed
@@ -242,6 +261,7 @@ class SimEngine:
         req.finish_step = self._steps
         self._finished.append(req)
         self.metrics.inc("requests_finished")
+        self.metrics.inc_class("requests_finished", class_label(req))
         self._jlog("finish", rid=req.rid, tokens=list(req.generated),
                    submit_step=req.submit_step,
                    first_token_step=req.first_token_step,
@@ -258,13 +278,16 @@ class SimEngine:
 
     def _expire_queued(self) -> None:
         for req in self.sched.expire(self._steps):
+            ttl = self._ttl_for(req)
             req.failure = TtlExpired(
-                f"request {req.rid} queued past its TTL "
-                f"({self.ttl_steps} steps from step {req.submit_step}) "
+                f"request {req.rid} (class {req.cls!r}) queued past its "
+                f"TTL ({ttl} steps from step {req.submit_step}) "
                 "without admission")
             self._rejected.append(req)
             self.metrics.inc("expirations")
-            self._jlog("expire", rid=req.rid, reason=str(req.failure))
+            self.metrics.inc_class("expirations", class_label(req))
+            self._jlog("expire", rid=req.rid, reason=str(req.failure),
+                       tenant=req.tenant, cls=req.cls)
 
     def run(self, max_steps: int | None = None, arrivals=None,
             recover=None) -> dict[int, list[int]]:
@@ -277,8 +300,10 @@ class SimEngine:
         i = 0
         while max_steps is None or i < max_steps:
             while pending and pending[0][0] <= i:
-                _, prompt, mnt = pending.popleft()
-                self.submit(prompt, mnt)
+                item = pending.popleft()
+                self.submit(item[1], item[2],
+                            tenant=item[3] if len(item) > 3 else None,
+                            cls=item[4] if len(item) > 4 else None)
             if not self.step() and not pending:
                 break
             i += 1
@@ -349,7 +374,9 @@ class SimEngine:
                          for r in self._finished],
             "rejected": [{"rid": r.rid, "kind": "expire"
                           if isinstance(r.failure, TtlExpired) else "reject",
-                          "reason": str(r.failure)} for r in self._rejected],
+                          "reason": str(r.failure), "tenant": r.tenant,
+                          "cls": r.cls} for r in self._rejected],
+            "policy": self.sched.policy_state(),
             "counters": dict(self.metrics.counters),
         }
 
@@ -357,7 +384,8 @@ class SimEngine:
         self.alloc = KVPagePool(self.alloc.num_pages, self.page_size,
                                 reserved=1)
         self.sched = ContinuousBatchingScheduler(
-            self.sched.num_slots, queue_cap=self.sched.queue_cap)
+            self.sched.num_slots, queue_cap=self.sched.queue_cap,
+            policy=self.sched.policy)
         self._finished = []
         self._failed = []
         self._rejected = []
@@ -371,9 +399,14 @@ class SimEngine:
         for snap in state["live"]:
             req = ckpt_mod.rebuild_request(snap)
             req.submit_time = time.perf_counter()
-            if self.ttl_steps is not None:
-                req.deadline = Deadline(self.ttl_steps, req.submit_step)
+            ttl = self._ttl_for(req)
+            if ttl is not None:
+                req.deadline = Deadline(ttl, req.submit_step)
             self.sched.submit(req)
+        # WFQ/bucket books restore AFTER the requeues: submit()'s idle-
+        # class vfloor snap ran against zeroed counters above, and the
+        # checkpoint values now overwrite them (order-dependent)
+        self.sched.restore_policy_state(state.get("policy"))
         for f in state["finished"]:
             self._restore_finished(f["rid"], f["tokens"], meta=f)
         for f in state["rejected"]:
@@ -468,9 +501,11 @@ class EngineReplica:
         v = getattr(e, "idle", None)
         return bool(v) if v is not None else e.sched.idle
 
-    def submit(self, prompt, max_new_tokens: int) -> int:
+    def submit(self, prompt, max_new_tokens: int,
+               tenant: str | None = None, cls: str | None = None) -> int:
         assert self.alive, f"replica {self.index} is dead"
-        return self.engine.submit(prompt, max_new_tokens)
+        return self.engine.submit(prompt, max_new_tokens,
+                                  tenant=tenant, cls=cls)
 
     def step(self) -> bool:
         assert self.alive, f"replica {self.index} is dead"
@@ -557,12 +592,13 @@ class Cluster:
             pick = min(alive, key=lambda r: (r.load, r.index))
         return pick
 
-    def submit(self, prompt, max_new_tokens: int) -> int:
+    def submit(self, prompt, max_new_tokens: int,
+               tenant: str | None = None, cls: str | None = None) -> int:
         rep = self.route(prompt)
         # first-writer-wins: runs this prompt ADDS stick to the replica
         # that actually received it, existing runs keep their owner
         self.prefix_index.insert(tuple(int(t) for t in prompt), rep.index)
-        rid = rep.submit(prompt, max_new_tokens)
+        rid = rep.submit(prompt, max_new_tokens, tenant=tenant, cls=cls)
         gid = self._next_gid
         self._next_gid += 1
         self._placement[gid] = (rep.index, rid)

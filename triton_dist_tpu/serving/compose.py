@@ -70,7 +70,7 @@ from triton_dist_tpu.serving.disagg import (DECODE_ROLE, ChunkSignalLedger,
                                             MigrationSignalTimeout,
                                             PageMigrationChannel,
                                             SignalProtocolError)
-from triton_dist_tpu.serving.engine import (mark_prefill_start,
+from triton_dist_tpu.serving.engine import (class_label, mark_prefill_start,
                                             record_first_token)
 from triton_dist_tpu.serving.journal import ControlJournal
 from triton_dist_tpu.serving.kv_pool import (KVPagePool, _fnv1a,
@@ -80,7 +80,7 @@ from triton_dist_tpu.serving.prefix_cache import PrefixCache
 from triton_dist_tpu.serving.scheduler import (AdmissionRejected,
                                                ContinuousBatchingScheduler,
                                                Request, RequestState,
-                                               TtlExpired)
+                                               SLOPolicy, TtlExpired)
 from triton_dist_tpu.serving.sharded import ShardedServingEngine
 from triton_dist_tpu.shmem import faults
 from triton_dist_tpu.shmem.context import ShmemContext
@@ -122,7 +122,8 @@ class DisaggShardedEngine:
                  queue_cap: int | None = None,
                  ttl_steps: int | None = None,
                  fault_plan: "faults.FaultPlan | None" = None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 slo: SLOPolicy | None = None):
         assert prefill_chunk is not None, (
             "the composed engine requires prefill_chunk: chunks are the "
             "migration unit AND the sharded engine's only prefill path")
@@ -175,8 +176,13 @@ class DisaggShardedEngine:
         self.pool_p = shard_pool_arrays(
             init_page_pool(cfg.base, num_pages + 1, page_size), n_sp,
             self.decode._pool_out_sharding)
+        # SLO policy (ISSUE 14) on the composed intake only — the decode
+        # fleet's scheduler stays policy-free (class-aware victim ordering
+        # reads the shed_level stamp each request carries)
+        self.slo = slo
         self.sched_p = ContinuousBatchingScheduler(num_prefill_slots,
-                                                   queue_cap=queue_cap)
+                                                   queue_cap=queue_cap,
+                                                   policy=slo)
         # prefix cache (ISSUE 13), disagg-shaped: one index per fleet.
         # The PREFILL-fleet cache adopts solely-owned pages and skips the
         # chunk compute inside the hit (every page still migrates); the
@@ -271,8 +277,15 @@ class DisaggShardedEngine:
         return self.decode.sched
 
     # -- request intake ----------------------------------------------------
-    def submit(self, prompt, max_new_tokens: int, rid: int | None = None
-               ) -> int:
+    def _ttl_for(self, req: Request) -> int | None:
+        """Class TTL override (ISSUE 14) beats the engine-wide knob."""
+        spec = self.sched_p.class_spec(req)
+        if spec is not None and spec.ttl_steps is not None:
+            return spec.ttl_steps
+        return self.ttl_steps
+
+    def submit(self, prompt, max_new_tokens: int, rid: int | None = None,
+               tenant: str | None = None, cls: str | None = None) -> int:
         prompt = tuple(int(t) for t in np.asarray(prompt).reshape(-1))
         assert prompt and max_new_tokens >= 1
         total = len(prompt) + max_new_tokens - 1
@@ -288,21 +301,29 @@ class DisaggShardedEngine:
         req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
                       eos_token=self.eos_id, submit_step=self._steps,
                       submit_time=time.perf_counter())
+        self.sched_p.stamp(req, tenant=tenant, cls=cls)
         self.metrics.inc("requests_submitted")
-        if self.sched_p.at_capacity and not self._replaying:
+        self.metrics.inc_class("requests_submitted", class_label(req))
+        if self.sched_p.at_capacity_for(req.cls) and not self._replaying:
+            cap = self.sched_p.queue_cap if self.sched_p.at_capacity else \
+                self.sched_p.policy.spec(req.cls).queue_cap
             req.state = RequestState.REJECTED
             req.failure = AdmissionRejected(
-                f"admission queue full (cap {self.sched_p.queue_cap}) — "
-                f"request {rid} rejected")
+                f"admission queue full for class {req.cls!r} (cap {cap}) "
+                f"— request {rid} rejected")
             self._rejected.append(req)
             self.metrics.inc("rejections")
-            self._jlog("reject", rid=rid, reason=str(req.failure))
+            self.metrics.inc_class("rejections", class_label(req))
+            self._jlog("reject", rid=rid, reason=str(req.failure),
+                       tenant=req.tenant, cls=req.cls)
             return rid
-        if self.ttl_steps is not None:
-            req.deadline = Deadline(self.ttl_steps, req.submit_step)
+        ttl = self._ttl_for(req)
+        if ttl is not None:
+            req.deadline = Deadline(ttl, req.submit_step)
         self.sched_p.submit(req)
         self._jlog("submit", rid=rid, prompt=list(prompt),
-                   max_new_tokens=max_new_tokens)
+                   max_new_tokens=max_new_tokens,
+                   tenant=req.tenant, cls=req.cls)
         return rid
 
     # -- prefill fleet -----------------------------------------------------
@@ -744,22 +765,27 @@ class DisaggShardedEngine:
                 and self.sched_d.idle)
 
     def step(self) -> bool:
-        if self.ttl_steps is not None:
-            self._expire_queued()
+        self.sched_p.tick(self._steps)
+        self._expire_queued()
         progressed = self._step_impl()
+        self.metrics.counters["quota_throttled"] = \
+            self.sched_p.quota_throttled
         if progressed:
             self._maybe_checkpoint()
         return progressed
 
     def _expire_queued(self) -> None:
         for req in self.sched_p.expire(self._steps):
+            ttl = self._ttl_for(req)
             req.failure = TtlExpired(
-                f"request {req.rid} queued past its TTL "
-                f"({self.ttl_steps} steps from step {req.submit_step}) "
+                f"request {req.rid} (class {req.cls!r}) queued past its "
+                f"TTL ({ttl} steps from step {req.submit_step}) "
                 "without admission")
             self._rejected.append(req)
             self.metrics.inc("expirations")
-            self._jlog("expire", rid=req.rid, reason=str(req.failure))
+            self.metrics.inc_class("expirations", class_label(req))
+            self._jlog("expire", rid=req.rid, reason=str(req.failure),
+                       tenant=req.tenant, cls=req.cls)
 
     def _step_impl(self) -> bool:
         """One composed step: prefill fleet (admissions + ≤1 chunk +
@@ -801,8 +827,10 @@ class DisaggShardedEngine:
         marker, since = self._progress_marker(), 0
         while max_steps is None or i < max_steps:
             while pending and pending[0][0] <= i:
-                _, prompt, mnt = pending.popleft()
-                self.submit(prompt, mnt)
+                item = pending.popleft()
+                self.submit(item[1], item[2],
+                            tenant=item[3] if len(item) > 3 else None,
+                            cls=item[4] if len(item) > 4 else None)
             if not self.step() and not pending:
                 break
             i += 1
@@ -938,7 +966,9 @@ class DisaggShardedEngine:
                        for r in self._failed],
             "rejected": [{"rid": r.rid, "kind": "expire"
                           if isinstance(r.failure, TtlExpired) else "reject",
-                          "reason": str(r.failure)} for r in self._rejected],
+                          "reason": str(r.failure), "tenant": r.tenant,
+                          "cls": r.cls} for r in self._rejected],
+            "policy": self.sched_p.policy_state(),
             "counters": dict(self.metrics.counters),
             "counters_decode": dict(self.metrics_decode.counters),
         }
@@ -953,7 +983,8 @@ class DisaggShardedEngine:
         self.alloc_p = KVPagePool(self.alloc_p.num_pages, self.page_size,
                                   reserved=1, sp_ranks=n_sp)
         self.sched_p = ContinuousBatchingScheduler(
-            self.sched_p.num_slots, queue_cap=self.sched_p.queue_cap)
+            self.sched_p.num_slots, queue_cap=self.sched_p.queue_cap,
+            policy=self.sched_p.policy)
         if self.prefix_cache is not None:
             # empty cache on the fresh ledger: cached KV is device state,
             # re-earned by re-prefill (the decode fleet's cache resets
@@ -991,9 +1022,14 @@ class DisaggShardedEngine:
         for snap in state["live"]:
             req = ckpt_mod.rebuild_request(snap)
             req.submit_time = time.perf_counter()
-            if self.ttl_steps is not None:
-                req.deadline = Deadline(self.ttl_steps, req.submit_step)
+            ttl = self._ttl_for(req)
+            if ttl is not None:
+                req.deadline = Deadline(ttl, req.submit_step)
             self.sched_p.submit(req)
+        # WFQ/bucket books restore AFTER the requeues: submit()'s idle-
+        # class vfloor snap ran against zeroed counters above, and the
+        # checkpoint values now overwrite them (order-dependent)
+        self.sched_p.restore_policy_state(state.get("policy"))
         for f in state["finished"]:
             self._restore_finished(f["rid"], f["tokens"], meta=f)
         for f in state["failed"]:

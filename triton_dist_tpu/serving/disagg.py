@@ -70,7 +70,7 @@ from triton_dist_tpu.ops.page_migrate import migrate_pages
 from triton_dist_tpu.serving import checkpoint as ckpt_mod
 from triton_dist_tpu.serving.deadline import (Backoff, Deadline,
                                               EngineStallError)
-from triton_dist_tpu.serving.engine import (mark_prefill_start,
+from triton_dist_tpu.serving.engine import (class_label, mark_prefill_start,
                                             record_first_token)
 from triton_dist_tpu.serving.journal import ControlJournal
 from triton_dist_tpu.serving.kv_pool import (KVPagePool, PageLedgerError,
@@ -80,7 +80,7 @@ from triton_dist_tpu.serving.prefix_cache import PrefixCache
 from triton_dist_tpu.serving.scheduler import (AdmissionRejected,
                                                ContinuousBatchingScheduler,
                                                Request, RequestState,
-                                               TtlExpired)
+                                               SLOPolicy, TtlExpired)
 from triton_dist_tpu.shmem import faults
 from triton_dist_tpu.shmem.context import (ShmemContext,
                                            initialize_distributed)
@@ -428,7 +428,8 @@ class DisaggServingEngine:
                  checkpoint_every: int | None = None,
                  queue_cap: int | None = None,
                  ttl_steps: int | None = None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 slo: SLOPolicy | None = None):
         assert prefill_chunk >= 1 and decode_horizon >= 1
         assert signal_deadline_steps >= 1 and max_retries >= 0
         assert checkpoint_every is None or checkpoint_every >= 1
@@ -487,8 +488,14 @@ class DisaggServingEngine:
         # the bounded admission queue (ISSUE 9) guards the PREFILL worker's
         # intake — that is where fresh arrivals wait; preemption requeues
         # (front=True) are exempt by scheduler construction
+        # SLO policy (ISSUE 14) attaches to the PREFILL scheduler — that is
+        # the only admission point; the decode scheduler stays policy-free
+        # and its class-aware victim ordering reads the shed_level stamp
+        # each request carries
+        self.slo = slo
         self.sched_p = ContinuousBatchingScheduler(num_prefill_slots,
-                                                   queue_cap=queue_cap)
+                                                   queue_cap=queue_cap,
+                                                   policy=slo)
         self.sched_d = ContinuousBatchingScheduler(num_slots)
         # crash consistency (ISSUE 9): journal + checkpoint cadence + the
         # overload knobs, mirroring ServingEngine's control surface
@@ -609,8 +616,15 @@ class DisaggServingEngine:
             clock=lambda: self._steps)
 
     # -- request intake (prefill worker) ----------------------------------
-    def submit(self, prompt, max_new_tokens: int, rid: int | None = None
-               ) -> int:
+    def _ttl_for(self, req: Request) -> int | None:
+        """Class TTL override (ISSUE 14) beats the engine-wide knob."""
+        spec = self.sched_p.class_spec(req)
+        if spec is not None and spec.ttl_steps is not None:
+            return spec.ttl_steps
+        return self.ttl_steps
+
+    def submit(self, prompt, max_new_tokens: int, rid: int | None = None,
+               tenant: str | None = None, cls: str | None = None) -> int:
         prompt = tuple(int(t) for t in np.asarray(prompt).reshape(-1))
         assert prompt and max_new_tokens >= 1
         total = len(prompt) + max_new_tokens - 1
@@ -626,24 +640,36 @@ class DisaggServingEngine:
         req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
                       eos_token=self.eos_id, submit_step=self._steps,
                       submit_time=time.perf_counter())
+        self.sched_p.stamp(req, tenant=tenant, cls=cls)
         self.metrics.inc("requests_submitted")
+        self.metrics.inc_class("requests_submitted", class_label(req))
         # bounded admission (ISSUE 9): shed fresh arrivals at capacity —
         # journal replay bypasses the cap (the WAL holds the authoritative
-        # accept/reject decisions)
-        if self.sched_p.at_capacity and not self._replaying:
+        # accept/reject decisions). Per-class caps (ISSUE 14) shed batch
+        # while chat still admits.
+        if self.sched_p.at_capacity_for(req.cls) and not self._replaying:
+            spec = self.sched_p.class_spec(req)
+            cap = (spec.queue_cap if spec is not None
+                   and spec.queue_cap is not None
+                   and not self.sched_p.at_capacity
+                   else self.sched_p.queue_cap)
             req.state = RequestState.REJECTED
             req.failure = AdmissionRejected(
-                f"admission queue full (cap {self.sched_p.queue_cap}) — "
+                f"admission queue full for class {req.cls!r} (cap {cap}) — "
                 f"request {rid} rejected")
             self._rejected.append(req)
             self.metrics.inc("rejections")
-            self._jlog("reject", rid=rid, reason=str(req.failure))
+            self.metrics.inc_class("rejections", class_label(req))
+            self._jlog("reject", rid=rid, reason=str(req.failure),
+                       tenant=req.tenant, cls=req.cls)
             return rid
-        if self.ttl_steps is not None:
-            req.deadline = Deadline(self.ttl_steps, req.submit_step)
+        ttl = self._ttl_for(req)
+        if ttl is not None:
+            req.deadline = Deadline(ttl, req.submit_step)
         self.sched_p.submit(req)
         self._jlog("submit", rid=rid, prompt=list(prompt),
-                   max_new_tokens=max_new_tokens)
+                   max_new_tokens=max_new_tokens,
+                   tenant=req.tenant, cls=req.cls)
         return rid
 
     # -- prefill worker ----------------------------------------------------
@@ -1188,6 +1214,7 @@ class DisaggServingEngine:
         self._park(slot)
         self._finished.append(req)
         self.metrics_decode.inc("requests_finished")
+        self.metrics_decode.inc_class("requests_finished", class_label(req))
         # finished tokens ride the journal so post-checkpoint finishes
         # survive a crash without re-running the request; the terminal
         # metadata rides along so the restored record stays faithful
@@ -1241,22 +1268,27 @@ class DisaggServingEngine:
         """One step of BOTH workers. Thin wrapper (ISSUE 9): TTL expiry
         sweep before the iteration, checkpoint cadence after a productive
         one — mirroring ``ServingEngine.step``."""
-        if self.ttl_steps is not None:
-            self._expire_queued()
+        self.sched_p.tick(self._steps)
+        self._expire_queued()
         progressed = self._step_impl()
+        self.metrics.counters["quota_throttled"] = \
+            self.sched_p.quota_throttled
         if progressed:
             self._maybe_checkpoint()
         return progressed
 
     def _expire_queued(self) -> None:
         for req in self.sched_p.expire(self._steps):
+            ttl = self._ttl_for(req)
             req.failure = TtlExpired(
-                f"request {req.rid} queued past its TTL "
-                f"({self.ttl_steps} steps from step {req.submit_step}) "
+                f"request {req.rid} (class {req.cls!r}) queued past its "
+                f"TTL ({ttl} steps from step {req.submit_step}) "
                 "without admission")
             self._rejected.append(req)
             self.metrics.inc("expirations")
-            self._jlog("expire", rid=req.rid, reason=str(req.failure))
+            self.metrics.inc_class("expirations", class_label(req))
+            self._jlog("expire", rid=req.rid, reason=str(req.failure),
+                       tenant=req.tenant, cls=req.cls)
 
     def _step_impl(self) -> bool:
         """One step of BOTH workers (single-driver SPMD: each device
@@ -1352,6 +1384,7 @@ class DisaggServingEngine:
         self.metrics_decode.observe("active_slots", len(active))
 
         n_tokens = 0
+        emitted_by_slot: dict[int, int] = {}
         for slot, req in active:
             emitted = 0
             for i in range(int(limits[slot])):
@@ -1363,6 +1396,7 @@ class DisaggServingEngine:
             self._token[slot] = slab[emitted - 1, slot]
             self._pos[slot] += emitted
             n_tokens += emitted
+            emitted_by_slot[slot] = emitted
             if req.done:
                 self._finish_decode(slot)
 
@@ -1373,6 +1407,11 @@ class DisaggServingEngine:
         per_tok = (dev_dt + host_dt) / max(n_tokens, 1)
         for _ in range(n_tokens):
             self.metrics_decode.observe("tok_latency_s", per_tok)
+        for slot, req in active:
+            label = class_label(req)
+            if label is not None:
+                for _ in range(emitted_by_slot.get(slot, 0)):
+                    self.metrics_decode.observe_class("itl_s", label, per_tok)
         return True
 
     def run(self, max_steps: int | None = None,
@@ -1405,8 +1444,10 @@ class DisaggServingEngine:
         marker, since = self._progress_marker(), 0
         while max_steps is None or i < max_steps:
             while pending and pending[0][0] <= i:
-                _, prompt, mnt = pending.popleft()
-                self.submit(prompt, mnt)
+                item = pending.popleft()
+                self.submit(item[1], item[2],
+                            tenant=item[3] if len(item) > 3 else None,
+                            cls=item[4] if len(item) > 4 else None)
             if not self.step() and not pending:
                 break
             i += 1
@@ -1546,7 +1587,9 @@ class DisaggServingEngine:
                        for r in self._failed],
             "rejected": [{"rid": r.rid, "kind": "expire"
                           if isinstance(r.failure, TtlExpired) else "reject",
-                          "reason": str(r.failure)} for r in self._rejected],
+                          "reason": str(r.failure), "tenant": r.tenant,
+                          "cls": r.cls} for r in self._rejected],
+            "policy": self.sched_p.policy_state(),
             "counters": dict(self.metrics.counters),
             "counters_decode": dict(self.metrics_decode.counters),
         }
@@ -1569,7 +1612,8 @@ class DisaggServingEngine:
             # bytes are re-earned by re-prefill before any read
             self.prefix_cache = PrefixCache(self.alloc_p, self.page_size)
         self.sched_p = ContinuousBatchingScheduler(
-            self.sched_p.num_slots, queue_cap=self.sched_p.queue_cap)
+            self.sched_p.num_slots, queue_cap=self.sched_p.queue_cap,
+            policy=self.sched_p.policy)
         self.sched_d = ContinuousBatchingScheduler(self.num_slots)
         self._handoff.clear()
         self._dslot.clear()
@@ -1607,9 +1651,14 @@ class DisaggServingEngine:
         for snap in state["live"]:
             req = ckpt_mod.rebuild_request(snap)
             req.submit_time = time.perf_counter()
-            if self.ttl_steps is not None:
-                req.deadline = Deadline(self.ttl_steps, req.submit_step)
+            ttl = self._ttl_for(req)
+            if ttl is not None:
+                req.deadline = Deadline(ttl, req.submit_step)
             self.sched_p.submit(req)
+        # WFQ/bucket books restore AFTER the requeues: submit()'s idle-
+        # class vfloor snap ran against zeroed counters above, and the
+        # checkpoint values now overwrite them (order-dependent)
+        self.sched_p.restore_policy_state(state.get("policy"))
         for f in state["finished"]:
             self._restore_finished(f["rid"], f["tokens"], meta=f)
         for f in state["failed"]:

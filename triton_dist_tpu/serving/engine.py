@@ -71,7 +71,7 @@ from triton_dist_tpu.serving.prefix_cache import PrefixCache
 from triton_dist_tpu.serving.scheduler import (AdmissionRejected,
                                                ContinuousBatchingScheduler,
                                                Request, RequestState,
-                                               TtlExpired)
+                                               SLOPolicy, TtlExpired)
 from triton_dist_tpu.shmem import faults as faults_mod
 from triton_dist_tpu.shmem.faults import InjectedCrash
 
@@ -81,6 +81,13 @@ from triton_dist_tpu.shmem.faults import InjectedCrash
 # (serving/disagg.py) splits them across workers. These module-level
 # helpers are the prefill-role half both share, so TTFT semantics cannot
 # drift between the colocated and disaggregated paths.
+
+def class_label(req: Request) -> str | None:
+    """Per-class metric label for a request (ISSUE 14): None for the
+    unclassed default so an engine without a policy emits exactly the
+    pre-ISSUE-14 metric panel — labeled series are pay-for-play."""
+    return req.cls if req.cls != "default" else None
+
 
 def mark_prefill_start(req: Request, metrics: ServingMetrics,
                        step: int) -> None:
@@ -103,6 +110,8 @@ def record_first_token(req: Request, metrics: ServingMetrics,
         metrics.observe("ttft_s", req.first_token_time - req.submit_time)
         metrics.observe("ttft_prefill_s",
                         req.first_token_time - req.prefill_start_time)
+        metrics.observe_class("ttft_s", class_label(req),
+                              req.first_token_time - req.submit_time)
 
 
 class ServingEngine:
@@ -154,7 +163,8 @@ class ServingEngine:
                  queue_cap: int | None = None,
                  ttl_steps: int | None = None,
                  fault_plan=None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 slo: SLOPolicy | None = None):
         assert decode_horizon >= 1
         assert prefill_chunk is None or prefill_chunk >= 1
         assert not prefix_cache or prefill_chunk is not None, (
@@ -194,8 +204,18 @@ class ServingEngine:
         # lint are identical with it on or off.
         self.prefix_cache = PrefixCache(self.alloc, page_size) \
             if prefix_cache else None
+        # multi-tenant SLO policy (ISSUE 14): entirely control-plane —
+        # the policy changes WHICH request a slot admits and how many
+        # prompt tokens a step co-schedules, never what the compiled
+        # programs look like (zero new programs; compile_stats is flat).
+        self.slo = slo
+        # the smallest per-step prefill budget any class declares — the
+        # deadline-aware chunk floor is pure configuration, precomputed
+        self._stall_budgeted = slo is not None and any(
+            c.stall_budget is not None for c in slo.classes)
         self.sched = ContinuousBatchingScheduler(num_slots,
-                                                 queue_cap=queue_cap)
+                                                 queue_cap=queue_cap,
+                                                 policy=slo)
         self._next_rid = 0
         self._steps = 0
         self._finished: list[Request] = []
@@ -320,8 +340,16 @@ class ServingEngine:
         self._bt_dev = jnp.asarray(self._bt)
 
     # -- request intake ---------------------------------------------------
-    def submit(self, prompt, max_new_tokens: int, rid: int | None = None
-               ) -> int:
+    def _ttl_for(self, req: Request) -> int | None:
+        """Effective TTL: the class's override when the policy sets one,
+        else the engine-global ``ttl_steps``."""
+        spec = self.sched.class_spec(req)
+        if spec is not None and spec.ttl_steps is not None:
+            return spec.ttl_steps
+        return self.ttl_steps
+
+    def submit(self, prompt, max_new_tokens: int, rid: int | None = None,
+               tenant: str | None = None, cls: str | None = None) -> int:
         prompt = tuple(int(t) for t in np.asarray(prompt).reshape(-1))
         assert prompt and max_new_tokens >= 1
         total = len(prompt) + max_new_tokens - 1   # KV the request may hold
@@ -339,25 +367,34 @@ class ServingEngine:
                       eos_token=self.eos_id,
                       submit_step=self._steps,
                       submit_time=time.perf_counter())
+        self.sched.stamp(req, tenant, cls)
         self.metrics.inc("requests_submitted")
-        # bounded admission (ISSUE 9): shed fresh arrivals when the queue
-        # is at capacity — a typed terminal, never an exception into the
+        self.metrics.inc_class("requests_submitted", class_label(req))
+        # bounded admission (ISSUE 9/14): shed fresh arrivals when the
+        # queue — global or THIS CLASS's budget — is at capacity. A typed
+        # terminal naming the class, never an exception into the
         # submitter. Journal replay bypasses the cap: the journal already
         # holds the authoritative accept/reject decisions.
-        if self.sched.at_capacity and not self._replaying:
+        if self.sched.at_capacity_for(req.cls) and not self._replaying:
+            cap = self.sched.queue_cap if self.sched.at_capacity else \
+                self.sched.policy.spec(req.cls).queue_cap
             req.state = RequestState.REJECTED
             req.failure = AdmissionRejected(
-                f"admission queue full (cap {self.sched.queue_cap}) — "
-                f"request {rid} rejected")
+                f"admission queue full for class {req.cls!r} (cap {cap}) "
+                f"— request {rid} rejected")
             self._rejected.append(req)
             self.metrics.inc("rejections")
-            self._jlog("reject", rid=rid, reason=str(req.failure))
+            self.metrics.inc_class("rejections", class_label(req))
+            self._jlog("reject", rid=rid, reason=str(req.failure),
+                       tenant=req.tenant, cls=req.cls)
             return rid
-        if self.ttl_steps is not None:
-            req.deadline = Deadline(self.ttl_steps, req.submit_step)
+        ttl = self._ttl_for(req)
+        if ttl is not None:
+            req.deadline = Deadline(ttl, req.submit_step)
         self.sched.submit(req)
         self._jlog("submit", rid=rid, prompt=list(prompt),
-                   max_new_tokens=max_new_tokens)
+                   max_new_tokens=max_new_tokens,
+                   tenant=req.tenant, cls=req.cls)
         return rid
 
     # -- prefill + admission ----------------------------------------------
@@ -524,12 +561,39 @@ class ServingEngine:
         # lands — the chunk program carries its own block-table argument,
         # so the decode batch never sees a half-prefilled row
 
+    def _step_prefill_budget(self) -> int | None:
+        """Deadline-aware chunk sizing (ISSUE 14): the prompt tokens this
+        step may co-schedule with decode, i.e. the tightest
+        ``stall_budget`` over the classes currently DECODING (their ITL
+        is what a long chunk stalls). None = no budget (no policy, no
+        budgeted class decoding). A pure function of scheduler state —
+        deterministic, digest-covered, crash-replayable."""
+        if not self._stall_budgeted:
+            return None
+        budget = None
+        for _, r in self.sched.active:
+            if r.state is not RequestState.ACTIVE:
+                continue
+            spec = self.sched.class_spec(r)
+            if spec is not None and spec.stall_budget is not None:
+                budget = spec.stall_budget if budget is None \
+                    else min(budget, spec.stall_budget)
+        return budget
+
     def _dispatch_prefill_chunk(self) -> int:
         """Run AT MOST ONE prefill chunk: the oldest (lowest admission
         ticket) PREFILLING slot advances its cursor by one chunk. The
         final chunk fuses the first-token argmax on device and flips the
         slot to ACTIVE (mirrors set, ready for this step's decode
         dispatch). Returns prompt tokens processed (0 = no prefill work).
+
+        Deadline-aware sizing (ISSUE 14): when a stall-budgeted class is
+        decoding, the EFFECTIVE chunk shrinks to its budget — same
+        compiled program, fewer real tokens: rows past the reduced
+        ``prompt_len`` scalar park on the scratch page exactly like the
+        final-chunk padding always has, so KV for the processed prefix
+        is bit-identical and ``compile_stats`` stays flat (the scalar is
+        a runtime argument, not a shape).
         """
         slot, req = None, None
         for i, r in enumerate(self.sched.slots):
@@ -539,10 +603,17 @@ class ServingEngine:
         if slot is None:
             return 0
         C = self.prefill_chunk
+        budget = self._step_prefill_budget()
+        c_eff = C if budget is None else max(1, min(C, budget))
+        if c_eff < C:
+            self.metrics.inc("chunk_shrinks")
         sp = len(req.prompt)
         start = req.prefill_cursor
+        # the chunk this step actually advances: c_eff real tokens; the
+        # compiled program masks rows past n_eff onto the scratch page
+        n_eff = min(start + c_eff, sp)
         toks = np.zeros(C, np.int32)
-        part = req.prompt[start:start + C]
+        part = req.prompt[start:n_eff]
         toks[:len(part)] = part
         if self.prefix_cache is not None:
             # COW guard over the chunk's write range: the chunk program
@@ -550,7 +621,7 @@ class ServingEngine:
             # admission-time guard already covered the whole-prompt-hit
             # rewrite, so these are no-ops unless a new sharing path
             # appears — cheap insurance on the invariant.
-            end = min(start + C, sp)
+            end = n_eff
             for i in range(start // self.page_size,
                            (end - 1) // self.page_size + 1):
                 self._cow_writable(req, i)
@@ -560,14 +631,14 @@ class ServingEngine:
         t0 = time.perf_counter()
         tok_dev, self.pool = self._chunk_step(
             self.params, jnp.asarray(toks),
-            jnp.asarray(start, jnp.int32), jnp.asarray(sp, jnp.int32),
+            jnp.asarray(start, jnp.int32), jnp.asarray(n_eff, jnp.int32),
             self.pool, jnp.asarray(row))
         # one int32 scalar download — it fences the chunk for honest
         # stall timing and, on the final chunk, IS the first token (the
         # argmax ran on device; the host never sees logits)
         tok0 = int(tok_dev)
         dt = time.perf_counter() - t0
-        req.prefill_cursor = min(start + C, sp)
+        req.prefill_cursor = n_eff
         self.metrics.inc("prefill_chunks")
         self.metrics.observe("prefill_stall_s", dt)
         self._jlog("chunk", rid=req.rid, cursor=req.prefill_cursor)
@@ -607,6 +678,7 @@ class ServingEngine:
         self._park(slot)
         self._finished.append(req)
         self.metrics.inc("requests_finished")
+        self.metrics.inc_class("requests_finished", class_label(req))
         # the finished tokens ride the journal so a post-checkpoint finish
         # survives a crash without re-running the request; the terminal
         # metadata rides along so the restored record stays faithful
@@ -674,26 +746,32 @@ class ServingEngine:
         ``decode_horizon`` tokens per slot). Returns False when there is
         nothing to do (engine idle).
 
-        Thin wrapper around ``_step_impl``: the TTL expiry sweep runs
-        before the iteration (an expired request must not be admitted),
-        ``_post_step`` after a productive one (checkpoint cadence here;
-        the sharded engine chains its digest cross-check in front)."""
-        if self.ttl_steps is not None:
-            self._expire_queued()
+        Thin wrapper around ``_step_impl``: the quota buckets refill and
+        the TTL expiry sweep runs before the iteration (an expired
+        request must not be admitted), ``_post_step`` after a productive
+        one (checkpoint cadence here; the sharded engine chains its
+        digest cross-check in front)."""
+        self.sched.tick(self._steps)
+        self._expire_queued()
         progressed = self._step_impl()
+        self.metrics.counters["quota_throttled"] = \
+            self.sched.quota_throttled
         if progressed:
             self._post_step()
         return progressed
 
     def _expire_queued(self) -> None:
         for req in self.sched.expire(self._steps):
+            ttl = self._ttl_for(req)
             req.failure = TtlExpired(
-                f"request {req.rid} queued past its TTL "
-                f"({self.ttl_steps} steps from step {req.submit_step}) "
+                f"request {req.rid} (class {req.cls!r}) queued past its "
+                f"TTL ({ttl} steps from step {req.submit_step}) "
                 "without admission")
             self._rejected.append(req)
             self.metrics.inc("expirations")
-            self._jlog("expire", rid=req.rid, reason=str(req.failure))
+            self.metrics.inc_class("expirations", class_label(req))
+            self._jlog("expire", rid=req.rid, reason=str(req.failure),
+                       tenant=req.tenant, cls=req.cls)
 
     def _post_step(self) -> None:
         self._maybe_checkpoint()
@@ -787,7 +865,15 @@ class ServingEngine:
                 # decodable row — count it and keep the loop hot
                 self._steps += 1
                 return True
-            return not self.sched.idle
+            if self.sched.idle:
+                return False
+            # nothing dispatched but work is still queued (quota-throttled
+            # or capacity-blocked): the logical clock MUST advance anyway —
+            # sched.tick(self._steps) refills the token buckets off it, so
+            # a frozen clock would turn a bounded deficit wait into
+            # permanent starvation (and a spurious stall-watchdog trip)
+            self._steps += 1
+            return True
 
         if self._dirty:
             self._sync_mirrors()
@@ -809,6 +895,7 @@ class ServingEngine:
         self.metrics.observe("active_slots", len(active))
 
         n_tokens = 0
+        emitted_by_slot = {}
         for slot, req in active:
             emitted = 0
             for i in range(int(limits[slot])):
@@ -823,6 +910,7 @@ class ServingEngine:
             self._token[slot] = slab[emitted - 1, slot]
             self._pos[slot] += emitted
             n_tokens += emitted
+            emitted_by_slot[slot] = emitted
             if req.done:
                 self._finish(slot)
 
@@ -833,13 +921,22 @@ class ServingEngine:
         per_tok = (dev_dt + host_dt) / max(n_tokens, 1)
         for _ in range(n_tokens):
             self.metrics.observe("tok_latency_s", per_tok)
+        # per-class ITL (ISSUE 14): the same per-token estimate, labeled
+        # by the emitting request's class — the isolation panel's number
+        for slot, req in active:
+            label = class_label(req)
+            if label is not None:
+                for _ in range(emitted_by_slot.get(slot, 0)):
+                    self.metrics.observe_class("itl_s", label, per_tok)
         return True
 
     def run(self, max_steps: int | None = None,
             arrivals=None, recover=None) -> dict[int, list[int]]:
         """Drive ``step()`` until idle (or ``max_steps``). ``arrivals`` is
         an optional iterable of (step_index, prompt, max_new_tokens)
-        sorted by step — the synthetic-trace replay hook serve_sim uses.
+        3-tuples — or 5-tuples with (…, tenant, cls) appended (ISSUE 14,
+        the bursty multi-tenant workloads) — sorted by step: the
+        synthetic-trace replay hook serve_sim uses.
         Returns {rid: generated tokens} for FINISHED requests only — a
         truncated run (``max_steps`` hit) simply omits the unfinished.
 
@@ -866,8 +963,10 @@ class ServingEngine:
         marker, since = self._progress_marker(), 0
         while max_steps is None or i < max_steps:
             while pending and pending[0][0] <= i:
-                _, prompt, mnt = pending.popleft()
-                self.submit(prompt, mnt)
+                item = pending.popleft()
+                self.submit(item[1], item[2],
+                            tenant=item[3] if len(item) > 3 else None,
+                            cls=item[4] if len(item) > 4 else None)
             if not self.step() and not pending:
                 break
             i += 1
@@ -969,7 +1068,14 @@ class ServingEngine:
                          for r in self._finished],
             "rejected": [{"rid": r.rid, "kind": "expire"
                           if isinstance(r.failure, TtlExpired) else "reject",
-                          "reason": str(r.failure)} for r in self._rejected],
+                          "reason": str(r.failure),
+                          "tenant": r.tenant, "cls": r.cls}
+                         for r in self._rejected],
+            # multi-tenant policy books (ISSUE 14): WFQ service counters,
+            # virtual-time floor, token-bucket levels — restored AFTER
+            # the live requests requeue so the exact cross-class order
+            # resumes (None without a policy)
+            "policy": self.sched.policy_state(),
             "counters": dict(self.metrics.counters),
         }
 
@@ -987,7 +1093,8 @@ class ServingEngine:
             # pointed at KV the restored process never computed
             self.prefix_cache = PrefixCache(self.alloc, self.page_size)
         self.sched = ContinuousBatchingScheduler(
-            self.num_slots, queue_cap=self.sched.queue_cap)
+            self.num_slots, queue_cap=self.sched.queue_cap,
+            policy=self.sched.policy)
         self._finished = []
         self._rejected = []
         for slot in range(self.num_slots):
@@ -1010,9 +1117,14 @@ class ServingEngine:
         for snap in state["live"]:
             req = ckpt_mod.rebuild_request(snap)
             req.submit_time = time.perf_counter()
-            if self.ttl_steps is not None:
-                req.deadline = Deadline(self.ttl_steps, req.submit_step)
+            ttl = self._ttl_for(req)
+            if ttl is not None:
+                req.deadline = Deadline(ttl, req.submit_step)
             self.sched.submit(req)
+        # policy books AFTER the requeues: submit()'s idle-class snap ran
+        # against zeroed counters; the checkpoint values overwrite them
+        # so the restored WFQ order is exactly the captured one
+        self.sched.restore_policy_state(state.get("policy"))
         for f in state["finished"]:
             self._restore_finished(f["rid"], f["tokens"], meta=f)
         for f in state["rejected"]:

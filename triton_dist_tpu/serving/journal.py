@@ -34,12 +34,26 @@ Event kinds written by the engines:
 
 Entries are plain JSON-able dicts ``{"seq", "step", "kind", "digest", ...}``
 so a journal can be persisted as JSON-lines and reloaded in a fresh process.
+
+Schema versioning (ISSUE 14): persisted journals open with a header line
+``{"schema": N}``. v2 stamps ``tenant``/``cls`` on submit/reject/expire
+entries; ``load()`` is tolerant — a headerless file is v1 and its entries
+replay with the default tenant/class, so pre-ISSUE-14 journals restore
+bit-identically under the new code (pinned by a checked-in v1 fixture).
 """
 
 from __future__ import annotations
 
 import json
+import os
 from typing import Any, Iterable
+
+SCHEMA_VERSION = 2
+
+# Event kinds whose payloads carry the multi-tenant stamps in v2; the
+# tolerant loader backfills these defaults on older entries.
+_CLASSED_KINDS = ("submit", "reject", "expire")
+_CLASS_DEFAULTS = {"tenant": "default", "cls": "default"}
 
 EVENT_KINDS = (
     "submit",
@@ -74,7 +88,13 @@ class ControlJournal:
     def __init__(self, path: str | None = None):
         self._entries: list[dict[str, Any]] = []
         self.path = path
+        self.schema = SCHEMA_VERSION
         self._fh = open(path, "a", encoding="utf-8") if path else None
+        if self._fh is not None and os.path.getsize(path) == 0:
+            # fresh file: lead with the schema header (reopened files
+            # already carry theirs — never write a second one)
+            self._fh.write(json.dumps({"schema": SCHEMA_VERSION}) + "\n")
+            self._fh.flush()
 
     # ------------------------------------------------------------- append
     def append(self, kind: str, step: int, digest: int, **payload: Any) -> dict[str, Any]:
@@ -147,17 +167,33 @@ class ControlJournal:
     # -------------------------------------------------------- persistence
     def save(self, path: str) -> None:
         with open(path, "w", encoding="utf-8") as fh:
+            fh.write(json.dumps({"schema": self.schema}) + "\n")
             for e in self._entries:
                 fh.write(json.dumps(e) + "\n")
 
     @classmethod
     def load(cls, path: str) -> "ControlJournal":
+        """Tolerant loader: an optional leading ``{"schema": N}`` header
+        sets the version (headerless = v1, the pre-ISSUE-14 format);
+        v1 submit/reject/expire entries are backfilled with the default
+        tenant/class so old journals replay under the v2 engines without
+        changing a single control decision."""
         j = cls()
+        schema = 1
         with open(path, "r", encoding="utf-8") as fh:
             for line in fh:
                 line = line.strip()
-                if line:
-                    j._entries.append(json.loads(line))
+                if not line:
+                    continue
+                e = json.loads(line)
+                if "seq" not in e and "schema" in e:
+                    schema = int(e["schema"])
+                    continue
+                if schema < 2 and e.get("kind") in _CLASSED_KINDS:
+                    for k, v in _CLASS_DEFAULTS.items():
+                        e.setdefault(k, v)
+                j._entries.append(e)
+        j.schema = schema
         return j
 
     def close(self) -> None:

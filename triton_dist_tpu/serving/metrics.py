@@ -146,6 +146,13 @@ class ServingMetrics:
             "prefix_skipped_chunks": 0,
             "router_radix_hits": 0,
             "router_radix_misses": 0,
+            # multi-tenant SLO policy (ISSUE 14): admission-scan skips of
+            # a class head whose tenant token bucket was dry (mirrored
+            # from the scheduler's cumulative count each step), and
+            # prefill chunks shrunk below prefill_chunk because a
+            # stall-budgeted class was decoding (deadline-aware sizing)
+            "quota_throttled": 0,
+            "chunk_shrinks": 0,
         }
         self.hist = {
             "ttft_s": Histogram(),
@@ -208,6 +215,60 @@ class ServingMetrics:
 
     def observe(self, name: str, value: float) -> None:
         self.hist[name].observe(value)
+
+    # -- per-class labels (ISSUE 14) --------------------------------------
+    # Labeled series live in the SAME flat dicts under Prometheus-style
+    # keys (``ttft_s{class=chat}``), created lazily on first touch so an
+    # unpoliced engine emits exactly the pre-ISSUE-14 panel. ``itl_s`` is
+    # the per-class twin of ``tok_latency_s`` (inter-token latency).
+    @staticmethod
+    def class_key(name: str, cls: str) -> str:
+        return f"{name}{{class={cls}}}"
+
+    def inc_class(self, name: str, cls: str | None, by: int = 1) -> None:
+        if cls is None:
+            return
+        key = self.class_key(name, cls)
+        self.counters[key] = self.counters.get(key, 0) + by
+
+    def observe_class(self, name: str, cls: str | None,
+                      value: float) -> None:
+        if cls is None:
+            return
+        key = self.class_key(name, cls)
+        if key not in self.hist:
+            self.hist[key] = Histogram()
+        self.hist[key].observe(value)
+
+    def classes(self) -> list[str]:
+        """Class labels seen so far (sorted — deterministic panels)."""
+        out = set()
+        for d in (self.counters, self.hist):
+            for k in d:
+                if "{class=" in k:
+                    out.add(k.split("{class=", 1)[1].rstrip("}"))
+        return sorted(out)
+
+    def per_class(self) -> dict:
+        """The two-panel serve_sim summary's per-class block: TTFT/ITL
+        p50/p99 plus the shed/throttle counts, one entry per class."""
+        out = {}
+        for cls in self.classes():
+            ttft = self.hist.get(self.class_key("ttft_s", cls))
+            itl = self.hist.get(self.class_key("itl_s", cls))
+            out[cls] = {
+                "ttft_p50_s": ttft.percentile(50) if ttft else None,
+                "ttft_p99_s": ttft.percentile(99) if ttft else None,
+                "itl_p50_s": itl.percentile(50) if itl else None,
+                "itl_p99_s": itl.percentile(99) if itl else None,
+                "finished": self.counters.get(
+                    self.class_key("requests_finished", cls), 0),
+                "rejections": self.counters.get(
+                    self.class_key("rejections", cls), 0),
+                "expirations": self.counters.get(
+                    self.class_key("expirations", cls), 0),
+            }
+        return out
 
     def snapshot(self) -> dict:
         wall = time.perf_counter() - self._t0

@@ -22,6 +22,28 @@ Policies (all deterministic — bit-identical replay is a test invariant):
   clears. A preempted request restarts from its prompt: greedy decode is
   deterministic, so the regenerated tokens are identical to the lost
   ones (tests assert bit-equality against uncontended runs).
+
+Multi-tenant SLO policy (ISSUE 14) — all still host-integer-deterministic:
+
+- **classes** (``ClassSpec``): every request carries a class name; the
+  policy orders classes by WEIGHTED FAIR QUEUEING over integer
+  virtual-service counters (service += prompt + budget tokens at
+  admission; the backlogged class with the smallest service/weight goes
+  first, compared by cross-multiplication so no floats ever enter the
+  control plane). FIFO is preserved WITHIN a class — head-of-line
+  blocking is per class, so a big batch request cannot block chat.
+- **quotas** (``SLOPolicy.quotas``): per-tenant integer token buckets
+  (rate tokens/step, burst cap) refilled by ``tick(now)``. A dry bucket
+  skips that head in the WFQ scan (counted in ``quota_throttled``);
+  admission debits the full request cost — the level may go negative
+  (deficit), which enforces the long-run rate exactly.
+- **degradation order**: ``pick_victim`` evicts the youngest WITHIN the
+  least-important (highest ``level``) class first, so overload pressure
+  lands on batch tiers before chat; with no policy every request is
+  level 0 and the pre-ISSUE-14 youngest-first order is bit-identical.
+
+``SLOPolicy=None`` (the default) keeps every decision bit-for-bit
+identical to strict FIFO — the policy machinery is pay-for-play.
 """
 
 from __future__ import annotations
@@ -29,6 +51,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 from collections import deque
+from typing import Mapping
 
 from triton_dist_tpu.serving.deadline import Deadline
 
@@ -47,6 +70,102 @@ class TtlExpired(AdmissionRejected):
     carried to completion (possibly through preemptions), so 'every
     admitted request finishes bit-identically' stays an invariant under
     overload."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassSpec:
+    """One priority class of the multi-tenant policy (ISSUE 14).
+
+    ``weight`` is the WFQ share (integer ≥ 1 — a weight-3 class gets 3×
+    the admission bandwidth of a weight-1 class under contention).
+    ``level`` is the degradation rank: 0 is the MOST protected tier;
+    preemption and shedding hit the highest level first. ``queue_cap`` /
+    ``ttl_steps`` override the engine-global bounds for this class
+    (None = inherit), so a batch tier can run a tight queue while chat
+    keeps a deep one. ``stall_budget`` caps the prefill tokens
+    co-scheduled per step WHILE a request of this class is decoding —
+    the deadline-aware chunk-sizing control (None = no cap)."""
+    name: str
+    weight: int = 1
+    level: int = 0
+    queue_cap: int | None = None
+    ttl_steps: int | None = None
+    stall_budget: int | None = None
+
+    def __post_init__(self):
+        assert self.name, "class name must be non-empty"
+        assert self.weight >= 1, f"class {self.name}: weight must be >= 1"
+        assert self.level >= 0, f"class {self.name}: level must be >= 0"
+        assert self.queue_cap is None or self.queue_cap >= 1
+        assert self.ttl_steps is None or self.ttl_steps >= 1
+        assert self.stall_budget is None or self.stall_budget >= 1
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOPolicy:
+    """The whole multi-tenant scheduling policy: an ordered tuple of
+    classes (declaration order is the deterministic WFQ tie-break; the
+    FIRST class is the default for unclassed submissions) plus per-tenant
+    token-bucket quotas ``{tenant: (rate_tokens_per_step, burst_cap)}``.
+    Frozen — policy is configuration, all mutable state (service
+    counters, bucket levels) lives in the scheduler where it is folded
+    into the control digest and captured by checkpoints."""
+    classes: tuple[ClassSpec, ...]
+    quotas: Mapping[str, tuple[int, int]] = dataclasses.field(
+        default_factory=dict)
+
+    def __post_init__(self):
+        assert self.classes, "policy needs at least one class"
+        names = [c.name for c in self.classes]
+        assert len(set(names)) == len(names), f"duplicate class in {names}"
+        for tenant, (rate, burst) in dict(self.quotas).items():
+            assert rate >= 1 and burst >= 1, (
+                f"tenant {tenant!r}: quota (rate={rate}, burst={burst}) "
+                "must be positive integers")
+
+    @property
+    def default(self) -> str:
+        return self.classes[0].name
+
+    def spec(self, name: str) -> ClassSpec:
+        for c in self.classes:
+            if c.name == name:
+                return c
+        raise KeyError(f"unknown class {name!r} — policy has "
+                       f"{[c.name for c in self.classes]}")
+
+    def index(self, name: str) -> int:
+        for i, c in enumerate(self.classes):
+            if c.name == name:
+                return i
+        raise KeyError(f"unknown class {name!r}")
+
+    @classmethod
+    def chat_batch(cls, chat_weight: int = 4, batch_weight: int = 1,
+                   batch_queue_cap: int | None = None,
+                   batch_ttl_steps: int | None = None,
+                   chat_stall_budget: int | None = None,
+                   quotas: Mapping[str, tuple[int, int]] | None = None
+                   ) -> "SLOPolicy":
+        """The canonical two-tier policy the sims/tests/bench use: a
+        protected ``chat`` tier (level 0) and a best-effort ``batch``
+        tier (level 1) that absorbs shedding and preemption first."""
+        return cls(classes=(
+            ClassSpec("chat", weight=chat_weight, level=0,
+                      stall_budget=chat_stall_budget),
+            ClassSpec("batch", weight=batch_weight, level=1,
+                      queue_cap=batch_queue_cap,
+                      ttl_steps=batch_ttl_steps),
+        ), quotas=quotas or {})
+
+
+def _str_fnv(s: str) -> int:
+    """32-bit FNV-1a over a string's UTF-8 bytes — folds class/tenant
+    NAMES into the integer-only control digest."""
+    h = 0x811C9DC5
+    for b in s.encode("utf-8"):
+        h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+    return h
 
 
 class RequestState(enum.Enum):
@@ -122,6 +241,20 @@ class Request:
     # TTFT split; re-admissions after preemption keep the original value
     # (the clock, like the hit, belongs to the first admission).
     cache_hit_tokens: int = 0
+    # multi-tenant SLO policy (ISSUE 14): the submitting tenant, the
+    # priority class, and the class's degradation level stamped at
+    # submit (``shed_level`` is denormalized from the ClassSpec so
+    # victim ordering never needs a policy lookup). Defaults make an
+    # unclassed request indistinguishable from pre-ISSUE-14 behavior.
+    tenant: str = "default"
+    cls: str = "default"
+    shed_level: int = 0
+
+    @property
+    def cost(self) -> int:
+        """WFQ service / quota debit unit: the tokens this request may
+        consume end to end (prompt KV + generation budget)."""
+        return len(self.prompt) + self.max_new_tokens
 
     @property
     def kv_len(self) -> int:
@@ -149,16 +282,118 @@ class ContinuousBatchingScheduler:
     then ``pick_victim()`` whenever growth fails, then ``finish()`` as
     slots complete."""
 
-    def __init__(self, num_slots: int, queue_cap: int | None = None):
+    def __init__(self, num_slots: int, queue_cap: int | None = None,
+                 policy: SLOPolicy | None = None):
         assert num_slots >= 1
         self.num_slots = num_slots
         self.queue_cap = queue_cap
+        self.policy = policy
         self.queue: deque[Request] = deque()
         self.slots: list[Request | None] = [None] * num_slots
         self._admit_ticket = 0
+        # WFQ state (ISSUE 14) — integers only, folded into digest():
+        # per-class virtual service, a monotone global virtual-time floor
+        # (num/den rational — newly-backlogged classes snap UP to it so an
+        # idle class cannot bank service and monopolize later), per-tenant
+        # token buckets [level, last_refill_step], and the cumulative
+        # quota-skip count the engine mirrors into metrics.
+        self._service: dict[str, int] = \
+            {c.name: 0 for c in policy.classes} if policy else {}
+        self._vfloor = (0, 1)
+        self._bucket: dict[str, list[int]] = {
+            t: [burst, 0] for t, (_, burst) in
+            (dict(policy.quotas).items() if policy else ())}
+        self.quota_throttled = 0
+
+    # -- multi-tenant policy (ISSUE 14) -----------------------------------
+    def stamp(self, req: Request, tenant: str | None = None,
+              cls: str | None = None) -> Request:
+        """Stamp class/tenant onto a fresh request (engine submit path):
+        validates the class against the policy, fills the default class,
+        and denormalizes the degradation level. No-op classification
+        without a policy (everything stays the level-0 default)."""
+        if tenant is not None:
+            req.tenant = tenant
+        if self.policy is None:
+            if cls is not None:
+                req.cls = cls
+            return req
+        req.cls = cls if cls is not None else self.policy.default
+        if req.cls == "default" and not any(
+                c.name == "default" for c in self.policy.classes):
+            # v1-journal backfill value replayed into a policied engine:
+            # "default" means "the policy's default class"
+            req.cls = self.policy.default
+        req.shed_level = self.policy.spec(req.cls).level
+        return req
+
+    def class_spec(self, req: Request) -> ClassSpec | None:
+        return None if self.policy is None else self.policy.spec(req.cls)
+
+    def tick(self, now: int) -> None:
+        """Refill every tenant's token bucket up to step ``now`` (engine
+        calls once per step, before admission). Integer refill: rate
+        tokens per elapsed step, clamped at burst. Deterministic —
+        iteration order is the policy's quota declaration order."""
+        if self.policy is None:
+            return
+        for tenant, (rate, burst) in dict(self.policy.quotas).items():
+            b = self._bucket[tenant]
+            if now > b[1]:
+                b[0] = min(burst, b[0] + rate * (now - b[1]))
+                b[1] = now
+
+    def _quota_ok(self, req: Request) -> bool:
+        b = self._bucket.get(req.tenant)
+        return b is None or b[0] > 0
+
+    def _wfq_order(self) -> list[str]:
+        """Backlogged classes ordered by virtual time (service/weight,
+        ascending) — compared by cross-multiplication so the control
+        plane stays integer-only; ties break on class declaration order.
+        """
+        heads = []
+        for r in self.queue:
+            if r.cls not in (c for c, _, _ in heads):
+                w = self.policy.spec(r.cls).weight
+                heads.append((r.cls, self._service[r.cls], w))
+        out = []
+        while heads:
+            best = 0
+            for i in range(1, len(heads)):
+                _, s_b, w_b = heads[best]
+                _, s_i, w_i = heads[i]
+                if s_i * w_b < s_b * w_i or (
+                        s_i * w_b == s_b * w_i
+                        and self.policy.index(heads[i][0])
+                        < self.policy.index(heads[best][0])):
+                    best = i
+            out.append(heads.pop(best)[0])
+        return out
+
+    def _class_head(self, cls: str) -> Request:
+        for r in self.queue:
+            if r.cls == cls:
+                return r
+        raise AssertionError(f"no queued request of class {cls!r}")
 
     # -- queue ------------------------------------------------------------
     def submit(self, req: Request, front: bool = False) -> None:
+        if self.policy is not None and req.cls not in self._service:
+            # lenient for restored pre-policy requests: unknown classes
+            # ride the default class's books but keep their stamp
+            self._service.setdefault(req.cls, 0)
+        if (self.policy is not None
+                and not any(q.cls == req.cls for q in self.queue)):
+            # newly-backlogged class: snap its virtual time UP to the
+            # global floor (max — never down) so idle time cannot be
+            # banked as future burst right-of-way
+            num, den = self._vfloor
+            w = (self.policy.spec(req.cls).weight
+                 if any(c.name == req.cls for c in self.policy.classes)
+                 else 1)
+            self._service[req.cls] = max(self._service[req.cls],
+                                         (num * w) // den)
         (self.queue.appendleft if front else self.queue.append)(req)
 
     # -- bounded admission (ISSUE 9) --------------------------------------
@@ -168,6 +403,21 @@ class ContinuousBatchingScheduler:
         (``front=True``) are exempt — an admitted request always keeps its
         place in line, only fresh arrivals are shed."""
         return self.queue_cap is not None and len(self.queue) >= self.queue_cap
+
+    def at_capacity_for(self, cls: str | None) -> bool:
+        """Per-class bounded admission (ISSUE 14): the class's own
+        ``queue_cap`` (when the policy sets one) bounds the count of
+        QUEUED requests of that class, composing with the global cap —
+        so a batch flood fills the batch budget and is shed there while
+        the chat tier keeps admitting."""
+        if self.at_capacity:
+            return True
+        if self.policy is None or cls is None:
+            return False
+        spec = self.policy.spec(cls)
+        if spec.queue_cap is None:
+            return False
+        return sum(1 for r in self.queue if r.cls == cls) >= spec.queue_cap
 
     def expire(self, now: int) -> list[Request]:
         """Sweep never-admitted queued requests whose TTL ``Deadline`` has
@@ -205,7 +455,48 @@ class ContinuousBatchingScheduler:
                 h = _fnv1a(h, r.rid, list(RequestState).index(r.state),
                            r.admitted_seq, r.prefill_cursor,
                            len(r.generated))
+        # multi-tenant policy fold (ISSUE 14): PER-CLASS queue order (the
+        # same rids regrouped by class — a class-reorder changes the
+        # digest even when the flat queue order is a permutation), class/
+        # tenant stamps, WFQ service counters, the virtual-time floor and
+        # every token bucket. Unconditional for the stamps (forked
+        # classification must fork the digest even without a policy).
+        for r in self.queue:
+            h = _fnv1a(h, _str_fnv(r.cls), _str_fnv(r.tenant),
+                       r.shed_level)
+        if self.policy is not None:
+            for cls in sorted(self._service):
+                h = _fnv1a(h, _str_fnv(cls), self._service[cls])
+                h = _fnv1a(h, len([0 for r in self.queue if r.cls == cls]))
+                for r in self.queue:
+                    if r.cls == cls:
+                        h = _fnv1a(h, r.rid)
+            h = _fnv1a(h, *self._vfloor, self.quota_throttled)
+            for tenant in sorted(self._bucket):
+                lvl, last = self._bucket[tenant]
+                h = _fnv1a(h, _str_fnv(tenant), lvl & 0xFFFFFFFF, last)
         return h
+
+    def policy_state(self) -> dict | None:
+        """JSON-able snapshot of the mutable policy books (checkpoint
+        capture half); None without a policy."""
+        if self.policy is None:
+            return None
+        return {"service": dict(self._service),
+                "vfloor": list(self._vfloor),
+                "buckets": {t: list(b) for t, b in self._bucket.items()},
+                "quota_throttled": self.quota_throttled}
+
+    def restore_policy_state(self, state: dict | None) -> None:
+        if state is None or self.policy is None:
+            return
+        self._service.update({k: int(v)
+                              for k, v in state["service"].items()})
+        self._vfloor = tuple(int(v) for v in state["vfloor"])
+        for t, b in state["buckets"].items():
+            if t in self._bucket:
+                self._bucket[t] = [int(b[0]), int(b[1])]
+        self.quota_throttled = int(state["quota_throttled"])
 
     @property
     def queue_depth(self) -> int:
@@ -228,24 +519,63 @@ class ContinuousBatchingScheduler:
     # -- admission --------------------------------------------------------
     def admissible(self, pool_can_hold) -> tuple[int, Request] | None:
         """Next (slot, request) to admit, or None. ``pool_can_hold(req)``
-        is the engine's pages-available check; FIFO order is strict — a
-        head-of-line request that does not fit blocks admission (it will
-        fit once finishes/preemptions release pages)."""
+        is the engine's pages-available check.
+
+        Without a policy: strict FIFO — a head-of-line request that does
+        not fit blocks admission (it will fit once finishes/preemptions
+        release pages).
+
+        With a policy (ISSUE 14): weighted fair queueing over classes.
+        Classes are scanned in ascending virtual-time order and each
+        class's own FIFO head is the candidate; a head blocked by pages
+        or a dry tenant bucket only blocks ITS class — the scan moves on,
+        which is exactly the isolation a flooded batch tier must not
+        break. Quota skips are counted in ``quota_throttled``."""
         slot = self.free_slot()
         if slot is None or not self.queue:
             return None
-        req = self.queue[0]
-        if not pool_can_hold(req):
-            return None
-        return slot, req
+        if self.policy is None:
+            req = self.queue[0]
+            if not pool_can_hold(req):
+                return None
+            return slot, req
+        for cls in self._wfq_order():
+            req = self._class_head(cls)
+            if not self._quota_ok(req):
+                self.quota_throttled += 1
+                continue
+            if not pool_can_hold(req):
+                continue            # per-class head-of-line blocking only
+            return slot, req
+        return None
 
     def activate(self, slot: int, req: Request) -> None:
-        assert self.slots[slot] is None and self.queue[0] is req
-        self.queue.popleft()
+        assert self.slots[slot] is None and req in self.queue
+        if self.queue[0] is req:
+            self.queue.popleft()
+        else:
+            assert self.policy is not None, \
+                "mid-queue admission requires a policy"
+            self.queue.remove(req)
         req.state = RequestState.ACTIVE
         req.admitted_seq = self._admit_ticket
         self._admit_ticket += 1
         self.slots[slot] = req
+        if self.policy is not None:
+            # WFQ service charge + virtual-time floor advance + quota
+            # debit (deficit allowed — enforces the long-run rate)
+            self._service[req.cls] = \
+                self._service.get(req.cls, 0) + req.cost
+            w = (self.policy.spec(req.cls).weight
+                 if any(c.name == req.cls for c in self.policy.classes)
+                 else 1)
+            s = self._service[req.cls]
+            num, den = self._vfloor
+            if s * den > num * w:          # s/w > floor: advance it
+                self._vfloor = (s, w)
+            b = self._bucket.get(req.tenant)
+            if b is not None:
+                b[0] -= req.cost
 
     # -- disaggregated handoff (ISSUE 6) ----------------------------------
     def place(self, slot: int, req: Request) -> None:
@@ -275,16 +605,20 @@ class ContinuousBatchingScheduler:
 
     # -- preemption -------------------------------------------------------
     def pick_victim(self, exclude_slot: int | None = None) -> int | None:
-        """Youngest active slot (highest admission ticket), optionally
-        excluding one slot (a grower never evicts itself while another
-        victim exists — evicting self frees its own pages but forfeits
-        more progress than evicting the youngest)."""
-        best, best_ticket = None, -1
+        """Youngest-within-lowest-class victim (ISSUE 14): among seated
+        requests the one with the HIGHEST (shed_level, admitted_seq) —
+        i.e. the least-protected class first, youngest admission within
+        it. Without a policy every request is level 0 and this is the
+        pre-ISSUE-14 youngest-first order bit-for-bit. ``exclude_slot``
+        protects the grower (evicting self frees its own pages but
+        forfeits more progress than evicting the youngest)."""
+        best, best_key = None, (-1, -1)
         for i, r in enumerate(self.slots):
             if r is None or i == exclude_slot:
                 continue
-            if r.admitted_seq > best_ticket:
-                best, best_ticket = i, r.admitted_seq
+            key = (r.shed_level, r.admitted_seq)
+            if key > best_key:
+                best, best_key = i, key
         return best
 
     def evict(self, slot: int) -> Request:
@@ -314,4 +648,4 @@ class ContinuousBatchingScheduler:
 
 
 __all__ = ["Request", "RequestState", "ContinuousBatchingScheduler",
-           "AdmissionRejected", "TtlExpired"]
+           "AdmissionRejected", "TtlExpired", "ClassSpec", "SLOPolicy"]

@@ -125,7 +125,8 @@ class ShardedServingEngine(ServingEngine):
                  queue_cap: int | None = None,
                  ttl_steps: int | None = None,
                  fault_plan=None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 slo=None):
         for ax in MESH_AXES:
             assert ax in ctx.axis_names, (
                 f"mesh is missing axis {ax!r} — build it with "
@@ -201,7 +202,8 @@ class ShardedServingEngine(ServingEngine):
                          stall_deadline_steps=stall_deadline_steps,
                          journal=journal, checkpoint_every=checkpoint_every,
                          queue_cap=queue_cap, ttl_steps=ttl_steps,
-                         fault_plan=fault_plan, prefix_cache=prefix_cache)
+                         fault_plan=fault_plan, prefix_cache=prefix_cache,
+                         slo=slo)
 
         # shard the pool arrays over SP on the page dim, padding the page
         # count up to a multiple of |sp|. The ALLOCATOR never learns about

@@ -1,0 +1,246 @@
+"""Bursty heterogeneous workload generation (ISSUE 14).
+
+The multi-tenant scheduler is only as honest as the traffic it is tested
+under, so this module supplies the adversarial-but-deterministic trace
+the SLO tests and ``scripts/serve_sim.py --workload`` replay:
+
+- **Zipf prompt sharing** — prompts draw a shared page-aligned prefix
+  from a small pool with Zipf(``zipf``) popularity plus a random tail,
+  the shape prefix caching (ISSUE 13) and cache-aware routing exist for.
+- **chat vs batch classes** — two request populations: short interactive
+  "chat" prompts with small decode budgets and long "batch" prompts with
+  large ones, each stamped with a tenant drawn from its own tenant pool.
+- **diurnal bursts** — the base arrival rate multiplies by ``burst_x``
+  for ``burst_len`` steps out of every ``burst_every`` (a square-wave
+  "diurnal" cycle), so overload arrives in waves rather than uniformly —
+  the regime where per-class shedding and WFQ isolation actually matter.
+
+Everything is a pure function of the spec (``numpy.random.RandomState``
+seeded from ``seed``): the same spec string replays the same 5-tuple
+arrival list ``(step, prompt, max_new_tokens, tenant, cls)`` bitwise,
+which is what lets flood-isolation tests compare admitted traces against
+uncontended goldens.
+
+Spec strings are ``key=value`` pairs joined by commas, e.g.::
+
+    n=200,seed=7,chat=0.7,rate=0.5,burst_every=64,burst_len=16,
+    burst_x=4,zipf=1.2,prefixes=8,tenants=3,plen=4:20,mnt=2:10
+
+``parse_workload`` validates every field and raises ``ValueError``
+NAMING the offending field — a CLI typo fails loudly, not as a silently
+default-shaped trace.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from triton_dist_tpu.serving.scheduler import SLOPolicy
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadSpec:
+    """One bursty two-class trace, fully determined by its fields."""
+
+    n: int = 100                # total requests
+    seed: int = 0
+    chat: float = 0.7           # P(class == "chat"); rest is "batch"
+    rate: float = 0.5           # base arrivals per engine step
+    burst_every: int = 64       # diurnal period (steps)
+    burst_len: int = 16         # burst window within each period (steps)
+    burst_x: float = 4.0        # rate multiplier inside the window
+    zipf: float = 1.2           # shared-prefix popularity exponent (> 1)
+    prefixes: int = 8           # shared-prefix pool size (0 = no sharing)
+    tenants: int = 3            # tenant pool size PER class
+    plen: tuple[int, int] = (4, 20)   # inclusive prompt-length range
+    mnt: tuple[int, int] = (2, 10)    # inclusive decode-budget range
+
+    def validate(self) -> "WorkloadSpec":
+        def bad(field: str, why: str):
+            raise ValueError(
+                f"workload spec field '{field}': {why} "
+                f"(got {getattr(self, field)!r})")
+        if self.n < 1:
+            bad("n", "must be >= 1")
+        if self.seed < 0:
+            bad("seed", "must be >= 0")
+        if not 0.0 <= self.chat <= 1.0:
+            bad("chat", "must be in [0, 1]")
+        if self.rate <= 0:
+            bad("rate", "must be > 0")
+        if self.burst_every < 1:
+            bad("burst_every", "must be >= 1")
+        if not 0 <= self.burst_len <= self.burst_every:
+            bad("burst_len", "must be in [0, burst_every]")
+        if self.burst_x < 1.0:
+            bad("burst_x", "must be >= 1")
+        if self.zipf <= 1.0:
+            bad("zipf", "must be > 1")
+        if self.prefixes < 0:
+            bad("prefixes", "must be >= 0")
+        if self.tenants < 1:
+            bad("tenants", "must be >= 1")
+        if not (1 <= self.plen[0] <= self.plen[1]):
+            bad("plen", "must be LO:HI with 1 <= LO <= HI")
+        if not (1 <= self.mnt[0] <= self.mnt[1]):
+            bad("mnt", "must be LO:HI with 1 <= LO <= HI")
+        return self
+
+
+_INT_FIELDS = ("n", "seed", "burst_every", "burst_len", "prefixes",
+               "tenants")
+_FLOAT_FIELDS = ("chat", "rate", "burst_x", "zipf")
+_RANGE_FIELDS = ("plen", "mnt")
+
+
+def parse_workload(spec: str) -> WorkloadSpec:
+    """Parse ``key=value,...`` into a validated :class:`WorkloadSpec`.
+    Every failure mode names the bad field."""
+    kw: dict = {}
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        if "=" not in part:
+            raise ValueError(
+                f"workload spec field {part!r}: expected key=value")
+        key, val = (s.strip() for s in part.split("=", 1))
+        if key in _INT_FIELDS:
+            try:
+                kw[key] = int(val)
+            except ValueError:
+                raise ValueError(
+                    f"workload spec field '{key}': expected an integer "
+                    f"(got {val!r})") from None
+        elif key in _FLOAT_FIELDS:
+            try:
+                kw[key] = float(val)
+            except ValueError:
+                raise ValueError(
+                    f"workload spec field '{key}': expected a number "
+                    f"(got {val!r})") from None
+        elif key in _RANGE_FIELDS:
+            try:
+                lo, hi = (int(s) for s in val.split(":"))
+            except ValueError:
+                raise ValueError(
+                    f"workload spec field '{key}': expected LO:HI "
+                    f"integers (got {val!r})") from None
+            kw[key] = (lo, hi)
+        else:
+            known = ", ".join(_INT_FIELDS + _FLOAT_FIELDS + _RANGE_FIELDS)
+            raise ValueError(
+                f"workload spec field '{key}': unknown field "
+                f"(known: {known})")
+    return WorkloadSpec(**kw).validate()
+
+
+def _rate_at(spec: WorkloadSpec, step: int) -> float:
+    """The square-wave diurnal rate: ``rate * burst_x`` inside the burst
+    window of each period, ``rate`` outside."""
+    if spec.burst_len and (step % spec.burst_every) < spec.burst_len:
+        return spec.rate * spec.burst_x
+    return spec.rate
+
+
+def generate_arrivals(spec: WorkloadSpec, vocab: int = 32000,
+                      page_size: int = 8
+                      ) -> list[tuple[int, list[int], int, str, str]]:
+    """Materialize the trace: a step-sorted list of 5-tuple arrivals
+    ``(step, prompt, max_new_tokens, tenant, cls)`` — the shape every
+    engine's ``run(arrivals=...)`` now accepts.
+
+    Chat prompts/budgets draw from the lower half of the configured
+    ranges, batch from the upper half — the heterogeneity (short
+    interactive vs long throughput work) the deadline-aware chunk sizing
+    and per-class shedding are tested against.
+    """
+    rng = np.random.RandomState(spec.seed)
+    # shared page-aligned prefixes with Zipf popularity (ISSUE 13 shape)
+    pool = []
+    weights = None
+    if spec.prefixes:
+        pre_len = max(page_size, (spec.plen[0] // page_size) * page_size)
+        pool = [rng.randint(1, vocab, size=pre_len).tolist()
+                for _ in range(spec.prefixes)]
+        weights = np.arange(1, spec.prefixes + 1,
+                            dtype=np.float64) ** -spec.zipf
+        weights /= weights.sum()
+
+    def _half_range(lo: int, hi: int, upper: bool) -> tuple[int, int]:
+        mid = (lo + hi) // 2
+        return (mid, hi) if upper else (lo, mid)
+
+    out = []
+    t = 0.0
+    for _ in range(spec.n):
+        step = int(t)
+        # inter-arrival gap from the CURRENT window's rate; the draw
+        # happens unconditionally so the stream of RNG consumption — and
+        # with it every downstream prompt — is fixed by (seed, n) alone
+        t += float(rng.exponential(1.0 / _rate_at(spec, step)))
+        is_batch = float(rng.uniform()) >= spec.chat
+        cls = "batch" if is_batch else "chat"
+        tenant = f"{cls[0]}{int(rng.randint(spec.tenants))}"
+        plo, phi = _half_range(*spec.plen, upper=is_batch)
+        mlo, mhi = _half_range(*spec.mnt, upper=is_batch)
+        plen = int(rng.randint(plo, phi + 1))
+        mnt = int(rng.randint(mlo, mhi + 1))
+        if pool:
+            k = int(rng.choice(spec.prefixes, p=weights))
+            tail = rng.randint(1, vocab, size=max(plen, 1)).tolist()
+            prompt = (pool[k] + tail)[:max(plen, 1)]
+            if len(prompt) < plen:
+                prompt = prompt + tail[:plen - len(prompt)]
+        else:
+            prompt = rng.randint(1, vocab, size=plen).tolist()
+        out.append((step, prompt, mnt, tenant, cls))
+    return out
+
+
+def parse_slo(spec: str) -> SLOPolicy:
+    """Parse an SLO-policy CLI spec into :meth:`SLOPolicy.chat_batch`.
+
+    ``key=value`` pairs joined by commas; every failure names the field::
+
+        chat_weight=4,batch_weight=1,batch_cap=8,batch_ttl=40,
+        chat_stall=4,quota=b0:1:4|b1:2:8
+
+    ``quota`` is ``tenant:rate:burst`` triples joined by ``|``.
+    """
+    kw: dict = {}
+    quotas: dict[str, tuple[int, int]] = {}
+    int_fields = {"chat_weight": "chat_weight", "batch_weight":
+                  "batch_weight", "batch_cap": "batch_queue_cap",
+                  "batch_ttl": "batch_ttl_steps",
+                  "chat_stall": "chat_stall_budget"}
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        if "=" not in part:
+            raise ValueError(
+                f"slo spec field {part!r}: expected key=value")
+        key, val = (s.strip() for s in part.split("=", 1))
+        if key in int_fields:
+            try:
+                kw[int_fields[key]] = int(val)
+            except ValueError:
+                raise ValueError(
+                    f"slo spec field '{key}': expected an integer "
+                    f"(got {val!r})") from None
+        elif key == "quota":
+            for trip in filter(None, val.split("|")):
+                try:
+                    tenant, rate, burst = trip.split(":")
+                    quotas[tenant] = (int(rate), int(burst))
+                except ValueError:
+                    raise ValueError(
+                        "slo spec field 'quota': expected "
+                        f"tenant:rate:burst triples joined by | "
+                        f"(got {trip!r})") from None
+        else:
+            known = ", ".join(list(int_fields) + ["quota"])
+            raise ValueError(
+                f"slo spec field '{key}': unknown field (known: {known})")
+    return SLOPolicy.chat_batch(quotas=quotas or None, **kw)
+
+
+__all__ = ["WorkloadSpec", "parse_workload", "generate_arrivals",
+           "parse_slo"]
