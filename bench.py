@@ -1869,6 +1869,135 @@ def bench_sigcheck() -> dict:
     }
 
 
+def bench_aot(ctx, n_layers: int = 2, num_requests: int = 12) -> dict:
+    """AOT cold-start rows (ISSUE 15): wall time from a cold process state
+    to the FIRST TOKEN out of a colocated engine, fresh-trace vs seeded
+    from a persisted artifact (``aot_cold_start_to_first_token_us`` both
+    ways + the speedup, asserted >= 10x on CPU where XLA compiles dwarf
+    dispatch), then a preemption trace asserted BIT-IDENTICAL artifact-on
+    vs artifact-off with compile parity (0 fresh traces, every program
+    accounted to the artifact).
+
+    Registry rows: the contextual autotuner's persisted-winner loop on the
+    two CPU-executable r6 levers (``grouped_gemm`` / ``moe_ffn_gated``) —
+    first-process sweep cost vs second-process ``registry_hit`` cost,
+    the registry hit rate, and tuned-vs-default kernel latency at the
+    swept shape.
+    """
+    import tempfile as _tf
+
+    import numpy as _np
+
+    from triton_dist_tpu.aot import (ArtifactSpec, build_artifact,
+                                     load_artifact, make_engine)
+    from triton_dist_tpu.aot.registry import (TunedConfigRegistry,
+                                              set_default_registry)
+    from triton_dist_tpu.utils import on_cpu
+    from triton_dist_tpu.utils.perf import perf_func
+
+    spec = ArtifactSpec(
+        model={"kind": "llama", "vocab_size": 128, "d_model": 64,
+               "n_layers": n_layers, "n_heads": 4, "n_kv_heads": 2,
+               "d_ff": 128, "max_seq_len": 64, "dtype": "float32"},
+        engines=[{"kind": "colocated", "num_slots": 4, "page_size": 8,
+                  "num_pages": 9, "pages_per_seq": 4, "prefill_chunk": 8}])
+    cfg = spec.model_config()
+    params = spec.init_params()
+
+    def first_token_s(artifact=None):
+        t0 = time.perf_counter()
+        eng = make_engine(spec.engines[0], params, cfg, artifact=artifact)
+        eng.submit(list(range(1, 12)), 2)
+        while not eng._finished:
+            eng.step()
+        return time.perf_counter() - t0
+
+    # fresh side FIRST: once the artifact's XLA cache is installed, later
+    # compiles in this process would hit it and the baseline would lie
+    fresh_s = _best_of(lambda: first_token_s(), n=2)
+
+    out = {}
+    with _tf.TemporaryDirectory(prefix="bench-aot-") as tdir:
+        t0 = time.perf_counter()
+        art_dir = build_artifact(spec, f"{tdir}/artifact")
+        out["aot_build_s"] = round(time.perf_counter() - t0, 3)
+
+        art_s = _best_of(
+            lambda: first_token_s(load_artifact(art_dir, spec=spec)), n=2)
+        speedup = fresh_s / art_s
+        out["aot_cold_start_fresh_us"] = round(fresh_s * 1e6, 1)
+        out["aot_cold_start_artifact_us"] = round(art_s * 1e6, 1)
+        out["aot_cold_start_speedup"] = round(speedup, 1)
+        if on_cpu():
+            assert speedup >= 10.0, (
+                f"artifact cold start must be >= 10x a fresh trace on CPU "
+                f"(fresh {fresh_s:.3f}s vs artifact {art_s:.3f}s = "
+                f"{speedup:.1f}x) — is the persisted XLA cache being hit?")
+
+        # bit-identity + compile parity on a preemption trace (9-page pool)
+        rng = _np.random.RandomState(77)
+        trace = [(i // 2, rng.randint(1, 128, size=int(rng.randint(3, 17))
+                                      ).tolist(), int(rng.randint(2, 6)))
+                 for i in range(num_requests)]
+        eng_f = make_engine(spec.engines[0], params, cfg)
+        golden = eng_f.run(max_steps=100_000, arrivals=list(trace))
+        eng_a = make_engine(spec.engines[0], params, cfg,
+                            artifact=load_artifact(art_dir, spec=spec))
+        tokens = eng_a.run(max_steps=100_000, arrivals=list(trace))
+        assert tokens == golden, "artifact-on trace diverged from fresh"
+        stats = eng_a.compile_stats
+        fresh_traces = {k: v for k, v in stats.items()
+                        if k.endswith("_compiles") and v}
+        assert not fresh_traces and stats["aot_programs"] == 2, stats
+
+    # -- persisted-registry loop on the CPU-executable levers ---------------
+    from triton_dist_tpu.ops import autotuned as at
+    key = jax.random.PRNGKey(0)
+    T, H, N, E = 256, 128, 256, 4
+    tokens_a = jax.random.normal(key, (T, H), jnp.float32)
+    ids = jnp.arange(T, dtype=jnp.int32) % E
+    w = jax.random.normal(key, (E, H, N), jnp.float32)
+    wd = jax.random.normal(key, (E, N, H), jnp.float32)
+    calls = {
+        "grouped_gemm": lambda **kw: at.grouped_gemm_autotuned(
+            tokens_a, ids, w, **kw),
+        "moe_ffn_gated": lambda **kw: at.moe_ffn_gated_autotuned(
+            tokens_a, ids, w, w, wd, **kw),
+    }
+
+    def _drop_cached(op):
+        # simulate the next process: the in-memory winner cache is empty,
+        # only the registry survives
+        fn = getattr(at, f"{op}_autotuned")
+        for k in [k for k in fn._autotune_cache
+                  if k[0] == fn.__wrapped__.__qualname__]:
+            del fn._autotune_cache[k]
+
+    reg = TunedConfigRegistry()
+    set_default_registry(reg)
+    try:
+        for op, call in calls.items():
+            _drop_cached(op)
+            _, sweep_ms = perf_func(call, iters=1, warmup_iters=0)
+            _drop_cached(op)
+            _, hit_ms = perf_func(call, iters=1, warmup_iters=0)
+            out[f"aot_{op}_sweep_ms"] = round(sweep_ms, 1)
+            out[f"aot_{op}_registry_hit_ms"] = round(hit_ms, 1)
+            winner = reg.get_similar(op, "float32")
+            _, tuned_ms = perf_func(lambda: call(cfg=winner),
+                                    iters=5, warmup_iters=2)
+            _, default_ms = perf_func(lambda: call(cfg=(128, 128)),
+                                      iters=5, warmup_iters=2)
+            out[f"aot_{op}_tuned_us"] = round(tuned_ms * 1e3, 1)
+            out[f"aot_{op}_default_us"] = round(default_ms * 1e3, 1)
+            out[f"aot_{op}_winner"] = str(winner)
+    finally:
+        set_default_registry(None)
+    out["aot_registry_hit_rate"] = round(reg.hit_rate, 3)
+    out["aot_registry_entries"] = len(reg)
+    return out
+
+
 def sweep():
     """Per-model-family AG-GEMM sweep at the reference's perf shapes; one
     JSON line per shape (informational — the driver parses main()'s single
@@ -2088,6 +2217,14 @@ def main(a2a_primary: bool = False):
         extras.update(bench_slo(ctx, **ssh))
 
     attempt("slo", _slo)
+
+    def _aot():
+        # persisted-artifact cold start vs fresh traces (>=10x on CPU,
+        # bit-identity + compile parity asserted) and the tuned-config
+        # registry's sweep-once/hit-forever loop (ISSUE 15)
+        extras.update(bench_aot(ctx))
+
+    attempt("aot", _aot)
 
     def _attn():
         ash = dict(s_loc=256, Hq=4, Hkv=2) if on_cpu() else {}

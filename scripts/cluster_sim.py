@@ -92,9 +92,18 @@ p.add_argument("--slo", default=None, metavar="SPEC",
                help="per-replica multi-tenant SLO policy (ISSUE 14): "
                     "chat/batch WFQ weights + per-class overrides + "
                     "token-bucket quotas (see serve_sim --slo)")
+p.add_argument("--artifact", default=None, metavar="DIR",
+               help="persisted AOT artifact (ISSUE 15; --engine colocated "
+                    "only — SimEngine has nothing to compile). EVERY "
+                    "replica — cold-built AND kill/restored — seeds its "
+                    "jit caches from the artifact's programs instead of "
+                    "tracing; a stale artifact is a loud typed error. "
+                    "Prints a cold_start summary line to stderr")
 args = p.parse_args()
 if args.prefix_cache and args.engine != "colocated":
     p.error("--prefix-cache needs --engine colocated")
+if args.artifact is not None and args.engine != "colocated":
+    p.error("--artifact needs --engine colocated")
 
 # multi-tenant SLO scheduling (ISSUE 14): both specs fail loudly NAMING
 # the bad field instead of silently replaying a default-shaped trace
@@ -123,6 +132,15 @@ ckpt_every = args.checkpoint_every or None
 from triton_dist_tpu.serving.cluster import (Cluster, SimEngine,  # noqa: E402
                                              expected_tokens)
 
+# AOT artifact (ISSUE 15): loaded ONCE before any replica exists; the
+# wall clock for cold-start-to-first-token starts here so the load (or
+# the fleet-wide fresh traces it replaces) is inside the measurement
+_t_cold0 = time.perf_counter()
+artifact = None
+if args.artifact is not None:
+    from triton_dist_tpu.aot import load_artifact  # noqa: E402
+    artifact = load_artifact(args.artifact)
+
 if args.engine == "sim":
     VOCAB = 32000
 
@@ -149,7 +167,10 @@ else:
     params = init_params(jax.random.PRNGKey(args.seed), cfg)
     VOCAB = cfg.vocab_size
 
-    def factory(journal):
+    def factory(journal, artifact=None):
+        # EngineReplica passes artifact= on the cold build AND on every
+        # restore, so a failed-over replica reaches its first replayed
+        # token with zero fresh traces too
         return ServingEngine(params, cfg, num_slots=args.slots,
                              page_size=args.page_size,
                              num_pages=args.pages,
@@ -157,7 +178,7 @@ else:
                              prefill_chunk=args.page_size,
                              journal=journal, checkpoint_every=ckpt_every,
                              prefix_cache=args.prefix_cache,
-                             slo=slo_policy)
+                             slo=slo_policy, artifact=artifact)
 
     _ref = ServingEngine(params, cfg, num_slots=args.slots,
                          page_size=args.page_size, num_pages=args.pages,
@@ -184,7 +205,11 @@ zipf_p = ranks ** -args.zipf
 zipf_p /= zipf_p.sum()
 
 journal_dir = args.journal_dir or tempfile.mkdtemp(prefix="cluster-sim-")
-cluster = Cluster(factory, replicas=args.replicas, journal_dir=journal_dir)
+# the golden reference engine (_ref) deliberately stays artifact-OFF:
+# bit-identity of every verified trace vs its fresh-traced golden IS the
+# artifact-transparency check at cluster scale
+cluster = Cluster(factory, replicas=args.replicas, journal_dir=journal_dir,
+                  artifact=artifact)
 
 reqs: dict[int, tuple[list[int], int]] = {}
 killed_step = restored_step = None
@@ -192,6 +217,16 @@ failover_s = None
 tk = None
 t0 = time.perf_counter()
 submitted = 0
+_t_first = None  # wall clock when the cluster's first token surfaced
+
+
+def _step() -> None:
+    """cluster.step() + first-token clock (engine._finished is harvested
+    and cleared inside step, so the summary can't read it post-drain)."""
+    global _t_first
+    cluster.step()
+    if _t_first is None and cluster._results:
+        _t_first = time.perf_counter()
 
 
 def _maybe_kill_restore() -> None:
@@ -234,7 +269,7 @@ if workload_spec is not None:
             reqs[gid] = (prompt, mnt)
             submitted += 1
             _maybe_kill_restore()
-        cluster.step()
+        _step()
         i += 1
 else:
     while submitted < args.requests:
@@ -249,8 +284,10 @@ else:
             reqs[gid] = (prompt, mnt)
             submitted += 1
             _maybe_kill_restore()
-        cluster.step()
+        _step()
 results = cluster.drain()
+if _t_first is None and cluster._results:
+    _t_first = time.perf_counter()
 wall = time.perf_counter() - t0
 
 # -- verification: every surviving trace vs its single-replica golden ----
@@ -309,6 +346,26 @@ if workload_spec is not None or slo_policy is not None:
                 dst[k] += row[k]
     print(json.dumps({"per_class": agg_cls,
                       "quota_throttled": throttled}), file=sys.stderr)
+# cold-start summary (ISSUE 15): fleet-wide fresh traces paid before any
+# token, plus wall time from cold start (artifact load / replica builds)
+# to the cluster's first token. Printed for every --engine colocated run
+# so artifact-on vs artifact-off compare 1:1; restored replicas are
+# included — their compiles land in the same aggregate.
+if args.engine == "colocated":
+    _alive = [rep.engine for rep in cluster.replicas
+              if rep.engine is not None]
+    _stats = [e.compile_stats for e in _alive]
+    print(json.dumps({"cold_start": {
+        "artifact": args.artifact,
+        "replicas_alive": len(_alive),
+        "cold_start_compiles": sum(
+            v for s in _stats for k, v in s.items()
+            if k.endswith("_compiles")),
+        "aot_programs": sum(s.get("aot_programs", 0) for s in _stats),
+        "cold_start_to_first_token_s":
+            None if _t_first is None else round(_t_first - _t_cold0, 4),
+    }}), file=sys.stderr)
+
 toks_total = sum(len(t) for t in results.values())
 ttft = cluster.metrics.hist["ttft_s"]
 us = lambda v: None if v is None else round(v * 1e6, 1)  # noqa: E731

@@ -135,6 +135,16 @@ p.add_argument("--workload", default=None, metavar="SPEC",
                     "batch heterogeneity x diurnal bursts, every request "
                     "stamped (tenant, class). Bad fields fail loudly BY "
                     "NAME. Overrides --sim/--arrive-every/--prompt-zipf")
+p.add_argument("--artifact", default=None, metavar="DIR",
+               help="load a persisted AOT serving artifact (built by "
+                    "tools/compile_aot.py) and seed the engine's compiled "
+                    "programs from it — zero fresh jit traces from cold "
+                    "start to first token. A stale or mismatched artifact "
+                    "is a loud typed error, never a silent re-trace. The "
+                    "cold-start summary line on stderr reports "
+                    "cold_start_compiles and cold-start-to-first-token "
+                    "time either way; with --recover the restarted "
+                    "incarnation seeds from the same artifact")
 p.add_argument("--slo", default=None, metavar="SPEC",
                help="multi-tenant SLO policy (ISSUE 14): chat/batch WFQ "
                     "weights, per-class overrides and token-bucket "
@@ -224,6 +234,19 @@ def _fault_plan():
     return plan
 
 
+# AOT artifact (ISSUE 15): load BEFORE any engine is built so the
+# engine's jit caches seed from persisted programs instead of tracing.
+# The wall clock starts here — cold-start-to-first-token covers the
+# artifact load (or the fresh traces it replaces) plus the first dispatch.
+import time as _time  # noqa: E402
+
+_t_cold0 = _time.perf_counter()
+artifact = None
+if args.artifact is not None:
+    from triton_dist_tpu.aot import load_artifact  # noqa: E402
+    artifact = load_artifact(args.artifact)
+
+
 def mk_engine(fresh=False):
     """Build the selected engine. ``fresh=True`` is the restarted
     incarnation after a crash: same configuration, same journal — the
@@ -234,7 +257,8 @@ def mk_engine(fresh=False):
                   decode_horizon=args.decode_horizon, journal=journal,
                   checkpoint_every=ckpt_every, queue_cap=args.queue_cap,
                   ttl_steps=args.ttl, fault_plan=_fault_plan(),
-                  prefix_cache=args.prefix_cache, slo=slo_policy)
+                  prefix_cache=args.prefix_cache, slo=slo_policy,
+                  artifact=artifact)
     if args.mesh is not None and args.disagg:
         # ISSUE 12: the composed engine — disaggregated prefill feeding a
         # ShardedServingEngine decode fleet on ONE TP/SP/EP mesh (the
@@ -390,6 +414,24 @@ if args.tokens:
             "ttft_steps": req.first_token_step - req.submit_step,
         }))
 print(json.dumps({"compile_stats": eng.compile_stats}), file=sys.stderr)
+
+# cold-start summary (ISSUE 15): fresh traces paid before the first token
+# and the wall time from process cold start (engine build / artifact
+# load) to the first token out. With --artifact both columns should read
+# zero-compiles and the ~10x-smaller wall time bench.py's `aot` extras
+# pin; printed unconditionally so artifact-on vs artifact-off runs (and
+# --recover restarts, which seed from the same artifact) compare 1:1.
+_stats = eng.compile_stats
+_ftt = [r.first_token_time for r in eng._finished
+        if r.first_token_time is not None]
+print(json.dumps({"cold_start": {
+    "artifact": args.artifact,
+    "cold_start_compiles": sum(
+        v for k, v in _stats.items() if k.endswith("_compiles")),
+    "aot_programs": _stats.get("aot_programs", 0),
+    "cold_start_to_first_token_s":
+        None if not _ftt else round(min(_ftt) - _t_cold0, 4),
+}}), file=sys.stderr)
 
 # prefill-stall / TTFT-split summary: the numbers chunked prefill moves
 # (per-step decode stall bound, queue-vs-prefill TTFT split)

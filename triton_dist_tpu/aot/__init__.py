@@ -1,0 +1,33 @@
+"""Persisted tuning + ahead-of-time compilation subsystem (ROADMAP item 5,
+the reference's L9 tier: ``contextual_autotune`` winners that survive the
+process + ``tools/compile_aot.py``-style serving artifacts).
+
+- :mod:`~triton_dist_tpu.aot.registry` — sigcheck-gated, digest-audited
+  tuned-config registry keyed on ``(op, mesh_shape, dtype, shape_bucket)``.
+- :mod:`~triton_dist_tpu.aot.artifact` — the versioned AOT artifact
+  directory holding every serving engine's compiled-program set, loaded
+  at replica restart for a zero-fresh-trace cold start.
+"""
+
+from triton_dist_tpu.aot.artifact import (ArtifactIntegrityError,
+                                          ArtifactMissError, ArtifactSpec,
+                                          LoadedProgram, ServingArtifact,
+                                          build_artifact,
+                                          engine_artifact_key, load_artifact,
+                                          make_engine)
+from triton_dist_tpu.aot.registry import (GATE_RUNNERS,
+                                          RegistryAdmissionError,
+                                          RegistryIntegrityError,
+                                          TunedConfigRegistry, TunedKey,
+                                          get_default_registry,
+                                          set_default_registry,
+                                          shape_bucket_of)
+
+__all__ = [
+    "TunedKey", "TunedConfigRegistry", "RegistryIntegrityError",
+    "RegistryAdmissionError", "shape_bucket_of", "GATE_RUNNERS",
+    "set_default_registry", "get_default_registry",
+    "ArtifactSpec", "ServingArtifact", "LoadedProgram", "ArtifactMissError",
+    "ArtifactIntegrityError", "build_artifact", "load_artifact",
+    "make_engine", "engine_artifact_key",
+]

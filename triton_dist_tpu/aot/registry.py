@@ -1,0 +1,403 @@
+"""Persisted tuned-config registry (analog of the reference's autotune
+cache file + ML-Triton's multi-level AOT workflow, PAPERS.md).
+
+``contextual_autotune`` reaches cross-rank consensus on a winner but the
+result dies with the process; this registry is the surviving half: winners
+are recorded under a ``(op, mesh_shape, dtype, shape_bucket)`` key, JSON-
+serialized next to the AOT artifact (aot/artifact.py), and read back by the
+autotuned op wrappers as the first candidate on the next cold start.
+
+Admission is **sigcheck-gated**: a tuned config only enters the registry if
+its kernel passes the static signal-protocol verifier at the target mesh
+sizes (``analysis.api.sigcheck`` — trace-only, no device execution). A
+config whose kernel sigcheck flags is refused with a typed
+:class:`RegistryAdmissionError` carrying the findings; it never becomes a
+persisted default someone else's replica deploys with.
+
+On-disk integrity follows the PR 13 snapshot-audit idiom: the file carries
+an FNV-1a digest over the canonical entry encoding, recomputed on load —
+a torn or tampered registry raises :class:`RegistryIntegrityError` instead
+of silently feeding a corrupted config into the serving path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+# -- FNV-1a over the canonical JSON encoding (same digest family as the
+# pool/scheduler digests in serving/kv_pool.py and the checkpoint audit) ----
+
+_FNV_OFF = 0x811C9DC5
+_FNV_PRIME = 0x01000193
+
+
+def _fnv1a_bytes(data: bytes, h: int = _FNV_OFF) -> int:
+    for b in data:
+        h ^= b
+        h = (h * _FNV_PRIME) & 0xFFFFFFFF
+    return h
+
+
+class RegistryIntegrityError(RuntimeError):
+    """The persisted registry's digest does not match its entries — the
+    file is torn or tampered. Never served from."""
+
+
+class RegistryAdmissionError(RuntimeError):
+    """A tuned config was refused registry entry: its kernel failed the
+    sigcheck admission gate (or no gate runner exists for the op and the
+    registry requires one). Carries the verifier findings."""
+
+    def __init__(self, msg: str, op: str = "", findings=()):
+        super().__init__(msg)
+        self.op = op
+        self.findings = list(findings)
+
+    @property
+    def finding_kinds(self) -> list:
+        return [getattr(f, "kind", str(f)) for f in self.findings]
+
+
+# -- keys --------------------------------------------------------------------
+
+def shape_bucket_of(*shapes) -> Tuple[Tuple[int, ...], ...]:
+    """Pow2-bucket each dim of each shape — the registry's shape key. Two
+    problem sizes in the same bucket share a tuned config (the autotuner's
+    exact-shape cache still disambiguates within a process)."""
+    def b1(d):
+        d = int(d)
+        if d <= 1:
+            return d
+        p = 1
+        while p < d:
+            p *= 2
+        return p
+    return tuple(tuple(b1(d) for d in s) for s in shapes)
+
+
+@dataclasses.dataclass(frozen=True)
+class TunedKey:
+    """Registry key: the op name, the mesh shape the winner was tuned on
+    (``()`` for single-device ops), the payload dtype, and the pow2 shape
+    bucket of the array operands."""
+
+    op: str
+    mesh_shape: Tuple[int, ...] = ()
+    dtype: str = "float32"
+    shape_bucket: Tuple = ()
+
+    def to_json(self) -> dict:
+        return {"op": self.op, "mesh_shape": list(self.mesh_shape),
+                "dtype": self.dtype,
+                "shape_bucket": [list(s) for s in self.shape_bucket]}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "TunedKey":
+        return cls(op=d["op"], mesh_shape=tuple(d["mesh_shape"]),
+                   dtype=d["dtype"],
+                   shape_bucket=tuple(tuple(s) for s in d["shape_bucket"]))
+
+
+# -- config codec ------------------------------------------------------------
+# GemmConfig and the scalar/tuple cfg forms the autotuned wrappers use all
+# round-trip through a tagged JSON encoding; anything else is refused
+# loudly rather than pickled.
+
+def _encode_config(cfg: Any) -> dict:
+    from triton_dist_tpu.ops.gemm import GemmConfig
+    if isinstance(cfg, GemmConfig):
+        return {"kind": "GemmConfig", "block_m": cfg.block_m,
+                "block_n": cfg.block_n, "block_k": cfg.block_k}
+    if isinstance(cfg, bool):
+        raise TypeError(f"unsupported tuned-config type: {type(cfg)}")
+    if isinstance(cfg, int):
+        return {"kind": "int", "value": cfg}
+    if isinstance(cfg, str):
+        return {"kind": "str", "value": cfg}
+    if isinstance(cfg, (tuple, list)) and all(
+            isinstance(v, int) for v in cfg):
+        return {"kind": "ints", "value": list(cfg)}
+    raise TypeError(f"unsupported tuned-config type: {type(cfg)!r} "
+                    f"({cfg!r}) — add a codec in aot/registry.py")
+
+
+def _decode_config(d: dict) -> Any:
+    kind = d["kind"]
+    if kind == "GemmConfig":
+        from triton_dist_tpu.ops.gemm import GemmConfig
+        return GemmConfig(d["block_m"], d["block_n"], d["block_k"])
+    if kind == "int":
+        return d["value"]
+    if kind == "str":
+        return d["value"]
+    if kind == "ints":
+        return tuple(d["value"])
+    raise RegistryIntegrityError(
+        f"unknown tuned-config kind {kind!r} in persisted registry")
+
+
+# -- sigcheck gate runners ---------------------------------------------------
+# Per-op factories building a ``run(ctx)`` the verifier can capture WITH the
+# candidate config applied. Shapes are derived from the config so the tile
+# asserts hold at every capture rank count (the idiom of
+# analysis/registry.py, which instantiates each op at fixed tiny configs).
+
+def _gate_ag_gemm(cfg) -> Callable:
+    def run(ctx):
+        import jax.numpy as jnp
+        from triton_dist_tpu.ops import ag_gemm
+        n = ctx.num_ranks
+        k = cfg.block_k or 128
+        a = jnp.zeros((cfg.block_m * n, k), jnp.float32)
+        b = jnp.zeros((k, cfg.block_n * n), jnp.float32)
+        ag_gemm(ctx, a, b, axis="x", cfg=cfg)
+    return run
+
+
+def _gate_gemm_rs(cfg) -> Callable:
+    def run(ctx):
+        import jax.numpy as jnp
+        from triton_dist_tpu.ops import gemm_rs
+        n = ctx.num_ranks
+        k = cfg.block_k or 128
+        a = jnp.zeros((cfg.block_m * n, k * n), jnp.float32)
+        b = jnp.zeros((k * n, cfg.block_n), jnp.float32)
+        gemm_rs(ctx, a, b, axis="x", cfg=cfg)
+    return run
+
+
+def _gate_ag_moe_group_gemm(block_m) -> Callable:
+    def run(ctx):
+        import jax.numpy as jnp
+        from triton_dist_tpu.ops import ag_moe_group_gemm
+        n = ctx.num_ranks
+        t = max(8, int(block_m))
+        tokens = jnp.zeros((t * n, 128), jnp.float32)
+        ids = jnp.zeros((t * n,), jnp.int32)
+        weights = jnp.zeros((2, 128, 16 * n), jnp.float32)
+        ag_moe_group_gemm(ctx, tokens, ids, weights, axis="x",
+                          block_m=int(block_m), block_n=16)
+    return run
+
+
+def _gate_moe_reduce_rs(block_m) -> Callable:
+    def run(ctx):
+        import jax.numpy as jnp
+        from triton_dist_tpu.ops import moe_reduce_rs
+        n = ctx.num_ranks
+        topk = 2
+        t = max(4 * n, int(block_m))
+        tokens = jnp.zeros((t * topk, 128 * n), jnp.float32)
+        ids = jnp.zeros((t * topk,), jnp.int32)
+        moe_reduce_rs(ctx, tokens, ids, jnp.ones((t, topk), jnp.float32),
+                      jnp.zeros((2, 128 * n, 16), jnp.float32), axis="x",
+                      block_m=int(block_m))
+    return run
+
+
+def _gate_ring_attention(bqbk) -> Callable:
+    # the A2A/ring signal protocol is tile-size-independent (the analysis
+    # registry skips the autotuned wrappers for exactly this reason), so
+    # the gate captures at the protocol-representative 128 tile — the
+    # candidate's (bq, bk) only sizes on-chip blocks, never the DMA plan
+    def run(ctx):
+        import jax.numpy as jnp
+        from triton_dist_tpu.ops import ring_attention
+        n = ctx.num_ranks
+        q = jnp.zeros((1, 2, n * 128, 128), jnp.float32)
+        kv = jnp.zeros((1, 2, n * 128, 128), jnp.float32)
+        ring_attention(ctx, q, kv, kv, axis="x", block_q=128, block_k=128)
+    return run
+
+
+GATE_RUNNERS: Dict[str, Callable[[Any], Callable]] = {
+    "ag_gemm": _gate_ag_gemm,
+    "gemm_rs": _gate_gemm_rs,
+    "ag_moe_group_gemm": _gate_ag_moe_group_gemm,
+    "moe_reduce_rs": _gate_moe_reduce_rs,
+    "ring_attention": _gate_ring_attention,
+}
+
+
+def _gate_meshes(mesh_shape: Tuple[int, ...]) -> Tuple[Dict[str, int], ...]:
+    """Capture meshes for the admission gate: n=2 (the minimal ring) plus
+    the key's own world size clamped to the verifier's supported range."""
+    total = 1
+    for d in mesh_shape:
+        total *= int(d)
+    ns = sorted({2, min(max(total, 2), 4)})
+    return tuple({"x": n} for n in ns)
+
+
+# -- the registry ------------------------------------------------------------
+
+FORMAT_VERSION = 1
+
+
+class TunedConfigRegistry:
+    """JSON-serializable winner store keyed on
+    ``(op, mesh_shape, dtype, shape_bucket)``.
+
+    ``require_sigcheck=True`` (the default) makes :meth:`put` refuse any
+    mesh-keyed config whose op has no gate runner and any config whose
+    kernel the verifier flags; single-device keys (``mesh_shape=()``)
+    carry no signal protocol and are admitted ungated, recorded as such.
+    """
+
+    def __init__(self, require_sigcheck: bool = True):
+        self.require_sigcheck = require_sigcheck
+        self._entries: Dict[TunedKey, Any] = {}
+        self._checked: Dict[TunedKey, bool] = {}
+        self.lookups = 0
+        self.hits = 0
+
+    # -- admission --------------------------------------------------------
+    def put(self, key: TunedKey, config: Any,
+            run: Optional[Callable] = None,
+            meshes: Optional[Sequence[Dict[str, int]]] = None) -> None:
+        """Admit ``config`` under ``key`` through the sigcheck gate.
+
+        ``run`` overrides the built-in gate runner (``run(ctx)`` drives
+        the kernel end to end on the capture context — the gallery tests
+        pass intentionally-broken kernels through here)."""
+        _encode_config(config)          # refuse unserializable configs NOW
+        checked = False
+        if key.mesh_shape:              # distributed op: protocol to verify
+            runner = run
+            if runner is None:
+                factory = GATE_RUNNERS.get(key.op)
+                runner = factory(config) if factory is not None else None
+            if runner is None:
+                if self.require_sigcheck:
+                    raise RegistryAdmissionError(
+                        f"no sigcheck gate runner for op {key.op!r} — a "
+                        f"mesh-keyed config cannot enter the registry "
+                        f"unverified (pass run=, or register the op in "
+                        f"aot.registry.GATE_RUNNERS)", op=key.op)
+            else:
+                from triton_dist_tpu.analysis.api import sigcheck
+                report = sigcheck(
+                    runner, op=key.op,
+                    meshes=meshes or _gate_meshes(key.mesh_shape))
+                if not report.ok:
+                    kinds = ",".join(report.finding_kinds)
+                    raise RegistryAdmissionError(
+                        f"sigcheck refused config {config!r} for op "
+                        f"{key.op!r} at meshes {report.ns}: findings "
+                        f"[{kinds}] — a flagged kernel never becomes a "
+                        f"persisted default", op=key.op,
+                        findings=report.findings)
+                checked = True
+        self._entries[key] = config
+        self._checked[key] = checked
+
+    # -- lookup -----------------------------------------------------------
+    def get(self, key: TunedKey) -> Any:
+        """Winner for ``key`` or None. Counts toward ``hit_rate``."""
+        self.lookups += 1
+        if key in self._entries:
+            self.hits += 1
+            return self._entries[key]
+        return None
+
+    def get_similar(self, op: str, dtype: str) -> Any:
+        """Any winner for (op, dtype) ignoring mesh/shape — used by the
+        autotuned wrappers to promote a near-miss winner to the FRONT of
+        the candidate list (still timed, just first)."""
+        for k, v in self._entries.items():
+            if k.op == op and k.dtype == dtype:
+                return v
+        return None
+
+    def checked(self, key: TunedKey) -> bool:
+        """True when ``key``'s config passed the sigcheck gate at admission
+        (single-device keys and ``require_sigcheck=False`` admits record
+        False — the distinction is persisted, auditable, and honest)."""
+        return self._checked.get(key, False)
+
+    @property
+    def hit_rate(self) -> float:
+        return self.hits / self.lookups if self.lookups else 0.0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: TunedKey) -> bool:
+        return key in self._entries
+
+    def keys(self):
+        return self._entries.keys()
+
+    # -- persistence ------------------------------------------------------
+    def _entries_json(self) -> list:
+        rows = [{"key": k.to_json(), "config": _encode_config(v),
+                 "checked": self._checked.get(k, False)}
+                for k, v in self._entries.items()]
+        rows.sort(key=lambda r: json.dumps(r["key"], sort_keys=True))
+        return rows
+
+    def to_json(self) -> dict:
+        entries = self._entries_json()
+        canon = json.dumps(entries, sort_keys=True).encode()
+        return {"format": FORMAT_VERSION, "entries": entries,
+                "digest": f"{_fnv1a_bytes(canon):08x}"}
+
+    @classmethod
+    def from_json(cls, doc: dict,
+                  require_sigcheck: bool = True) -> "TunedConfigRegistry":
+        if doc.get("format") != FORMAT_VERSION:
+            raise RegistryIntegrityError(
+                f"registry format {doc.get('format')!r} != "
+                f"{FORMAT_VERSION} — refusing to guess at the layout")
+        entries = doc.get("entries", [])
+        canon = json.dumps(entries, sort_keys=True).encode()
+        digest = f"{_fnv1a_bytes(canon):08x}"
+        if digest != doc.get("digest"):
+            raise RegistryIntegrityError(
+                f"tuned-config registry torn or tampered: entry digest "
+                f"{digest} != recorded {doc.get('digest')!r}")
+        reg = cls(require_sigcheck=require_sigcheck)
+        for row in entries:
+            key = TunedKey.from_json(row["key"])
+            # load path trusts the digest, not the gate: entries were
+            # gated at put() time and the digest proves they are unedited
+            reg._entries[key] = _decode_config(row["config"])
+            reg._checked[key] = bool(row.get("checked", False))
+        return reg
+
+    def save(self, path: str) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self.to_json(), f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str,
+             require_sigcheck: bool = True) -> "TunedConfigRegistry":
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        return cls.from_json(doc, require_sigcheck=require_sigcheck)
+
+
+# -- process-default registry (the autotuner's write target) -----------------
+
+_DEFAULT: Optional[TunedConfigRegistry] = None
+
+
+def set_default_registry(reg: Optional[TunedConfigRegistry]) -> None:
+    """Install ``reg`` as the registry ``contextual_autotune`` consults and
+    records winners into (None detaches)."""
+    global _DEFAULT
+    _DEFAULT = reg
+
+
+def get_default_registry() -> Optional[TunedConfigRegistry]:
+    return _DEFAULT
+
+
+__all__ = ["TunedKey", "TunedConfigRegistry", "RegistryIntegrityError",
+           "RegistryAdmissionError", "shape_bucket_of", "GATE_RUNNERS",
+           "set_default_registry", "get_default_registry"]

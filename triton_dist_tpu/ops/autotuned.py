@@ -61,14 +61,14 @@ _rs_jit = jax.jit(gemm_rs, static_argnums=(0,),
                   static_argnames=("axis", "cfg", "out_dtype"))
 
 
-@contextual_autotune(configs=_CANDIDATES, prune=_prune_ag)
+@contextual_autotune(configs=_CANDIDATES, prune=_prune_ag, op="ag_gemm")
 def ag_gemm_autotuned(ctx: ShmemContext, a: jax.Array, b: jax.Array,
                       axis: str | None = None, cfg: GemmConfig | None = None,
                       out_dtype=None) -> jax.Array:
     return _ag_jit(ctx, a, b, axis=axis, cfg=cfg, out_dtype=out_dtype)
 
 
-@contextual_autotune(configs=_CANDIDATES, prune=_prune_rs)
+@contextual_autotune(configs=_CANDIDATES, prune=_prune_rs, op="gemm_rs")
 def gemm_rs_autotuned(ctx: ShmemContext, a: jax.Array, b: jax.Array,
                       axis: str | None = None, cfg: GemmConfig | None = None,
                       out_dtype=None) -> jax.Array:
@@ -107,7 +107,8 @@ _moe_rs_jit = jax.jit(moe_reduce_rs, static_argnums=(0,),
                       static_argnames=("axis", "block_m"))
 
 
-@contextual_autotune(configs=_MOE_BLOCK_CANDIDATES, prune=_prune_moe_ag)
+@contextual_autotune(configs=_MOE_BLOCK_CANDIDATES, prune=_prune_moe_ag,
+                     op="ag_moe_group_gemm")
 def ag_moe_group_gemm_autotuned(ctx: ShmemContext, tokens, ids, weights,
                                 axis: str | None = None, cfg=None):
     """``ag_moe_group_gemm`` with the grouped-GEMM block size tuned per
@@ -115,7 +116,8 @@ def ag_moe_group_gemm_autotuned(ctx: ShmemContext, tokens, ids, weights,
     return _moe_ag_jit(ctx, tokens, ids, weights, axis=axis, block_m=cfg)
 
 
-@contextual_autotune(configs=_MOE_BLOCK_CANDIDATES, prune=_prune_moe_rs)
+@contextual_autotune(configs=_MOE_BLOCK_CANDIDATES, prune=_prune_moe_rs,
+                     op="moe_reduce_rs")
 def moe_reduce_rs_autotuned(ctx: ShmemContext, tokens, ids, topk_weights,
                             weights, axis: str | None = None, cfg=None):
     return _moe_rs_jit(ctx, tokens, ids, topk_weights, weights, axis=axis,
@@ -178,7 +180,8 @@ def _ffn_run(tokens, ids, w_gate, w_up, w_down, bm, bn):
     return apply_grouped(tokens, ids, w_gate.shape[0], f, block_m=bm)
 
 
-@contextual_autotune(configs=_GG_CANDIDATES, prune=_prune_gg)
+@contextual_autotune(configs=_GG_CANDIDATES, prune=_prune_gg,
+                     op="grouped_gemm")
 def grouped_gemm_autotuned(tokens, ids, weights,
                            num_experts: int | None = None, cfg=None):
     """Single grouped GEMM over (tokens [T,H], ids [T], weights [E,H,N])
@@ -188,7 +191,8 @@ def grouped_gemm_autotuned(tokens, ids, weights,
                    bm, bn)
 
 
-@contextual_autotune(configs=_GG_CANDIDATES, prune=_prune_gg)
+@contextual_autotune(configs=_GG_CANDIDATES, prune=_prune_gg,
+                     op="moe_ffn_gated")
 def moe_ffn_gated_autotuned(tokens, ids, w_gate, w_up, w_down, cfg=None):
     """The EP serving block's expert-FFN stage (fused gate+up+act grouped
     GEMM, then the down grouped GEMM) with (block_m, block_n) tuned per
@@ -241,7 +245,8 @@ _attn_jit = jax.jit(
                      "batch_axis", "head_axis", "layout"))
 
 
-@contextual_autotune(configs=_ATTN_CANDIDATES, prune=_prune_attn)
+@contextual_autotune(configs=_ATTN_CANDIDATES, prune=_prune_attn,
+                     op="ring_attention")
 def ring_attention_autotuned(ctx: ShmemContext, q, k, v,
                              axis: str | None = None, causal: bool = True,
                              layout: str = "contiguous", cfg=None):

@@ -460,16 +460,26 @@ class EngineReplica:
     replays the retained object instead of re-reading disk).
     """
 
-    def __init__(self, index: int, factory, journal_dir: str | None = None):
+    def __init__(self, index: int, factory, journal_dir: str | None = None,
+                 artifact=None):
         self.index = index
         self._factory = factory
+        self.artifact = artifact
         self.journal_path = (os.path.join(journal_dir,
                                           f"journal-r{index}.jsonl")
                              if journal_dir is not None else None)
         self.journal = ControlJournal(path=self.journal_path)
-        self.engine = factory(self.journal)
+        self.engine = self._build(self.journal)
         self.alive = True
         self.failovers = 0
+
+    def _build(self, journal):
+        """AOT artifact (ISSUE 15): thread the artifact through BOTH the
+        cold build and every restore — a restored replica must reach its
+        first token with zero fresh traces, exactly like a cold one."""
+        if self.artifact is not None:
+            return self._factory(journal, artifact=self.artifact)
+        return self._factory(journal)
 
     # load signals, duck-typed off the engine's intake scheduler and the
     # pool the decode work actually occupies
@@ -536,7 +546,7 @@ class EngineReplica:
         else:
             j = self.journal
         self.journal = j
-        self.engine = self._factory(j)
+        self.engine = self._build(j)
         stats = ckpt_mod.restore(self.engine, ckpt_mod.latest(j), j)
         self.alive = True
         return stats
@@ -550,9 +560,10 @@ class Cluster:
 
     def __init__(self, factory, replicas: int = 4,
                  journal_dir: str | None = None, prefix_tokens: int = 8,
-                 spill_threshold: int | None = None):
+                 spill_threshold: int | None = None, artifact=None):
         assert replicas >= 1
-        self.replicas = [EngineReplica(i, factory, journal_dir)
+        self.replicas = [EngineReplica(i, factory, journal_dir,
+                                       artifact=artifact)
                          for i in range(replicas)]
         self.prefix_tokens = prefix_tokens
         self.spill_threshold = spill_threshold

@@ -123,7 +123,8 @@ class DisaggShardedEngine:
                  ttl_steps: int | None = None,
                  fault_plan: "faults.FaultPlan | None" = None,
                  prefix_cache: bool = False,
-                 slo: SLOPolicy | None = None):
+                 slo: SLOPolicy | None = None,
+                 artifact=None, artifact_key: str | None = None):
         assert prefill_chunk is not None, (
             "the composed engine requires prefill_chunk: chunks are the "
             "migration unit AND the sharded engine's only prefill path")
@@ -154,6 +155,13 @@ class DisaggShardedEngine:
         # journal/TTL/queue-cap stay None — the COMPOSED engine owns the
         # crash-consistency and overload surfaces (one journal, one intake
         # queue); the decode engine's digest guard runs at full cadence.
+        # AOT artifact (ISSUE 15): the composition's programs live under
+        # ONE key — the inner decode engine seeds chunk/decode from it,
+        # and the xmig copy program is seeded below.
+        self._aot_artifact = artifact
+        self._aot_key = artifact_key or (
+            f"disagg_sharded:{ctx.axis_size('tp')}x"
+            f"{ctx.axis_size('sp')}x{ctx.axis_size('ep')}")
         self.decode = ShardedServingEngine(
             params, cfg, ctx, num_slots=num_slots, page_size=page_size,
             num_pages=num_pages, pages_per_seq=pages_per_seq,
@@ -161,7 +169,8 @@ class DisaggShardedEngine:
             eos_id=eos_id, prefill_chunk=prefill_chunk,
             wire_dtype=wire_dtype, tp_impl=tp_impl, tp_cfg=tp_cfg,
             moe_block_m=moe_block_m, digest_every=digest_every,
-            prefix_cache=prefix_cache)
+            prefix_cache=prefix_cache,
+            artifact=artifact, artifact_key=self._aot_key)
         self.decode._preempt_hook = self._on_decode_preempt
         self.mesh_desc = self.decode.mesh_desc
         self.wire_dtype = self.decode.wire_dtype
@@ -218,6 +227,10 @@ class DisaggShardedEngine:
             self._xmig = jax.jit(xmig, **kw)
         else:
             self._xmig = jax.jit(xmig, donate_argnums=(6, 7), **kw)
+        if artifact is not None:
+            # _launch reads self._xmig at call time, so seeding here is
+            # enough — no closure rebind needed
+            self._xmig = artifact.program(self._aot_key, "xmig")
 
         # TDT_SIGCHECK=1: the decode engine linted its own two programs in
         # its constructor; lint the composition's third program here
@@ -1110,13 +1123,19 @@ class DisaggShardedEngine:
                 return fallback
 
         base = self.decode.compile_stats
-        return {
+        stats = {
             "prefill_chunk_compiles": base["prefill_chunk_compiles"],
             "decode_compiles": base["decode_compiles"],
             "migrate_compiles": n(
                 self._xmig,
                 1 if self.metrics.counters["migrate_chunks"] else 0),
         }
+        if self._aot_artifact is not None:
+            from triton_dist_tpu.aot.artifact import LoadedProgram
+            stats["aot_programs"] = (
+                base.get("aot_programs", 0)
+                + int(isinstance(self._xmig, LoadedProgram)))
+        return stats
 
 
 __all__ = ["DisaggShardedEngine"]

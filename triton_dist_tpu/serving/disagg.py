@@ -429,7 +429,8 @@ class DisaggServingEngine:
                  queue_cap: int | None = None,
                  ttl_steps: int | None = None,
                  prefix_cache: bool = False,
-                 slo: SLOPolicy | None = None):
+                 slo: SLOPolicy | None = None,
+                 artifact=None, artifact_key: str | None = None):
         assert prefill_chunk >= 1 and decode_horizon >= 1
         assert signal_deadline_steps >= 1 and max_retries >= 0
         assert checkpoint_every is None or checkpoint_every >= 1
@@ -583,6 +584,17 @@ class DisaggServingEngine:
             self._chunk_step = jax.jit(chunk_sm, donate_argnums=(4, 5))
             self._dec_step = jax.jit(dec_sm, donate_argnums=(3, 4))
             self._migrate = jax.jit(mig_f, donate_argnums=(4, 5))
+
+        # AOT artifact seeding (ISSUE 15): replace all three SPMD programs
+        # with the artifact's deserialized executables BEFORE the channel
+        # captures the migrate launch — zero fresh traces from cold start
+        # to first token (compile_stats reports aot_programs)
+        self._aot_artifact = artifact
+        if artifact is not None:
+            self._aot_key = artifact_key or "disagg"
+            self._chunk_step = artifact.program(self._aot_key, "chunk")
+            self._dec_step = artifact.program(self._aot_key, "decode")
+            self._migrate = artifact.program(self._aot_key, "migrate")
 
         # widest possible per-chunk migration: a C-token chunk can
         # finalize at most C//ps whole pages plus the straddle page it
@@ -1742,7 +1754,7 @@ class DisaggServingEngine:
             except Exception:
                 return fallback
 
-        return {
+        stats = {
             "prefill_chunk_compiles": n(
                 self._chunk_step,
                 1 if self.metrics.counters["prefill_chunks"] else 0),
@@ -1751,6 +1763,12 @@ class DisaggServingEngine:
                 self._migrate,
                 1 if self.metrics.counters["migrate_chunks"] else 0),
         }
+        if self._aot_artifact is not None:
+            from triton_dist_tpu.aot.artifact import LoadedProgram
+            stats["aot_programs"] = sum(
+                isinstance(f, LoadedProgram)
+                for f in (self._chunk_step, self._dec_step, self._migrate))
+        return stats
 
 
 __all__ = ["DisaggServingEngine", "PageMigrationChannel",

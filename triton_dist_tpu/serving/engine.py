@@ -164,7 +164,8 @@ class ServingEngine:
                  ttl_steps: int | None = None,
                  fault_plan=None,
                  prefix_cache: bool = False,
-                 slo: SLOPolicy | None = None):
+                 slo: SLOPolicy | None = None,
+                 artifact=None, artifact_key: str | None = None):
         assert decode_horizon >= 1
         assert prefill_chunk is None or prefill_chunk >= 1
         assert not prefix_cache or prefill_chunk is not None, (
@@ -328,6 +329,30 @@ class ServingEngine:
                     pool_abs, i32(pages_per_seq)))
             lint_engine_programs(programs, type(self).__name__)
 
+        # AOT artifact seeding (ISSUE 15): swap the freshly-built jit
+        # objects for the artifact's deserialized programs so a cold start
+        # reaches first token with ZERO fresh traces of the model code —
+        # compile_stats reports the swap via the ``aot_programs`` key and
+        # the replaced programs' trace caches stay at size 0 by
+        # construction (LoadedProgram never traces its source).
+        self._aot_artifact = artifact
+        if artifact is not None:
+            self._seed_from_artifact(artifact, artifact_key)
+
+    # -- AOT artifact (ISSUE 15) ------------------------------------------
+    def _default_artifact_key(self) -> str:
+        return "colocated"
+
+    def _seed_from_artifact(self, artifact, artifact_key: str | None) -> None:
+        key = artifact_key or self._default_artifact_key()
+        self._aot_key = key
+        self._step = artifact.program(key, "decode")
+        if self._chunk_step is not None:
+            self._chunk_step = artifact.program(key, "chunk")
+        for bucket, cache_len in artifact.prefill_keys(key):
+            self._prefill_jit[(bucket, cache_len)] = artifact.program(
+                key, f"prefill:{bucket}x{cache_len}")
+
     def _sync_mirrors(self) -> None:
         """Upload the host slot mirrors to the device copies. The sharded
         engine overrides this to COMMIT the uploads to the mesh (matching
@@ -417,6 +442,16 @@ class ServingEngine:
     def _prefill_fn(self, bucket: int, cache_len: int):
         key = (bucket, cache_len)
         if key not in self._prefill_jit:
+            if self._aot_artifact is not None:
+                # artifact-seeded engines never trace: a bucket outside
+                # the artifact's program set is a typed loud miss, not a
+                # silent fresh compile on the serving path
+                from triton_dist_tpu.aot.artifact import ArtifactMissError
+                raise ArtifactMissError(
+                    f"prefill bucket {bucket} (cache_len {cache_len}) is "
+                    f"not in the artifact's program set for "
+                    f"{self._aot_key!r} — rebuild the artifact with this "
+                    f"bucket declared")
             cfg = self.cfg
             if self.prefill_buckets is None:
                 # exact mode: the legacy no-length trace, bit-for-bit
@@ -1200,13 +1235,20 @@ class ServingEngine:
         if self._chunk_step is not None:
             chunk = n(self._chunk_step,
                       1 if self.metrics.counters["prefill_chunks"] else 0)
-        return {
+        stats = {
             "decode_compiles": n(self._step, 1 if self._steps else 0),
             "prefill_compiles": prefills,
             "prefill_programs": len(self._prefill_jit),
             # chunked mode: exactly one program for ALL prompt lengths
             "prefill_chunk_compiles": chunk,
         }
+        if self._aot_artifact is not None:
+            from triton_dist_tpu.aot.artifact import LoadedProgram
+            stats["aot_programs"] = sum(
+                isinstance(f, LoadedProgram)
+                for f in (self._step, self._chunk_step,
+                          *self._prefill_jit.values()))
+        return stats
 
 
 __all__ = ["ServingEngine", "mark_prefill_start", "record_first_token"]

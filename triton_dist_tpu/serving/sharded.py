@@ -126,7 +126,8 @@ class ShardedServingEngine(ServingEngine):
                  ttl_steps: int | None = None,
                  fault_plan=None,
                  prefix_cache: bool = False,
-                 slo=None):
+                 slo=None,
+                 artifact=None, artifact_key: str | None = None):
         for ax in MESH_AXES:
             assert ax in ctx.axis_names, (
                 f"mesh is missing axis {ax!r} — build it with "
@@ -203,7 +204,8 @@ class ShardedServingEngine(ServingEngine):
                          journal=journal, checkpoint_every=checkpoint_every,
                          queue_cap=queue_cap, ttl_steps=ttl_steps,
                          fault_plan=fault_plan, prefix_cache=prefix_cache,
-                         slo=slo)
+                         slo=slo, artifact=artifact,
+                         artifact_key=artifact_key)
 
         # shard the pool arrays over SP on the page dim, padding the page
         # count up to a multiple of |sp|. The ALLOCATOR never learns about
@@ -242,6 +244,9 @@ class ShardedServingEngine(ServingEngine):
 
         self._digest_check = jax.jit(ctx.shard_map(
             gather_cmp, in_specs=P(MESH_AXES), out_specs=P(MESH_AXES)))
+
+    def _default_artifact_key(self) -> str:
+        return f"sharded:{self.mesh_desc}"
 
     def _sync_mirrors(self) -> None:
         self._token_dev = jax.device_put(jnp.asarray(self._token),

@@ -16,7 +16,15 @@ Same shape here, simpler by construction:
   elementwise MAX (a config is as slow as its slowest host), exactly the
   reference's consensus rule;
 - results are cached per (function, static key, arg shapes) and logged to
-  ``.autotune_logs/process-N.log`` (cf. autotuner.py:57-67).
+  ``.autotune_logs/process-N.log`` (cf. autotuner.py:57-67; the directory
+  moves with ``TDT_AUTOTUNE_LOG_DIR`` or the ``log_dir=`` kwarg);
+- winners can OUTLIVE the process (ISSUE 15): pass ``registry=`` (or
+  install one with ``aot.registry.set_default_registry``) and the wrapper
+  consults the persisted ``(op, mesh_shape, dtype, shape_bucket)`` key
+  before timing anything — an exact hit skips the sweep entirely (the
+  ``registry_hit`` log marker), a same-(op, dtype) near-hit is promoted to
+  the front of the candidate list, and a fresh winner is written back
+  through the registry's sigcheck admission gate.
 """
 
 from __future__ import annotations
@@ -44,22 +52,53 @@ def _consensus_times(times: np.ndarray) -> np.ndarray:
     return np.max(np.asarray(gathered), axis=0)
 
 
-def _log(msg: str) -> None:
-    os.makedirs(".autotune_logs", exist_ok=True)
-    path = f".autotune_logs/process-{jax.process_index()}.log"
+def _log(msg: str, log_dir: str | None = None) -> None:
+    d = (log_dir or os.environ.get("TDT_AUTOTUNE_LOG_DIR")
+         or ".autotune_logs")
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"process-{jax.process_index()}.log")
     with open(path, "a") as f:
         f.write(f"[{time.strftime('%H:%M:%S')}] {msg}\n")
 
 
+def _tuned_key(op: str, bound_args: dict):
+    """Registry key for this call: mesh shape from the first context-like
+    argument, dtype + pow2 shape bucket from the array operands."""
+    from triton_dist_tpu.aot.registry import TunedKey, shape_bucket_of
+    mesh_shape: tuple = ()
+    dtype = "float32"
+    shapes = []
+    for v in bound_args.values():
+        mesh = getattr(v, "mesh", None)
+        if not mesh_shape and mesh is not None and hasattr(mesh, "devices"):
+            mesh_shape = tuple(int(d) for d in np.shape(mesh.devices))
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            if not shapes:
+                dtype = str(v.dtype)
+            shapes.append(tuple(v.shape))
+    return TunedKey(op=op, mesh_shape=mesh_shape, dtype=dtype,
+                    shape_bucket=shape_bucket_of(*shapes))
+
+
 def contextual_autotune(configs: Sequence[Any], iters: int = 5,
                         warmup: int = 2,
-                        prune: Callable[[Any, tuple, dict], bool] | None = None):
+                        prune: Callable[[Any, tuple, dict], bool] | None = None,
+                        op: str | None = None,
+                        registry=None,
+                        log_dir: str | None = None):
     """Decorator: ``fn(*args, cfg=<config>, **kw)`` gets its ``cfg`` picked
     by timing every candidate on the first call per arg-shape signature.
 
     ``prune(config, args, kw)`` may return False to skip invalid candidates
     (e.g. tile sizes that don't divide the shapes — the analog of Triton's
     early-config-prune).
+
+    ``op`` names the kernel in the persisted registry (defaults to the
+    function's qualname); ``registry`` pins a
+    :class:`~triton_dist_tpu.aot.registry.TunedConfigRegistry` for this
+    wrapper (default: whatever ``set_default_registry`` installed, if
+    anything — no registry means the winner dies with the process, the
+    pre-ISSUE-15 behavior).
     """
     configs = list(configs)
 
@@ -87,6 +126,28 @@ def contextual_autotune(configs: Sequence[Any], iters: int = 5,
                 cands = [c for c in configs
                          if prune is None or prune(c, args, kw)]
                 assert cands, f"all autotune configs pruned for {key}"
+                # persisted-registry consult (ISSUE 15): exact key hit
+                # skips the sweep; a same-(op, dtype) near-hit only jumps
+                # the queue (still timed against the rest)
+                from triton_dist_tpu.aot.registry import \
+                    get_default_registry
+                reg = registry if registry is not None \
+                    else get_default_registry()
+                op_name = op or fn.__qualname__
+                tkey = None
+                if reg is not None:
+                    tkey = _tuned_key(op_name, bound.arguments)
+                    winner = reg.get(tkey)
+                    if winner is not None and (
+                            prune is None or prune(winner, args, kw)):
+                        _CACHE[key] = winner
+                        _log(f"{op_name} {tkey}: registry_hit "
+                             f"{winner} (no sweep)", log_dir)
+                        return fn(*args, **dict(kw, cfg=winner))
+                    near = reg.get_similar(op_name, tkey.dtype)
+                    if near is not None and near in cands:
+                        cands.remove(near)
+                        cands.insert(0, near)
                 times = np.full((len(cands),), np.inf)
                 for i, c in enumerate(cands):
                     try:
@@ -95,7 +156,8 @@ def contextual_autotune(configs: Sequence[Any], iters: int = 5,
                                           iters=iters, warmup_iters=warmup)
                         times[i] = ms
                     except Exception as e:  # config failed to compile/run
-                        _log(f"{fn.__qualname__} cfg {c}: FAILED {e!r}")
+                        _log(f"{fn.__qualname__} cfg {c}: FAILED {e!r}",
+                             log_dir)
                 times = _consensus_times(times)
                 best = int(np.argmin(times))
                 assert np.isfinite(times[best]), (
@@ -103,10 +165,33 @@ def contextual_autotune(configs: Sequence[Any], iters: int = 5,
                 _CACHE[key] = cands[best]
                 _log(f"{fn.__qualname__} {key[1]}: picked {cands[best]} "
                      f"({times[best]:.3f} ms; "
-                     f"{np.sum(np.isfinite(times))}/{len(cands)} ok)")
+                     f"{np.sum(np.isfinite(times))}/{len(cands)} ok)",
+                     log_dir)
+                if reg is not None:
+                    from triton_dist_tpu.aot.registry import \
+                        RegistryAdmissionError
+                    try:
+                        reg.put(tkey, cands[best])
+                        _log(f"{op_name} {tkey}: recorded winner "
+                             f"{cands[best]}", log_dir)
+                    except (RegistryAdmissionError, TypeError) as e:
+                        # the in-process pick stands; it just never
+                        # becomes a persisted default
+                        _log(f"{op_name} {tkey}: registry REFUSED "
+                             f"winner {cands[best]}: {e}", log_dir)
             return fn(*args, **dict(kw, cfg=_CACHE[key]))
 
+        def _registry_handle():
+            """The registry this wrapper reads/writes right now (the
+            explicit ``registry=`` pin, else the process default)."""
+            if registry is not None:
+                return registry
+            from triton_dist_tpu.aot.registry import get_default_registry
+            return get_default_registry()
+
         wrapper._autotune_cache = _CACHE
+        wrapper._autotune_op = op or fn.__qualname__
+        wrapper._autotune_registry = _registry_handle
         return wrapper
 
     return deco
