@@ -1448,31 +1448,48 @@ def bench_serving_sharded(ctx, num_requests: int = 24, num_slots: int = 4,
                 for i in range(num_requests)]
 
     rows, golden = {}, None
+    # overlap sweep (ISSUE 16): every multi-rank mesh runs twice —
+    # overlap=off (the PR 8 baseline) and overlap=ep+sp (microbatched EP
+    # dispatch + start-local SP pool assembly). BOTH rows are asserted
+    # bitwise against the n=1 golden: overlap moves the schedule, never
+    # the reduction order. The exposed/overlapped split is the wire-fit
+    # model (serving/sharded.py _comm_split_us) — CPU wall clock
+    # serializes ranks, so the modeled split is the honest number here.
     for tp, sp, ep in meshes:
-        eng = ShardedServingEngine(
-            params, cfg, serving_mesh(tp, sp, ep), num_slots=num_slots,
-            page_size=page_size, num_pages=num_pages,
-            pages_per_seq=pages_per_seq, decode_horizon=decode_horizon,
-            prefill_chunk=prefill_chunk, wire_dtype=jnp.float8_e4m3fn)
-        t0 = time.perf_counter()
-        res = eng.run(max_steps=100_000, arrivals=_trace())
-        wall = time.perf_counter() - t0
-        assert len(res) == num_requests
-        if golden is None:
-            golden = res
-        else:
-            assert res == golden, (
-                f"mesh {tp}x{sp}x{ep} changed tokens — the bitwise "
-                "cross-mesh contract broke")
-        snap = eng.metrics.snapshot()
-        rows[eng.mesh_desc] = {
-            "serving_tok_per_s": round(snap["tokens_generated"] / wall, 1),
-            "serving_step_us": round(
-                (snap["step_device_s"]["mean"] or 0.0) * 1e6, 1),
-            "dispatches": snap["dispatches"],
-            "digest_checks": snap["digest_checks"],
-            "compiles": eng.compile_stats,
-        }
+        variants = [("off", "")]
+        if tp * sp * ep > 1:
+            variants.append(("ep+sp", ":overlap=on"))
+        for overlap, tag in variants:
+            eng = ShardedServingEngine(
+                params, cfg, serving_mesh(tp, sp, ep), num_slots=num_slots,
+                page_size=page_size, num_pages=num_pages,
+                pages_per_seq=pages_per_seq, decode_horizon=decode_horizon,
+                prefill_chunk=prefill_chunk,
+                wire_dtype=jnp.float8_e4m3fn, overlap=overlap)
+            t0 = time.perf_counter()
+            res = eng.run(max_steps=100_000, arrivals=_trace())
+            wall = time.perf_counter() - t0
+            assert len(res) == num_requests
+            if golden is None:
+                golden = res
+            else:
+                assert res == golden, (
+                    f"mesh {tp}x{sp}x{ep} overlap={overlap} changed "
+                    "tokens — the bitwise cross-mesh contract broke")
+            snap = eng.metrics.snapshot()
+            rows[eng.mesh_desc + tag] = {
+                "serving_tok_per_s": round(
+                    snap["tokens_generated"] / wall, 1),
+                "serving_step_us": round(
+                    (snap["step_device_s"]["mean"] or 0.0) * 1e6, 1),
+                "exposed_comm_us": round(
+                    snap["exposed_comm_us"]["mean"] or 0.0, 2),
+                "overlapped_comm_us": round(
+                    snap["overlapped_comm_us"]["mean"] or 0.0, 2),
+                "dispatches": snap["dispatches"],
+                "digest_checks": snap["digest_checks"],
+                "compiles": eng.compile_stats,
+            }
     return {
         "serving_sharded": rows,
         "serving_sharded_wire": eng.wire_dtype,
@@ -1481,7 +1498,8 @@ def bench_serving_sharded(ctx, num_requests: int = 24, num_slots: int = 4,
             else "micro_moe",
             "num_requests": num_requests, "num_slots": num_slots,
             "page_size": page_size, "prefill_chunk": prefill_chunk,
-            "decode_horizon": decode_horizon},
+            "decode_horizon": decode_horizon,
+            "overlap_microbatches": eng.overlap_microbatches},
     }
 
 

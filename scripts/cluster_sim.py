@@ -92,6 +92,17 @@ p.add_argument("--slo", default=None, metavar="SPEC",
                help="per-replica multi-tenant SLO policy (ISSUE 14): "
                     "chat/batch WFQ weights + per-class overrides + "
                     "token-bucket quotas (see serve_sim --slo)")
+p.add_argument("--mesh", default=None, metavar="TPxSPxEP",
+               help="run each colocated replica as a ShardedServingEngine "
+                    "on this TP/SP/EP mesh serving the tiny MoE model "
+                    "(--engine colocated only; implied 1x1x1 by "
+                    "--overlap)")
+p.add_argument("--overlap", choices=("off", "ep", "ep+sp"), default="off",
+               help="fine-grained compute/comm overlap inside each "
+                    "sharded replica (ISSUE 16; --engine colocated only). "
+                    "The single-replica golden reference always runs "
+                    "overlap=off, so the per-request trace verification "
+                    "IS the overlap bit-identity check at cluster scale")
 p.add_argument("--artifact", default=None, metavar="DIR",
                help="persisted AOT artifact (ISSUE 15; --engine colocated "
                     "only — SimEngine has nothing to compile). EVERY "
@@ -104,6 +115,12 @@ if args.prefix_cache and args.engine != "colocated":
     p.error("--prefix-cache needs --engine colocated")
 if args.artifact is not None and args.engine != "colocated":
     p.error("--artifact needs --engine colocated")
+if ((args.overlap != "off" or args.mesh is not None)
+        and args.engine != "colocated"):
+    p.error("--overlap/--mesh need --engine colocated (SimEngine has no "
+            "device programs to overlap)")
+if args.overlap != "off" and args.mesh is None:
+    args.mesh = "1x1x1"
 
 # multi-tenant SLO scheduling (ISSUE 14): both specs fail loudly NAMING
 # the bad field instead of silently replaying a default-shaped trace
@@ -160,30 +177,66 @@ else:
     # of (params, prompt)) makes per-request traces placement-invariant.
     import jax  # noqa: E402
 
-    from triton_dist_tpu.models.llama import LlamaConfig, init_params  # noqa: E402
-    from triton_dist_tpu.serving import ServingEngine  # noqa: E402
+    if args.mesh is not None:
+        # sharded replicas (ISSUE 16): each replica is the MoE
+        # ShardedServingEngine on its own TP/SP/EP mesh, overlap as
+        # requested — while the golden reference below is the SAME
+        # engine pinned to overlap=off, so every verified trace is an
+        # overlap-on-vs-off bit-identity witness
+        tp, sp, ep = (int(d) for d in args.mesh.lower().split("x"))
+        from triton_dist_tpu.utils.env import force_virtual_cpu_devices  # noqa: E402
+        force_virtual_cpu_devices(tp * sp * ep)
+        from triton_dist_tpu.models.moe import (MoEConfig,  # noqa: E402
+                                                init_moe_params)
+        from triton_dist_tpu.serving import (ShardedServingEngine,  # noqa: E402
+                                             serving_mesh)
 
-    cfg = LlamaConfig.tiny(n_layers=2)
-    params = init_params(jax.random.PRNGKey(args.seed), cfg)
-    VOCAB = cfg.vocab_size
+        cfg = MoEConfig.tiny(n_layers=2)
+        params = init_moe_params(jax.random.PRNGKey(args.seed), cfg)
+        VOCAB = cfg.base.vocab_size
 
-    def factory(journal, artifact=None):
-        # EngineReplica passes artifact= on the cold build AND on every
-        # restore, so a failed-over replica reaches its first replayed
-        # token with zero fresh traces too
-        return ServingEngine(params, cfg, num_slots=args.slots,
-                             page_size=args.page_size,
-                             num_pages=args.pages,
+        def factory(journal, artifact=None):
+            return ShardedServingEngine(
+                params, cfg, serving_mesh(tp, sp, ep),
+                num_slots=args.slots, page_size=args.page_size,
+                num_pages=args.pages, pages_per_seq=args.pages_per_seq,
+                prefill_chunk=args.page_size, overlap=args.overlap,
+                journal=journal, checkpoint_every=ckpt_every,
+                prefix_cache=args.prefix_cache, slo=slo_policy,
+                artifact=artifact)
+
+        _ref = ShardedServingEngine(
+            params, cfg, serving_mesh(tp, sp, ep), num_slots=args.slots,
+            page_size=args.page_size, num_pages=args.pages,
+            pages_per_seq=args.pages_per_seq,
+            prefill_chunk=args.page_size, overlap="off")
+    else:
+        from triton_dist_tpu.models.llama import (LlamaConfig,  # noqa: E402
+                                                  init_params)
+        from triton_dist_tpu.serving import ServingEngine  # noqa: E402
+
+        cfg = LlamaConfig.tiny(n_layers=2)
+        params = init_params(jax.random.PRNGKey(args.seed), cfg)
+        VOCAB = cfg.vocab_size
+
+        def factory(journal, artifact=None):
+            # EngineReplica passes artifact= on the cold build AND on
+            # every restore, so a failed-over replica reaches its first
+            # replayed token with zero fresh traces too
+            return ServingEngine(params, cfg, num_slots=args.slots,
+                                 page_size=args.page_size,
+                                 num_pages=args.pages,
+                                 pages_per_seq=args.pages_per_seq,
+                                 prefill_chunk=args.page_size,
+                                 journal=journal,
+                                 checkpoint_every=ckpt_every,
+                                 prefix_cache=args.prefix_cache,
+                                 slo=slo_policy, artifact=artifact)
+
+        _ref = ServingEngine(params, cfg, num_slots=args.slots,
+                             page_size=args.page_size, num_pages=args.pages,
                              pages_per_seq=args.pages_per_seq,
-                             prefill_chunk=args.page_size,
-                             journal=journal, checkpoint_every=ckpt_every,
-                             prefix_cache=args.prefix_cache,
-                             slo=slo_policy, artifact=artifact)
-
-    _ref = ServingEngine(params, cfg, num_slots=args.slots,
-                         page_size=args.page_size, num_pages=args.pages,
-                         pages_per_seq=args.pages_per_seq,
-                         prefill_chunk=args.page_size)
+                             prefill_chunk=args.page_size)
     _ref_cache: dict = {}
 
     def golden(prompt, mnt):
@@ -365,6 +418,29 @@ if args.engine == "colocated":
         "cold_start_to_first_token_s":
             None if _t_first is None else round(_t_first - _t_cold0, 4),
     }}), file=sys.stderr)
+
+if args.mesh is not None:
+    # overlap panel (ISSUE 16): fleet-aggregated per-step EP wire split
+    # under the wire-fit model (serving/sharded.py _comm_split_us) —
+    # modeled, labeled as such: CPU wall clock serializes ranks and can
+    # never show real overlap. overlap=off replicas report all-exposed.
+    _exp = _ovl = 0.0
+    _cnt = 0
+    _mb = None
+    for rep in cluster.replicas:
+        if rep.engine is None:
+            continue
+        _h = rep.engine.metrics.hist
+        _exp += _h["exposed_comm_us"].total
+        _ovl += _h["overlapped_comm_us"].total
+        _cnt += _h["exposed_comm_us"].count
+        _mb = rep.engine.overlap_microbatches
+    print(json.dumps({
+        "overlap": args.overlap, "mesh": args.mesh,
+        "overlap_microbatches": _mb,
+        "exposed_comm_us_mean": round(_exp / max(_cnt, 1), 2),
+        "overlapped_comm_us_mean": round(_ovl / max(_cnt, 1), 2),
+    }), file=sys.stderr)
 
 toks_total = sum(len(t) for t in results.values())
 ttft = cluster.metrics.hist["ttft_s"]

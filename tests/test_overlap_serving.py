@@ -1,0 +1,284 @@
+"""Overlapped serving (ISSUE 16): fine-grained compute/comm overlap in
+the decode/chunk hot loop, held to the SAME bitwise cross-mesh contract
+as tests/test_sharded_serving.py.
+
+THE claim under test: ``overlap="ep"`` (microbatched EP dispatch riding
+the segmented counted-signal a2a, expert FFN overlapping the next
+microbatch's wire) and ``overlap="ep+sp"`` (plus start-local SP pool
+assembly under the allgather) move the SCHEDULE only — every combine is
+still a concat or fixed-order fold — so the 50-request forced-preemption
+trace is BIT-IDENTICAL to the overlap=off n=1 golden at every mesh size,
+decode horizon and chunk size. The fast tier covers n∈{1,2,4}, K∈{1,4}
+and chunk∈{4,8} across its runs; the slow tier fills in the full cross
+product.
+
+Also covered: the one-decode + one-chunk compile-count guard stays
+pinned with overlap on; a PR 7-style chaos schedule (seeded digest skew
+through the restore rung) replays bit-identically with overlap on; the
+``serving_overlap_mb`` tuned key is sigcheck-gated into the PR 15
+registry (and a broken protocol — the seg_dropped_signal gallery kernel
+— is REFUSED admission); the exposed/overlapped comm split lands in the
+metrics.
+
+Wire dtype pinned to fp8, never "auto" (same caveat as the sharded
+suite: auto resolves per rank count, a pinned wire makes every run
+quantize identically).
+"""
+
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import TEST_WORLD  # noqa: F401
+from triton_dist_tpu.models.llama import LlamaConfig
+from triton_dist_tpu.models.moe import MoEConfig, init_moe_params
+from triton_dist_tpu.serving import ShardedServingEngine, serving_mesh
+from triton_dist_tpu.serving.journal import ControlJournal
+from triton_dist_tpu.shmem import FaultPlan
+
+pytestmark = [pytest.mark.mesh, pytest.mark.serving]
+
+WATCHDOG_S = 240
+N_REQUESTS = 50
+MAX_STEPS = 100_000
+WIRE = jnp.float8_e4m3fn  # pinned (NOT "auto") — see module docstring
+
+# exactly one compiled program per path, regardless of overlap mode —
+# overlap must not fork the program cache
+ONE_OF_EACH = {"decode_compiles": 1, "prefill_compiles": 0,
+               "prefill_programs": 0, "prefill_chunk_compiles": 1}
+
+
+@pytest.fixture(autouse=True)
+def mesh_watchdog():
+    """Per-test SIGALRM wall cap (test_sharded_serving.py pattern)."""
+    def boom(signum, frame):
+        raise TimeoutError(
+            f"mesh watchdog: test exceeded {WATCHDOG_S}s wall — "
+            "a mesh collective (or the engine) is hanging")
+    old = signal.signal(signal.SIGALRM, boom)
+    signal.alarm(WATCHDOG_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+@pytest.fixture(scope="module")
+def moe_model():
+    cfg = MoEConfig(base=LlamaConfig(vocab_size=128, d_model=128,
+                                     n_layers=1, n_heads=4, n_kv_heads=2,
+                                     d_ff=128, max_seq_len=128,
+                                     dtype=jnp.float32),
+                    num_experts=4, topk=2, moe_d_ff=64)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _trace(n=N_REQUESTS):
+    """The sharded suite's 50-request bursty trace against a 9-page pool:
+    growth-driven preemption is forced, not incidental."""
+    rng = np.random.RandomState(77)
+    out = []
+    for i in range(n):
+        plen = int(rng.randint(3, 17))
+        mnt = int(rng.randint(2, 6))
+        out.append((i // 2, rng.randint(1, 128, size=plen).tolist(), mnt))
+    return out
+
+
+def _engine(moe_model, tp, sp, ep, **kw):
+    cfg, params = moe_model
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("num_pages", 9)          # tight: forces preemption
+    kw.setdefault("pages_per_seq", 4)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("wire_dtype", WIRE)
+    return ShardedServingEngine(params, cfg, serving_mesh(tp, sp, ep), **kw)
+
+
+def _serve(moe_model, tp, sp, ep, **kw):
+    eng = _engine(moe_model, tp, sp, ep, **kw)
+    tokens = eng.run(max_steps=MAX_STEPS, arrivals=_trace())
+    return {"tokens": tokens, "compiles": eng.compile_stats,
+            "engine": eng, "snap": eng.metrics.snapshot()}
+
+
+@pytest.fixture(scope="module")
+def golden(moe_model):
+    """Lazy per-(K, chunk) overlap=off n=1 goldens — each (horizon,
+    chunk) pair is its own trace, computed once and shared by the fast
+    and slow matrices."""
+    cache = {}
+
+    def get(horizon, chunk):
+        key = (horizon, chunk)
+        if key not in cache:
+            cache[key] = _serve(moe_model, 1, 1, 1, decode_horizon=horizon,
+                                prefill_chunk=chunk)["tokens"]
+        return cache[key]
+
+    return get
+
+
+def _assert_identical(tokens, gold):
+    assert tokens.keys() == gold.keys()
+    bad = [r for r in gold if tokens[r] != gold[r]]
+    assert not bad, f"token streams diverged from n=1 golden: rids {bad}"
+
+
+# -- the bit-identity matrix -------------------------------------------------
+# fast tier: the two cheapest corners (n=1 degenerate + the canonical n=2
+# ep+sp case) keep the quick suite inside the tier-1 time budget; the slow
+# tier completes the n∈{1,2,4} × K∈{1,4} × chunk∈{4,8} × mode cross
+# product (every combo runs the full 50-request forced-preemption trace).
+
+_FAST = [
+    (1, 1, 1, 1, 8, "ep+sp"),
+    (1, 1, 2, 1, 8, "ep+sp"),
+]
+_SLOW = [
+    (1, 1, 1, 4, 4, "ep"),
+    (1, 1, 1, 4, 8, "ep+sp"),
+    (1, 1, 2, 1, 4, "ep+sp"),
+    (1, 1, 2, 4, 4, "ep"),
+    (1, 1, 2, 4, 8, "ep"),
+    (1, 2, 2, 1, 4, "ep"),
+    (1, 2, 2, 1, 8, "ep+sp"),
+    (1, 2, 2, 4, 4, "ep+sp"),
+    (1, 2, 2, 4, 8, "ep+sp"),
+]
+
+
+def _run_matrix_case(moe_model, golden, tp, sp, ep, horizon, chunk, mode):
+    run = _serve(moe_model, tp, sp, ep, decode_horizon=horizon,
+                 prefill_chunk=chunk, overlap=mode)
+    _assert_identical(run["tokens"], golden(horizon, chunk))
+    # compile guard: overlap still compiles exactly ONE decode + ONE
+    # chunk program at this mesh size
+    assert run["compiles"] == ONE_OF_EACH, run["compiles"]
+    assert run["engine"].overlap == mode
+    assert run["engine"].overlap_microbatches == 2   # the tuned default
+
+
+@pytest.mark.parametrize("tp,sp,ep,horizon,chunk,mode", _FAST)
+def test_overlap_bit_identical(moe_model, golden, tp, sp, ep, horizon,
+                               chunk, mode):
+    _run_matrix_case(moe_model, golden, tp, sp, ep, horizon, chunk, mode)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("tp,sp,ep,horizon,chunk,mode", _SLOW)
+def test_overlap_bit_identical_full(moe_model, golden, tp, sp, ep, horizon,
+                                    chunk, mode):
+    _run_matrix_case(moe_model, golden, tp, sp, ep, horizon, chunk, mode)
+
+
+# -- chaos replay with overlap on --------------------------------------------
+
+def test_chaos_digest_skew_replay_with_overlap(moe_model):
+    """A seeded fault schedule (transient digest skew through the PR 9
+    restore rung) replayed with overlap ON: the divergence is absorbed
+    exactly once and the tokens still match the overlap=off run of the
+    SAME schedule — overlap changes nothing the control plane can see."""
+    arrivals = _trace(20)
+
+    def run(overlap):
+        eng = _engine(moe_model, 1, 1, 2, journal=ControlJournal(),
+                      checkpoint_every=4, digest_every=1, overlap=overlap,
+                      fault_plan=FaultPlan(seed=5, digest_skew_at=(9,)))
+        toks = eng.run(max_steps=MAX_STEPS, arrivals=arrivals)
+        return toks, eng.metrics.counters
+
+    toks_off, _ = run("off")
+    toks_on, c = run("ep+sp")
+    assert c["digest_recoveries"] == 1
+    assert c["faults_injected"] >= 1
+    assert toks_on == toks_off
+
+
+# -- tuned-key gate ----------------------------------------------------------
+
+def test_overlap_mb_tuned_key_gated_and_consumed():
+    """The microbatch depth is a sigcheck-gated registry key: a clean
+    config admits (checked=True) and the engine consumes it; admission
+    with a broken protocol runner — the seg_dropped_signal gallery
+    kernel, the overlap wire's own hazard — is REFUSED with the
+    under_signal finding attached."""
+    from triton_dist_tpu.analysis.gallery import GALLERY
+    from triton_dist_tpu.aot.registry import (RegistryAdmissionError,
+                                              TunedConfigRegistry, TunedKey,
+                                              set_default_registry)
+
+    reg = TunedConfigRegistry()
+    key = TunedKey("serving_overlap_mb", mesh_shape=(1, 1, 1),
+                   dtype=str(jnp.dtype(WIRE)))
+    reg.put(key, 4)                       # gate runs 4 seg-a2a rounds
+    assert reg.checked(key)
+
+    with pytest.raises(RegistryAdmissionError) as exc:
+        reg.put(TunedKey("serving_overlap_mb", mesh_shape=(1, 1, 2),
+                         dtype=str(jnp.dtype(WIRE))), 2,
+                run=GALLERY["seg_dropped_signal"].run)
+    assert "under_signal" in exc.value.finding_kinds
+    assert len(reg) == 1                  # the refused config never landed
+    set_default_registry(reg)
+    try:
+        # (num_slots // ep) % 4 == 0 holds at this shape, so the tuned
+        # depth is admissible and must win over the built-in default 2
+        cfg = MoEConfig(base=LlamaConfig(vocab_size=128, d_model=128,
+                                         n_layers=1, n_heads=4,
+                                         n_kv_heads=2, d_ff=128,
+                                         max_seq_len=128,
+                                         dtype=jnp.float32),
+                        num_experts=4, topk=2, moe_d_ff=64)
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        eng = ShardedServingEngine(params, cfg, serving_mesh(1, 1, 1),
+                                   num_slots=4, page_size=8, num_pages=9,
+                                   pages_per_seq=4, prefill_chunk=8,
+                                   wire_dtype=WIRE, overlap="ep")
+        assert eng.overlap_microbatches == 4
+    finally:
+        set_default_registry(None)
+
+
+def test_overlap_mb_explicit_overrides_registry(moe_model):
+    eng = _engine(moe_model, 1, 1, 1, overlap="ep", overlap_microbatches=1)
+    assert eng.overlap_microbatches == 1
+
+
+def test_overlap_rejects_indivisible_microbatch(moe_model):
+    with pytest.raises(AssertionError, match="microbatch"):
+        _engine(moe_model, 1, 1, 1, overlap="ep", overlap_microbatches=3)
+
+
+def test_overlap_rejects_unknown_mode(moe_model):
+    with pytest.raises(AssertionError, match="overlap"):
+        _engine(moe_model, 1, 1, 1, overlap="sp")
+
+
+# -- exposed/overlapped comm split -------------------------------------------
+
+def test_comm_split_metrics(moe_model):
+    """The modeled wire split (serving/metrics.py ISSUE 16 hists):
+    overlap=off exposes everything, overlap=on hides a strictly positive
+    share at n>1, and n=1 (no wire) observes zeros on both."""
+    def split(tp, sp, ep, overlap):
+        eng = _engine(moe_model, tp, sp, ep, overlap=overlap)
+        eng.run(max_steps=MAX_STEPS, arrivals=_trace(6))
+        s = eng.metrics.snapshot()
+        return (s["exposed_comm_us"]["mean"],
+                s["overlapped_comm_us"]["mean"])
+
+    exp_off, ovl_off = split(1, 1, 2, "off")
+    assert exp_off > 0 and ovl_off == 0
+    exp_on, ovl_on = split(1, 1, 2, "ep")
+    assert 0 < exp_on < exp_off
+    assert ovl_on > 0
+    exp1, ovl1 = split(1, 1, 1, "ep+sp")
+    assert exp1 == 0 and ovl1 == 0

@@ -74,6 +74,27 @@ def _dropped_signal_kernel(axis, mesh_axes, in_ref, out_ref, flag):
     out_ref[...] = in_ref[...]
 
 
+def _seg_dropped_signal_kernel(axis, mesh_axes, in_ref, out_ref, flag):
+    """Microbatch-segmented announcement protocol (the ISSUE 16 overlap
+    wire: one counted signal per (peer, segment), consumer gates on the
+    aggregate per-segment count) whose producer FORGETS the last
+    microbatch's segment signal — the waits budget 2 segments per peer but
+    only segment 0 is ever announced, so the per-segment gate starves
+    (static under-signal)."""
+    from ..shmem import device as shd
+    me = shd.my_pe(axis)
+    n = shd.n_pes(axis)
+    segments = 2
+    for p in range(1, n):
+        pid = shd.pe_at(mesh_axes, axis, lax.rem(me + p, n))
+        # BUG: announces segment 0 only — segment 1 (the second
+        # microbatch) is never signalled to any peer
+        for s in range(segments - 1):
+            shd.signal_op(flag, 1, pid)
+    shd.signal_wait_until(flag, segments * (n - 1))
+    out_ref[...] = in_ref[...]
+
+
 def _over_signal_kernel(axis, mesh_axes, in_ref, out_ref, flag):
     """Arrival counter whose producers double-signal: the wait consumes n-1
     but 2(n-1) arrive — the residue poisons the next call on this scratch
@@ -219,6 +240,9 @@ _ENTRIES = [
     GalleryEntry("dropped_signal", UNDER_SIGNAL,
                  run=lambda ctx: _flag_call(ctx, _dropped_signal_kernel,
                                             "dropped_signal")),
+    GalleryEntry("seg_dropped_signal", UNDER_SIGNAL,
+                 run=lambda ctx: _flag_call(ctx, _seg_dropped_signal_kernel,
+                                            "seg_dropped_signal")),
     GalleryEntry("over_signal", OVER_SIGNAL,
                  run=lambda ctx: _flag_call(ctx, _over_signal_kernel,
                                             "over_signal")),

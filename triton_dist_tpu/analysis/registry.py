@@ -104,6 +104,15 @@ def _run_all_to_all_push(ctx):
     all_to_all_push(ctx, jnp.zeros((n * n, 8, 128), f32), axis="x")
 
 
+def _run_all_to_all_push_seg(ctx):
+    from ..ops import all_to_all_push_seg
+    n = ctx.num_ranks
+    # 16 f32 rows split into two 8-row sublane-aligned segments — a real
+    # two-segment counted-signal schedule, not the degenerate "full" path
+    all_to_all_push_seg(ctx, jnp.zeros((n * n, 16, 128), f32), axis="x",
+                        segments=2)
+
+
 # -- GEMM overlaps -----------------------------------------------------------
 
 def _gemm_cfg():
@@ -301,6 +310,13 @@ def _run_sp_paged_attend_write(ctx):
                           jnp.array([4], i32), axis="x")
 
 
+def _run_pool_ag_start_local(ctx):
+    from ..ops import pool_ag_start_local
+    n = ctx.num_ranks
+    pages = jnp.zeros((4 * n, 2, 8, 128), f32)
+    pool_ag_start_local(ctx, pages, pages, axis="x")
+
+
 # -- grouped GEMM / MoE ------------------------------------------------------
 
 def _gg_grouped_gemm():
@@ -413,6 +429,8 @@ _ENTRIES = [
     RegistryEntry("migrate_pages", _run_migrate_pages, meshes=MESH_PAIR),
     # EP all-to-all
     RegistryEntry("all_to_all_push", _run_all_to_all_push),
+    # segmented counted-signal wire (ISSUE 16 overlap schedule)
+    RegistryEntry("all_to_all_push_seg", _run_all_to_all_push_seg),
     RegistryEntry("create_all_to_all_context", _run_ep_dispatch_combine),
     RegistryEntry("dispatch", _run_ep_dispatch_combine),
     RegistryEntry("combine", _run_ep_dispatch_combine),
@@ -441,6 +459,8 @@ _ENTRIES = [
     RegistryEntry("ll_ag_merge", _run_ll_ag_merge),
     RegistryEntry("sp_gqa_flash_decode", _run_sp_gqa_flash_decode),
     RegistryEntry("sp_paged_attend_write", _run_sp_paged_attend_write),
+    # start-local signal-gated pool allgather (ISSUE 16 SP overlap)
+    RegistryEntry("pool_ag_start_local", _run_pool_ag_start_local),
     # grouped GEMM
     RegistryEntry("grouped_gemm", _local(_gg_grouped_gemm),
                   meshes=MESH_LOCAL),

@@ -213,12 +213,30 @@ def _gate_ring_attention(bqbk) -> Callable:
     return run
 
 
+def _gate_overlap_microbatch(m) -> Callable:
+    # the serving-overlap microbatch depth (ISSUE 16): the tuned value is
+    # how many segmented a2a rounds the hot loop issues back to back, so
+    # the gate replays exactly that many all_to_all_push_seg calls — the
+    # counted per-segment signal protocol must stay balanced ACROSS rounds
+    # (a leaked segment signal from round i poisons round i+1's gate)
+    def run(ctx):
+        import jax.numpy as jnp
+        from triton_dist_tpu.ops import all_to_all_push_seg
+        n = ctx.num_ranks
+        for _ in range(max(1, int(m))):
+            all_to_all_push_seg(ctx, jnp.zeros((n * n, 16, 128),
+                                               jnp.float32),
+                                axis="x", segments=2)
+    return run
+
+
 GATE_RUNNERS: Dict[str, Callable[[Any], Callable]] = {
     "ag_gemm": _gate_ag_gemm,
     "gemm_rs": _gate_gemm_rs,
     "ag_moe_group_gemm": _gate_ag_moe_group_gemm,
     "moe_reduce_rs": _gate_moe_reduce_rs,
     "ring_attention": _gate_ring_attention,
+    "serving_overlap_mb": _gate_overlap_microbatch,
 }
 
 

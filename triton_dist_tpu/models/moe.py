@@ -207,7 +207,8 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
                        axis: str | None = None, block_m: int = 128,
                        block_n: int = 128, block_k: int | None = None,
                        down_block_n: int | None = None,
-                       we_gate_up_packed: jax.Array | None = None
+                       we_gate_up_packed: jax.Array | None = None,
+                       microbatches: int = 1
                        ) -> jax.Array:
     """The reference's EP MoE inference block (test_ep_moe_inference.py /
     tutorial 04) on the Pallas kernel stack: router → low-latency A2A
@@ -221,6 +222,20 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
     With a 2-tier layer (``EPAll2AllLayer.create(axis=(major, minor))``)
     the dispatch/combine run the hierarchical path and ``axis`` is taken
     from the layer; ``x2d`` is P((major, minor))-sharded.
+
+    ``microbatches=M > 1`` runs ISSUE 16's double-buffered schedule: the
+    router still scores the FULL batch (identical math), then the per-rank
+    token rows are split into M contiguous row blocks, each dispatched
+    through an M-times-smaller (still drop-proof) a2a context, with block
+    i+1's dispatch issued BEFORE block i's expert FFN — the grouped FFN on
+    microbatch i overlaps the a2a of microbatch i+1 (gated per-segment by
+    the counted-signal wire when the layer sets ``seg_push``). The output
+    is the FIXED-ORDER per-rank concatenation of the block outputs; since
+    every per-row quantity (routing decision, gather, quant round-trip,
+    expert FFN row, fixed k-order combine fold) is bitwise invariant to
+    which rows share its batch, the result is BITWISE identical to
+    ``microbatches=1`` — the schedule overlaps, the reduction order never
+    moves.
     """
     from triton_dist_tpu.ops.all_to_all import QuantTokens
     from triton_dist_tpu.ops.group_gemm import (PackedGatedWeights,
@@ -263,8 +278,51 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
     gate_vals, gate_ids = lax.top_k(jax.nn.softmax(logits, -1), k)
     gate_vals = (gate_vals / jnp.sum(gate_vals, -1, keepdims=True))
 
-    recv_tok, recv_ids, layout = a2a_layer.dispatch(x2d, gate_ids)
-    quant = isinstance(recv_tok, QuantTokens)
+    mbs = int(microbatches)
+    if mbs > 1:
+        import dataclasses as _dc
+        from triton_dist_tpu.ops.all_to_all import _cap_round
+        assert not is_2d, "microbatched overlap is a 1d-EP schedule"
+        assert not expert_major, (
+            "microbatched overlap needs the rank-major layout: the per-"
+            "expert budget of an expert-major context is not drop-proof "
+            "per microbatch, so drops could differ from the unsplit path")
+        T = a2a.max_tokens
+        assert T % mbs == 0, (
+            f"per-rank rows {T} not divisible by microbatches={mbs}")
+        itemsize = jnp.dtype(a2a.wire_dtype or a2a.dtype).itemsize
+        assert a2a.capacity >= _cap_round(T * k, itemsize), (
+            "microbatched overlap requires a drop-proof capacity "
+            f"(>= {T}*{k} rounded) — a tuned sub-worst-case capacity "
+            "drops per-microbatch routing spill differently from the "
+            "unsplit dispatch and breaks bit-identity")
+        mbT = T // mbs
+        # the microbatch context: same wire dtype / edges / seg_push, an
+        # M-times-smaller (still drop-proof) slot budget. Reusing the FULL
+        # layer's resolved wire_dtype is what keeps a "auto" wire decision
+        # independent of M (it was resolved at the full dispatch size).
+        mb_a2a = _dc.replace(a2a, max_tokens=mbT,
+                             capacity=_cap_round(mbT * k, itemsize))
+        mb_layer = _dc.replace(a2a_layer, a2a=mb_a2a)
+
+        def _mb_part(i):
+            def f(x, gv, gi):
+                s = lambda a: lax.dynamic_slice_in_dim(a, i * mbT, mbT, 0)
+                return s(x), s(gv), s(gi)
+            return ctx.shard_map(f, in_specs=(shard_spec,) * 3,
+                                 out_specs=(shard_spec,) * 3)(
+                x2d, gate_vals, gate_ids)
+
+        parts = [_mb_part(i) for i in range(mbs)]
+    else:
+        mb_layer = a2a_layer
+        parts = [(x2d, gate_vals, gate_ids)]
+
+    # software pipeline prologue: microbatch 0's a2a is in flight before
+    # any expert FFN is traced (at mbs == 1 this is exactly the original
+    # dispatch call)
+    disp = [mb_layer.dispatch(parts[0][0], parts[0][2])]
+    quant = isinstance(disp[0][0], QuantTokens)
 
     n = ctx.axis_size(group)
 
@@ -359,10 +417,25 @@ def moe_mlp_ep_overlap(ctx: ShmemContext, a2a_layer, x2d: jax.Array,
     wgu = we_gate_up_packed if packed else we_gate
     wup = (jnp.zeros((a2a.num_experts, 1, 1), we_gate.dtype) if packed
            else we_up)
-    args = ((recv_tok.q, recv_ids, wgu, wup, we_down, recv_tok.scale)
-            if quant else (recv_tok, recv_ids, wgu, wup, we_down))
-    processed = sm(*args)
-    return a2a_layer.combine(processed, layout, gate_vals)
+
+    outs = []
+    for i in range(len(parts)):
+        if i + 1 < len(parts):
+            # issue microbatch i+1's dispatch BEFORE microbatch i's FFN:
+            # the grouped GEMMs below overlap the next block's wire time
+            disp.append(mb_layer.dispatch(parts[i + 1][0], parts[i + 1][2]))
+        recv_tok, recv_ids, layout = disp[i]
+        args = ((recv_tok.q, recv_ids, wgu, wup, we_down, recv_tok.scale)
+                if quant else (recv_tok, recv_ids, wgu, wup, we_down))
+        processed = sm(*args)
+        outs.append(mb_layer.combine(processed, layout, parts[i][1]))
+    if len(outs) == 1:
+        return outs[0]
+    # fixed-order per-rank concatenation restores the original row order —
+    # a concat, never a reduction, so the bitwise contract holds
+    return ctx.shard_map(lambda *os: jnp.concatenate(os, axis=0),
+                         in_specs=(shard_spec,) * len(outs),
+                         out_specs=shard_spec)(*outs)
 
 
 def moe_mlp_tp_overlap(ctx: ShmemContext, x2d: jax.Array,

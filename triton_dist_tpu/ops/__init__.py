@@ -25,13 +25,15 @@ from triton_dist_tpu.ops.ring_attention import (  # noqa: F401
     ring_attention, ring_attention_fwd, ring_attention_bwd, zigzag_indices)
 from triton_dist_tpu.ops.page_migrate import migrate_pages  # noqa: F401
 from triton_dist_tpu.ops.all_to_all import (  # noqa: F401
-    EpAllToAllContext, Ep2dAllToAllContext, all_to_all_push, a2a_wire_bytes,
+    EpAllToAllContext, Ep2dAllToAllContext, all_to_all_push,
+    all_to_all_push_seg, a2a_wire_bytes,
     pick_wire_dtype, create_all_to_all_context, create_all_to_all_context_2d,
     route_tokens, route_tokens_2d, dispatch, dispatch_2d, combine, combine_2d,
     expected_capacity)
 from triton_dist_tpu.ops.flash_decode import (  # noqa: F401
     gqa_decode_partial, gqa_decode_paged, paged_kv_write, decode_combine,
-    ll_ag_merge, sp_gqa_flash_decode, sp_paged_attend_write)
+    ll_ag_merge, sp_gqa_flash_decode, sp_paged_attend_write,
+    pool_ag_start_local)
 from triton_dist_tpu.ops.group_gemm import (  # noqa: F401
     PackedGatedWeights, align_tokens_by_expert, used_block_count,
     emit_grouped_gemm, grouped_gemm, pack_gated_weights, grouped_gemm_gated,

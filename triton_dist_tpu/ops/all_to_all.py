@@ -381,6 +381,197 @@ def all_to_all_push(ctx: ShmemContext, *arrays: jax.Array,
     return sm(*arrays)
 
 
+def _seg_chunks(shape: tuple, segments: int, itemsize: int):
+    """Static per-segment ``(row_offset, rows)`` split of one wire array's
+    slot rows (dim 1 of the local ``[n, rows, ...]`` view), each boundary
+    rounded DOWN to the dtype's sublane tile so every chunk's DMA slice
+    meets Mosaic's tiling alignment (same 8/16/32-row tiles as
+    ``_cap_round``). Arrays too small (or too low-rank) to split ride whole
+    in segment 0 — the ``"full"`` sentinel — so side-channels like the id
+    wire gate on the first segment's signal. Degenerate chunks are ``None``
+    (no put, no wait)."""
+    if len(shape) < 3:
+        return ("full",) + (None,) * (segments - 1)
+    rows = shape[1]
+    align = max(1, 32 // max(1, itemsize))
+    bounds = [0]
+    for s in range(1, segments):
+        b = (rows * s // segments) // align * align
+        bounds.append(max(bounds[-1], min(b, rows)))
+    bounds.append(rows)
+    if bounds[1] == 0 and segments > 1:
+        # alignment swallowed the split: don't degrade to an all-in-the-
+        # LAST-segment schedule — ship whole under segment 0 instead
+        return ("full",) + (None,) * (segments - 1)
+    return tuple(
+        (bounds[s], bounds[s + 1] - bounds[s])
+        if bounds[s + 1] > bounds[s] else None
+        for s in range(segments))
+
+
+def _seg_view(ref, idx, chunk):
+    """The ref slice one segment chunk addresses: the whole peer slot for
+    the ``"full"`` sentinel, a static-size row window otherwise."""
+    if chunk == "full":
+        return ref.at[idx]
+    off, rows = chunk
+    return ref.at[idx, pl.ds(off, rows)]
+
+
+def _a2a_seg_kernel(axis, mesh_axes, n_arrays, chunks, refs):
+    """Segmented counted-signal variant of ``_a2a_kernel`` (plain wire
+    arrays only — the quant/dequant edges run as XLA passes outside).
+
+    ``chunks[a]`` is the static per-segment row split of array ``a``
+    (``_seg_chunks``). The producer issues the puts of one (peer, segment)
+    pair and then ANNOUNCES the segment with one counted
+    ``shd.signal_op(+1)`` on the peer's per-segment REGULAR semaphore —
+    ``ops/page_migrate.py``'s counted-signal protocol. The consumer gates on
+    ``shd.signal_wait_until(seg_sems[s], n-1)`` per segment in FIXED order
+    and only then drains that segment's receive DMA semaphores — so a
+    caller interleaving compute between segment waits overlaps segment
+    s+1's flight time with segment s's compute while consuming arrivals in
+    a rank-independent order. Every byte lands in the same slot as the
+    unsegmented kernel: outputs are bitwise identical, only the schedule is
+    finer."""
+    segments = len(chunks[0])
+    ins = refs[:n_arrays]
+    outs = refs[n_arrays:2 * n_arrays]
+    send_sems = refs[2 * n_arrays]
+    recv_sems = refs[2 * n_arrays + 1]
+    seg_sems = refs[2 * n_arrays + 2:]
+    me = shd.my_pe(axis)
+    n = shd.n_pes(axis)
+
+    shd.barrier_all((axis,), mesh_axes=mesh_axes)
+
+    local_copies = []
+    for a in range(n_arrays):
+        c = pltpu.make_async_copy(ins[a].at[me], outs[a].at[me],
+                                  recv_sems.at[a, me, 0])
+        c.start()
+        local_copies.append(c)
+    rdmas = []
+    for p in range(1, n):
+        dst = lax.rem(me + p, n)
+        pid = shd.pe_at(mesh_axes, axis, dst)
+        for s in range(segments):
+            for a in range(n_arrays):
+                if chunks[a][s] is None:
+                    continue
+                rdmas.append(shd.putmem_nbi(
+                    _seg_view(outs[a], me, chunks[a][s]),
+                    _seg_view(ins[a], dst, chunks[a][s]),
+                    send_sems.at[a, dst, s],
+                    recv_sems.at[a, me, s], pid))
+            # announce segment s the moment its puts are in flight —
+            # the peer's gate for starting compute on s while s+1 flies
+            shd.signal_op(seg_sems[s], 1, pe=pid)
+    for c in local_copies:
+        c.wait()
+    if n > 1:
+        for s in range(segments):
+            shd.signal_wait_until(seg_sems[s], n - 1)
+            for p in range(1, n):
+                src = lax.rem(me + p, n)
+                for a in range(n_arrays):
+                    if chunks[a][s] is None:
+                        continue
+                    shd.wait_recv(_seg_view(outs[a], src, chunks[a][s]),
+                                  recv_sems.at[a, src, s])
+    shd.quiet(*rdmas)
+
+
+def all_to_all_push_seg(ctx: ShmemContext, *arrays: jax.Array,
+                        axis: str | None = None,
+                        spec: P | None = None,
+                        segments: int = 2,
+                        dequant_to=None,
+                        fuse_dequant: bool = False,
+                        quant_from=None,
+                        fuse_quant: bool = False) -> tuple[jax.Array, ...]:
+    """Segmented counted-signal variant of ``all_to_all_push`` — the wire
+    collective behind the serving overlap schedule (ISSUE 16). Each
+    (peer, array) payload is split row-wise into ``segments`` static
+    chunks; the producer announces every segment with one counted
+    ``signal_op`` after its puts are issued and the consumer drains
+    segments in fixed order behind per-segment ``signal_wait_until`` gates
+    (``ops/page_migrate.py``'s protocol). The same bytes land in the same
+    slots as the plain push — outputs are BITWISE identical; only the
+    delivery schedule is finer, which is what lets the microbatched EP
+    pipeline overlap expert compute with the next microbatch's flight.
+
+    ``fuse_dequant`` / ``fuse_quant`` are accepted for call-site parity
+    with ``all_to_all_push`` and ignored: the segmented wire always takes
+    the UNFUSED quant/dequant edges (one XLA pass outside the collective),
+    whose rows are bit-identical to the fused in-kernel pipelines by
+    construction (same f32 amax/divide chain — see ``_quant_slot_pipeline``).
+    DCN tiers and the CPU simulator fall back to ``all_to_all_push``'s XLA
+    exchange — identical slot semantics, identical bytes."""
+    del fuse_dequant, fuse_quant
+    axis = axis or ctx.axis_names[0]
+    segments = max(1, int(segments))
+    spec = spec if spec is not None else P(axis)
+    if quant_from is not None:
+        # always the send-edge XLA quantize pass (bit-identical rows to the
+        # fused path), then the plain quantized-wire segmented push
+        wire_q = jnp.dtype(quant_from)
+        cap_q, H_q = arrays[0].shape[-2:]
+        cols = _id_cols(cap_q)
+
+        def _qpack(x):
+            nl = x.shape[0]
+            q, s = _quant(x.reshape(nl * cap_q, H_q), wire_q)
+            sc = jnp.ones((nl, cols), jnp.float32).at[:, :cap_q].set(
+                s.reshape(nl, cap_q))
+            return q.reshape(x.shape), sc.reshape(nl, -1, 128)
+
+        pq, psc = ctx.shard_map(_qpack, in_specs=spec,
+                                out_specs=(spec, spec))(arrays[0])
+        return all_to_all_push_seg(ctx, pq, *arrays[1:], psc, axis=axis,
+                                   spec=spec, segments=segments,
+                                   dequant_to=dequant_to)
+    if _xla_wire(ctx, axis):
+        return all_to_all_push(ctx, *arrays, axis=axis, spec=spec,
+                               dequant_to=dequant_to, fuse_dequant=False)
+    n = ctx.axis_size(axis)
+    mesh_axes = ctx.axis_names
+    n_arrays = len(arrays)
+    cap = arrays[0].shape[-2] if dequant_to is not None else None
+
+    def f(*shards):
+        chunks = tuple(
+            _seg_chunks(s.shape, segments, jnp.dtype(s.dtype).itemsize)
+            for s in shards)
+        n_segs = len(chunks[0])
+        kernel = lambda *refs: _a2a_seg_kernel(axis, mesh_axes, n_arrays,
+                                               chunks, refs)
+        out = pl.pallas_call(
+            kernel,
+            out_shape=tuple(jax.ShapeDtypeStruct(s.shape, s.dtype)
+                            for s in shards),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * n_arrays,
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * n_arrays,
+            scratch_shapes=(
+                [pltpu.SemaphoreType.DMA((n_arrays, n, n_segs)),
+                 pltpu.SemaphoreType.DMA((n_arrays, n, n_segs))]
+                + [pltpu.SemaphoreType.REGULAR] * n_segs),
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=collective_id_for(f"all_to_all_seg_{axis}")),
+            interpret=default_interpret(),
+        )(*shards)
+        return out if isinstance(out, tuple) else (out,)
+
+    sm = ctx.shard_map(f, in_specs=tuple(spec for _ in arrays),
+                       out_specs=tuple(spec for _ in arrays))
+    out = sm(*arrays)
+    if dequant_to is not None:
+        scale = out[-1].reshape(out[-1].shape[0], -1)[:, :cap]
+        return (_dequant(out[0], scale, dequant_to),) + out[1:]
+    return out
+
+
 # ---------------------------------------------------------------------------
 # MoE EP dispatch / combine
 # ---------------------------------------------------------------------------
@@ -463,6 +654,11 @@ class EpAllToAllContext:
     quant_edge: str = "fused"     # "fused" | "pre" | "kernel"
     dequant_edge: str = "post"    # "post" | "kernel"
     expert_major: bool = False
+    # >= 2: the wire collective runs as ``all_to_all_push_seg`` with this
+    # many per-peer segments — the counted-signal schedule the serving
+    # overlap path rides (ISSUE 16). Same bytes, same slots, bit-identical
+    # outputs; 0/1 keeps the plain one-put-per-(peer, array) push.
+    seg_push: int = 0
 
     def _dequant_in_kernel(self) -> bool:
         return self.dequant_edge == "kernel"
@@ -540,7 +736,8 @@ def create_all_to_all_context(ctx: ShmemContext, max_tokens: int, hidden: int,
                               wire_fit: dict | None = None,
                               quant_edge: str = "fused",
                               dequant_edge: str = "post",
-                              expert_major: bool = False
+                              expert_major: bool = False,
+                              seg_push: int = 0
                               ) -> EpAllToAllContext:
     axis = axis or ctx.axis_names[0]
     n = ctx.axis_size(axis)
@@ -570,7 +767,8 @@ def create_all_to_all_context(ctx: ShmemContext, max_tokens: int, hidden: int,
                                          if wire_dtype is not None else None),
                              quant_edge=quant_edge,
                              dequant_edge=dequant_edge,
-                             expert_major=expert_major)
+                             expert_major=expert_major,
+                             seg_push=int(seg_push))
 
 
 def route_tokens(a2a: EpAllToAllContext, topk_ids: jax.Array):
@@ -620,6 +818,17 @@ def route_tokens(a2a: EpAllToAllContext, topk_ids: jax.Array):
         return dest, slot.reshape(T, k), valid.reshape(T, k)
     slot, valid = _slot_assign(dest.reshape(-1), a2a.n_ranks, a2a.capacity)
     return dest, slot.reshape(T, k), valid.reshape(T, k)
+
+
+def _a2a_push_fn(a2a):
+    """The wire collective for this context: the plain one-put-per-(peer,
+    array) push, or — ``seg_push >= 2`` — the segmented counted-signal push
+    the serving overlap schedule rides. Bit-identical outputs either way
+    (same bytes, same slots); only the delivery schedule differs."""
+    if getattr(a2a, "seg_push", 0) >= 2:
+        import functools
+        return functools.partial(all_to_all_push_seg, segments=a2a.seg_push)
+    return all_to_all_push
 
 
 def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
@@ -682,14 +891,15 @@ def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
         send_buf, send_ids, send_sc, dest, slot, valid = sm(tokens, topk_ids)
     else:
         send_buf, send_ids, dest, slot, valid = sm(tokens, topk_ids)
+    push = _a2a_push_fn(a2a)
     if wire is not None and a2a.dequant_edge == "expert":
         # no dequantization anywhere: tokens stay in the wire dtype and the
         # scales ride alongside for the expert GEMM's accumulator
         if kq:
-            recv_q, recv_ids_wire, recv_sc = all_to_all_push(
+            recv_q, recv_ids_wire, recv_sc = push(
                 ctx, send_buf, send_ids, axis=axis, quant_from=wire)
         else:
-            recv_q, recv_ids_wire, recv_sc = all_to_all_push(
+            recv_q, recv_ids_wire, recv_sc = push(
                 ctx, send_buf, send_ids, send_sc, axis=axis)
         unpack_sc = ctx.shard_map(
             lambda w: w.reshape(n, -1)[:, :cap],
@@ -700,16 +910,16 @@ def dispatch(a2a: EpAllToAllContext, tokens: jax.Array, topk_ids: jax.Array):
         # policy: one post-kernel XLA pass (default) or per-arrival
         # in-kernel (multi-chip experiment: overlaps later peers' waits)
         if kq:
-            recv_tokens, recv_ids_wire, _ = all_to_all_push(
+            recv_tokens, recv_ids_wire, _ = push(
                 ctx, send_buf, send_ids, axis=axis, quant_from=wire,
                 dequant_to=a2a.dtype, fuse_dequant=a2a._dequant_in_kernel())
         else:
-            recv_tokens, recv_ids_wire, _ = all_to_all_push(
+            recv_tokens, recv_ids_wire, _ = push(
                 ctx, send_buf, send_ids, send_sc, axis=axis,
                 dequant_to=a2a.dtype, fuse_dequant=a2a._dequant_in_kernel())
     else:
-        recv_tokens, recv_ids_wire = all_to_all_push(ctx, send_buf, send_ids,
-                                                     axis=axis)
+        recv_tokens, recv_ids_wire = push(ctx, send_buf, send_ids,
+                                          axis=axis)
     unpack = ctx.shard_map(
         lambda w: w.reshape(n, id_cols)[:, :cap],
         in_specs=P(axis), out_specs=P(axis))
@@ -728,6 +938,7 @@ def combine(a2a: EpAllToAllContext, processed: jax.Array, layout,
     ctx, axis = a2a.ctx, a2a.axis
     n, cap, H, k = a2a.n_ranks, a2a.capacity, a2a.hidden, a2a.topk
     wire = a2a.wire_dtype
+    push = _a2a_push_fn(a2a)
     if wire is not None:
         # quantize the return trip too (reference sends fp8 both ways) —
         # INSIDE the collective, per departure slot (all_to_all_push's
@@ -735,16 +946,16 @@ def combine(a2a: EpAllToAllContext, processed: jax.Array, layout,
         if a2a.dequant_edge == "expert":
             # no full-buffer dequant: the scale is gathered with the token
             # in the combine epilogue and folded into the f32 weighted sum
-            back, back_sc = all_to_all_push(ctx, processed, axis=axis,
-                                            quant_from=wire)
+            back, back_sc = push(ctx, processed, axis=axis,
+                                 quant_from=wire)
         else:
-            back, _ = all_to_all_push(ctx, processed, axis=axis,
-                                      quant_from=wire,
-                                      dequant_to=a2a.dtype,
-                                      fuse_dequant=a2a._dequant_in_kernel())
+            back, _ = push(ctx, processed, axis=axis,
+                           quant_from=wire,
+                           dequant_to=a2a.dtype,
+                           fuse_dequant=a2a._dequant_in_kernel())
             back_sc = None
     else:
-        (back,) = all_to_all_push(ctx, processed, axis=axis)
+        (back,) = push(ctx, processed, axis=axis)
         back_sc = None
 
     def gather_back(back_shard, dest, slot, valid, w, *sc):
@@ -1238,7 +1449,8 @@ def combine_2d(a2a: Ep2dAllToAllContext, processed: jax.Array, layouts,
         back1, a_dst, slot1, ok1, topk_weights, *b1sc)
 
 
-__all__ = ["all_to_all_push", "EpAllToAllContext", "create_all_to_all_context",
-           "route_tokens", "dispatch", "combine", "Ep2dAllToAllContext",
-           "create_all_to_all_context_2d", "route_tokens_2d", "dispatch_2d",
-           "combine_2d", "a2a_wire_bytes", "pick_wire_dtype"]
+__all__ = ["all_to_all_push", "all_to_all_push_seg", "EpAllToAllContext",
+           "create_all_to_all_context", "route_tokens", "dispatch", "combine",
+           "Ep2dAllToAllContext", "create_all_to_all_context_2d",
+           "route_tokens_2d", "dispatch_2d", "combine_2d", "a2a_wire_bytes",
+           "pick_wire_dtype"]

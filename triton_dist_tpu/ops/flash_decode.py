@@ -499,12 +499,114 @@ def sp_gqa_flash_decode(ctx: ShmemContext, q: jax.Array, k_cache: jax.Array,
     return smc(g)
 
 
+def _pool_ag_kernel(axis, mesh_axes, k_ref, v_ref, kf_ref, vf_ref,
+                    send_sems, recv_sems, sig):
+    """Signal-gated start-local pool allgather (the SP half of the ISSUE 16
+    overlap schedule — the reference ``allgather_gemm.py`` tile-swizzle
+    "start local" idiom, restricted to the transport).
+
+    The rank's OWN pool slice is copied into its canonical slot of the full
+    pool FIRST, with no gate — it is ready while every remote shard is
+    still in flight, so the consumer's paged-attention walk can begin
+    issuing its earliest (local-page) reads immediately after this kernel.
+    Remote shards are put to each peer's canonical slot and announced with
+    one counted ``signal_op`` (``ops/page_migrate.py``'s protocol); the
+    consumer gates on the aggregate count and drains arrivals in FIXED
+    rank order. The assembled pool is a pure page-order concatenation —
+    bitwise identical to ``lax.all_gather(tiled=True)`` — so the attention
+    walk that follows keeps its single-device reduction order untouched.
+    Overlap moves the SCHEDULE (local slice never waits on the wire),
+    never the reduction order."""
+    me = shd.my_pe(axis)
+    n = shd.n_pes(axis)
+    p_local = k_ref.shape[0]
+    shd.barrier_all((axis,), mesh_axes=mesh_axes)
+    # start local: own slice lands while the remote puts are in flight
+    lk = pltpu.make_async_copy(
+        k_ref, kf_ref.at[pl.ds(me * p_local, p_local)], recv_sems.at[0, me])
+    lv = pltpu.make_async_copy(
+        v_ref, vf_ref.at[pl.ds(me * p_local, p_local)], recv_sems.at[1, me])
+    lk.start()
+    lv.start()
+    rdmas = []
+    for p in range(1, n):
+        dst = lax.rem(me + p, n)
+        pid = shd.pe_at(mesh_axes, axis, dst)
+        rdmas.append(shd.putmem_nbi(
+            kf_ref.at[pl.ds(me * p_local, p_local)], k_ref,
+            send_sems.at[0, dst], recv_sems.at[0, me], pid))
+        rdmas.append(shd.putmem_nbi(
+            vf_ref.at[pl.ds(me * p_local, p_local)], v_ref,
+            send_sems.at[1, dst], recv_sems.at[1, me], pid))
+        # announce my shard to the peer the moment its puts are in flight
+        shd.signal_op(sig, 1, pe=pid)
+    lk.wait()
+    lv.wait()
+    if n > 1:
+        shd.signal_wait_until(sig, n - 1)
+        for p in range(1, n):
+            src = lax.rem(me + p, n)
+            shd.wait_recv(kf_ref.at[pl.ds(src * p_local, p_local)],
+                          recv_sems.at[0, src])
+            shd.wait_recv(vf_ref.at[pl.ds(src * p_local, p_local)],
+                          recv_sems.at[1, src])
+    shd.quiet(*rdmas)
+
+
+def pool_ag_start_local(ctx: ShmemContext, k_pages: jax.Array,
+                        v_pages: jax.Array, axis: str = "sp"):
+    """Host wrapper for the start-local pool allgather: global pools
+    [P, Hkv, page_size, D] sharded P(axis) on the page dim in; FULL pools
+    (replicated) out, assembled in canonical page order — bitwise identical
+    to the tiled ``lax.all_gather`` concatenation the non-overlapped SP
+    path uses (the DCN/CPU fallback IS that all_gather). One kernel moves
+    both pools so K and V ride the wire together."""
+    from triton_dist_tpu.ops.all_to_all import _xla_wire
+    n = ctx.axis_size(axis)
+    if n == 1:
+        return k_pages, v_pages
+    mesh_axes = ctx.axis_names
+
+    if _xla_wire(ctx, axis):
+        def f(kp_l, vp_l):
+            return (lax.all_gather(kp_l, axis, axis=0, tiled=True),
+                    lax.all_gather(vp_l, axis, axis=0, tiled=True))
+        return ctx.shard_map(f, in_specs=(P(axis), P(axis)),
+                             out_specs=(P(None), P(None)))(k_pages, v_pages)
+
+    def f(kp_l, vp_l):
+        kernel = lambda *refs: _pool_ag_kernel(axis, mesh_axes, *refs)
+        full_k = jax.ShapeDtypeStruct((n * kp_l.shape[0],) + kp_l.shape[1:],
+                                      kp_l.dtype)
+        full_v = jax.ShapeDtypeStruct((n * vp_l.shape[0],) + vp_l.shape[1:],
+                                      vp_l.dtype)
+        return pl.pallas_call(
+            kernel,
+            out_shape=(full_k, full_v),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 2,
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA((2, n)),
+                pltpu.SemaphoreType.DMA((2, n)),
+                pltpu.SemaphoreType.REGULAR,
+            ],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=collective_id_for(f"pool_ag_{axis}")),
+            interpret=default_interpret(),
+        )(kp_l, vp_l)
+
+    return ctx.shard_map(f, in_specs=(P(axis), P(axis)),
+                         out_specs=(P(None), P(None)))(k_pages, v_pages)
+
+
 def sp_paged_attend_write(ctx: ShmemContext, q: jax.Array,
                           k_new: jax.Array, v_new: jax.Array,
                           k_pages: jax.Array, v_pages: jax.Array,
                           block_table: jax.Array, pos: jax.Array,
                           kv_len: jax.Array, axis: str = "sp",
-                          active: jax.Array | None = None):
+                          active: jax.Array | None = None,
+                          overlap: bool = False):
     """Sequence-parallel paged write + paged GQA decode attention: the page
     pool is sharded over ``axis`` on the PAGE dim (``page_pool_pspec``),
     rank r owning pages ``[r*Pl, (r+1)*Pl)``.
@@ -526,6 +628,14 @@ def sp_paged_attend_write(ctx: ShmemContext, q: jax.Array,
     [B, Hkv, D]; k/v_pages [P, Hkv, page_size, D] GLOBAL views sharded
     P(axis); pos/kv_len [B]. Returns (attn [B, Hq, D], k_pages, v_pages)
     with the pools still P(axis)-sharded.
+
+    ``overlap=True`` swaps the tiled ``lax.all_gather`` for the
+    signal-gated start-local assembly (``pool_ag_start_local``): the
+    rank's own pool slice lands in the full pool without waiting on the
+    wire and remote slices are gated per-shard by counted signals —
+    ISSUE 16's SP overlap. The assembled pool is a page-order
+    concatenation either way, so the attention output is BITWISE identical
+    to ``overlap=False`` (only the transport schedule differs).
     """
     n = ctx.axis_size(axis)
     if n == 1:
@@ -540,7 +650,7 @@ def sp_paged_attend_write(ctx: ShmemContext, q: jax.Array,
         "does this; the allocator never hands out the padding pages)")
     has_active = active is not None
 
-    def body(kp_l, vp_l, q, kn, vn, bt, pos, kv_lens, *act):
+    def write_shard(kp_l, vp_l, kn, vn, bt, pos, *act):
         r = lax.axis_index(axis)
         p_local = kp_l.shape[0]
         page_size = kp_l.shape[2]
@@ -554,6 +664,25 @@ def sp_paged_attend_write(ctx: ShmemContext, q: jax.Array,
         slot = pos % page_size
         kp_l = kp_l.at[idx, :, slot].set(kn, mode="drop")
         vp_l = vp_l.at[idx, :, slot].set(vn, mode="drop")
+        return kp_l, vp_l
+
+    if overlap:
+        smw = ctx.shard_map(
+            write_shard,
+            in_specs=(P(axis), P(axis)) + (P(),) * (4 + int(has_active)),
+            out_specs=(P(axis), P(axis)))
+        wargs = (k_pages, v_pages, k_new, v_new, block_table, pos)
+        if has_active:
+            wargs += (active,)
+        kp, vp = smw(*wargs)
+        kf, vf = pool_ag_start_local(ctx, kp, vp, axis=axis)
+        smo = ctx.shard_map(
+            lambda q, kf, vf, bt, kl: gqa_decode_paged(q, kf, vf, bt, kl)[0],
+            in_specs=(P(),) * 5, out_specs=P())
+        return smo(q, kf, vf, block_table, kv_len), kp, vp
+
+    def body(kp_l, vp_l, q, kn, vn, bt, pos, kv_lens, *act):
+        kp_l, vp_l = write_shard(kp_l, vp_l, kn, vn, bt, pos, *act)
         # tiled page-dim allgather = exact concatenation of the shards
         kf = lax.all_gather(kp_l, axis, axis=0, tiled=True)
         vf = lax.all_gather(vp_l, axis, axis=0, tiled=True)
@@ -572,4 +701,4 @@ def sp_paged_attend_write(ctx: ShmemContext, q: jax.Array,
 
 __all__ = ["gqa_decode_partial", "gqa_decode_paged", "paged_kv_write",
            "decode_combine", "ll_ag_merge", "sp_gqa_flash_decode",
-           "sp_paged_attend_write"]
+           "sp_paged_attend_write", "pool_ag_start_local"]

@@ -207,6 +207,17 @@ class ServingMetrics:
             # cached pages vs ones that prefilled from scratch
             "ttft_cached_s": Histogram(),
             "ttft_cold_s": Histogram(),
+            # overlapped serving (ISSUE 16): per-decode-step EP wire time
+            # split by the wire-fit model — comm the schedule still
+            # exposes on the critical path vs comm hidden behind expert
+            # FFN compute by the microbatch pipeline. MODELED (t = t0 +
+            # bytes/BW per a2a round), not wall clock: CPU test runs
+            # serialize ranks and can never exhibit real overlap, so the
+            # honest number is the model, labeled as such (docs/
+            # serving.md). overlap=off exposes everything; n_ep=1 has no
+            # wire and observes zeros.
+            "exposed_comm_us": Histogram(),
+            "overlapped_comm_us": Histogram(),
         }
         self._t0 = time.perf_counter()
 

@@ -38,6 +38,7 @@ which is also why ``gemm_rs`` is refused here (docs/serving.md).
 
 from __future__ import annotations
 
+import dataclasses
 import time
 
 import jax
@@ -46,8 +47,10 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from triton_dist_tpu.aot.registry import TunedKey, get_default_registry
 from triton_dist_tpu.layers.ep_a2a_layer import EPAll2AllLayer
 from triton_dist_tpu.models.moe import MoEConfig, moe_mlp_ep_overlap
+from triton_dist_tpu.ops.all_to_all import _DEFAULT_WIRE_FIT, a2a_wire_bytes
 from triton_dist_tpu.ops.allgather_gemm import GemmConfig, tp_column_linear
 from triton_dist_tpu.ops.flash_decode import sp_paged_attend_write
 from triton_dist_tpu.serving import checkpoint as ckpt_mod
@@ -119,6 +122,8 @@ class ShardedServingEngine(ServingEngine):
                  stall_deadline_steps: int = 256,
                  wire_dtype: str | None = "auto", tp_impl: str = "xla",
                  tp_cfg: GemmConfig | None = None, moe_block_m: int = 128,
+                 overlap: str = "off",
+                 overlap_microbatches: int | None = None,
                  digest_every: int = 1,
                  journal: ControlJournal | None = None,
                  checkpoint_every: int | None = None,
@@ -159,21 +164,85 @@ class ShardedServingEngine(ServingEngine):
                           else mk(prefill_chunk))
         self.wire_dtype = str(jnp.dtype(self.a2a_decode.a2a.wire_dtype)) \
             if self.a2a_decode.a2a.wire_dtype is not None else None
+        # per-program resolved wire (satellite 6): ``auto`` resolves per
+        # dispatch size, so decode and chunk can disagree — serve_sim
+        # prints both so "wire=auto" is auditable per mesh (PR 8 caveat).
+        self.wire_dtype_chunk = \
+            str(jnp.dtype(self.a2a_chunk.a2a.wire_dtype)) \
+            if self.a2a_chunk.a2a.wire_dtype is not None else None
+
+        # -- fine-grained compute/comm overlap (ISSUE 16) ------------------
+        # ``overlap`` gates the SCHEDULE only, never the math: the EP leg
+        # microbatches each dispatch/combine (segmented counted-signal
+        # wire, FFN(i) overlapping a2a(i+1)) and the ``ep+sp`` leg starts
+        # local attention-pool assembly under the tiled allgather. Every
+        # combine stays a concat or fixed-order fold, so the bitwise trace
+        # contract above is untouched — asserted by bench.py and
+        # tests/test_overlap_serving.py against the overlap=off golden.
+        assert overlap in ("off", "ep", "ep+sp"), (
+            f"overlap must be 'off', 'ep' or 'ep+sp', got {overlap!r}")
+        self.overlap = overlap
+        mb = 1
+        if overlap != "off":
+            mb = overlap_microbatches
+            if mb is None:
+                # tuned depth: the sigcheck-gated registry key PR 15
+                # persists (aot/registry.py GATE_RUNNERS
+                # ``serving_overlap_mb``); default 2 = double-buffering
+                reg = get_default_registry()
+                if reg is not None:
+                    mb = reg.get(TunedKey("serving_overlap_mb",
+                                          mesh_shape=(n_tp, n_sp, n_ep),
+                                          dtype=self.wire_dtype or "none"))
+                mb = 2 if mb is None else int(mb)
+            mb = int(mb)
+            assert mb >= 1, f"overlap_microbatches must be >= 1, got {mb}"
+            assert (num_slots // n_ep) % mb == 0, (
+                f"decode rows per rank {num_slots // n_ep} must split "
+                f"evenly into {mb} overlap microbatches")
+            assert (prefill_chunk // n_ep) % mb == 0, (
+                f"chunk rows per rank {prefill_chunk // n_ep} must split "
+                f"evenly into {mb} overlap microbatches")
+            if mb > 1:
+                # ride the segmented counted-signal wire kernel so each
+                # microbatch's put is gated per segment (ops/all_to_all.py
+                # ``all_to_all_push_seg``) — same bytes, same slots
+                shared = self.a2a_chunk is self.a2a_decode
+                seg = lambda l: dataclasses.replace(  # noqa: E731
+                    l, a2a=dataclasses.replace(l.a2a, seg_push=2))
+                self.a2a_decode = seg(self.a2a_decode)
+                self.a2a_chunk = (self.a2a_decode if shared
+                                  else seg(self.a2a_chunk))
+        self.overlap_microbatches = mb
 
         def moe_ffn(a2a):
             def ffn(h, p):
                 return moe_mlp_ep_overlap(ctx, a2a, h, p["w_router"],
                                           p["we_gate"], p["we_up"],
-                                          p["we_down"], block_m=moe_block_m)
+                                          p["we_down"], block_m=moe_block_m,
+                                          microbatches=mb)
             return ffn
+
+        sp_overlap = overlap == "ep+sp"
 
         def attn_io(q, k, v, kp, vp, bt, pos, kv_len, active):
             return sp_paged_attend_write(ctx, q, k, v, kp, vp, bt, pos,
-                                         kv_len, axis="sp", active=active)
+                                         kv_len, axis="sp", active=active,
+                                         overlap=sp_overlap)
 
         def linear(h, w, name):
             return tp_column_linear(ctx, h, w, axis="tp", impl=tp_impl,
                                     cfg=tp_cfg)
+
+        # modeled per-decode-step wire split (satellite 2): price each EP
+        # a2a with the PR 8 wire fit (t = t0 + bytes/BW). With M overlap
+        # microbatches the software pipeline hides all but one round per
+        # a2a, so exposed = t0 + B/(M*BW) while the total pays the extra
+        # (M-1) launch overheads. CPU wall clock serializes ranks and can
+        # never show real overlap, so the split is an HONEST MODELED
+        # number (docs/serving.md), observed per step into the metrics.
+        self._exposed_comm_us, self._overlapped_comm_us = \
+            self._comm_split_us(cfg.base.n_layers, mb)
 
         # pool-output sharding pin: must exist BEFORE super().__init__
         # builds the jitted programs (it becomes their out_shardings for
@@ -245,6 +314,25 @@ class ShardedServingEngine(ServingEngine):
         self._digest_check = jax.jit(ctx.shard_map(
             gather_cmp, in_specs=P(MESH_AXES), out_specs=P(MESH_AXES)))
 
+    def _comm_split_us(self, n_layers: int, mb: int) -> tuple[float, float]:
+        """(exposed_us, overlapped_us) per decode step under the wire fit.
+        ``mb == 1`` (overlap off) exposes everything; n_ep == 1 has no
+        wire at all, so both halves are zero there — which is also why
+        overlap can only LOSE at n=1 (it still pays the extra microbatch
+        launches while hiding nothing)."""
+        a2a = self.a2a_decode.a2a
+        if a2a.n_ranks == 1:
+            return 0.0, 0.0
+        wire = a2a.wire_dtype
+        fit = _DEFAULT_WIRE_FIT["fp8" if wire is not None and
+                                jnp.dtype(wire).itemsize == 1 else "bf16"]
+        bw_us = fit["gb_per_s"] * 1e3          # bytes per microsecond
+        b = a2a_wire_bytes(a2a.n_ranks, a2a.max_tokens, a2a.hidden,
+                           a2a.topk, wire)
+        total = n_layers * (mb * fit["t0_us"] + b / bw_us)
+        exposed = n_layers * (fit["t0_us"] + b / (mb * bw_us))
+        return exposed, max(0.0, total - exposed)
+
     def _default_artifact_key(self) -> str:
         return f"sharded:{self.mesh_desc}"
 
@@ -300,6 +388,9 @@ class ShardedServingEngine(ServingEngine):
         override ran it on), then the base checkpoint cadence — so a
         checkpoint is only ever captured at a step whose digest all ranks
         just agreed on."""
+        self.metrics.observe("exposed_comm_us", self._exposed_comm_us)
+        self.metrics.observe("overlapped_comm_us",
+                             self._overlapped_comm_us)
         if self.digest_every and self._steps % self.digest_every == 0:
             try:
                 self.check_replicated_decisions()
