@@ -1613,6 +1613,128 @@ def bench_cluster(ctx, num_requests: int = 2000, templates: int = 32,
     }
 
 
+def bench_lending(ctx, num_requests: int = 240, templates: int = 8,
+                  zipf: float = 1.2, replicas: int = 4,
+                  num_slots: int = 4, page_size: int = 8,
+                  num_pages: int = 33, pages_per_seq: int = 8) -> dict:
+    """Cluster-wide prefix sharing rows (ISSUE 17): the page-lending
+    tier on a Zipf template workload with router affinity DISABLED —
+    full-prompt rendezvous scatters same-template requests across the
+    fleet, the adversarial placement lending exists to absorb.
+
+    - ``lend_hit_rate_single`` / ``lend_hit_rate_scattered`` /
+      ``lend_hit_rate_cluster``: the acceptance sandwich — one replica's
+      hit rate (the ceiling), the scattered fleet without lending (the
+      floor), and the scattered fleet WITH lending, asserted within 0.02
+      of the ceiling: every remote radix hit became a lend became an
+      ordinary local cached hit.
+    - ``lend_us_per_page``: mean wall cost of one lent page through the
+      export → ladder → adopt path (host control plane; the device-mesh
+      byte movement is ``ops.lend_pages``, priced by its own sigcheck-
+      registered kernel).
+    - ``lend_rewarm_ttft_steps`` vs ``lend_cold_ttft_steps``: post-
+      restore template TTFT (step space) after the re-warm-from-peers
+      path vs the fallback's cold prefill during the owner's downtime —
+      the restore acceptance is rewarmed ≈ cached, NOT cold.
+
+    Every trace in every configuration is asserted bit-identical to the
+    closed-form ``expected_tokens`` golden — lending that changed tokens
+    would be pricing a broken tier. Submissions are drained serially so
+    the lender's pages are CACHED (refcount-0, the sole-ownership lend
+    precondition) before a peer may borrow them; the rows price warm
+    steady-state lending, not the racy in-flight window it refuses.
+    """
+    import numpy as _np
+
+    from triton_dist_tpu.serving import (Cluster, SimEngine,
+                                         expected_tokens)
+
+    rng0 = _np.random.RandomState(0)
+    tpls = [tuple(rng0.randint(1, 32000, size=3 * page_size).tolist())
+            for _ in range(templates)]
+    ranks = _np.arange(1, templates + 1, dtype=_np.float64)
+    zp = ranks ** -zipf
+    zp /= zp.sum()
+
+    def factory(journal):
+        return SimEngine(num_slots=num_slots, page_size=page_size,
+                         num_pages=num_pages, pages_per_seq=pages_per_seq,
+                         journal=journal, prefix_cache=True,
+                         prefill_chunk=page_size)
+
+    def run(n_rep, **kw):
+        cl = Cluster(factory, replicas=n_rep, **kw)
+        rng = _np.random.RandomState(1)
+        reqs = {}
+        for _ in range(num_requests):
+            t = tpls[int(rng.choice(templates, p=zp))]
+            prompt = list(t) + rng.randint(1, 32000, size=3).tolist()
+            mnt = int(rng.randint(2, 5))
+            reqs[cl.submit(prompt, mnt)] = (prompt, mnt)
+            cl.drain()
+        res = cl.results()
+        assert len(res) == num_requests and not cl.failed_gids
+        for gid, toks in res.items():
+            assert toks == expected_tokens(*reqs[gid]), (
+                f"gid {gid} diverged from the closed-form golden — "
+                f"lending changed tokens")
+        hits = sum(r.engine.metrics.counters["prefix_hits"]
+                   for r in cl.replicas)
+        miss = sum(r.engine.metrics.counters["prefix_misses"]
+                   for r in cl.replicas)
+        return cl, hits / max(hits + miss, 1)
+
+    _, rate_single = run(1)
+    _, rate_scattered = run(replicas, affinity=False)
+    cl, rate_lend = run(replicas, affinity=False, lend=True)
+    assert rate_lend >= rate_single - 0.02, (
+        f"cluster hit rate {rate_lend:.3f} fell below the single-replica "
+        f"ceiling {rate_single:.3f} — the lending tier is leaking misses")
+    lp = cl.metrics.hist["lend_us_per_page"]
+    lend_count = cl.metrics.counters["lends"]
+
+    # the restore rung: kill a template's home, serve it elsewhere (cold,
+    # then cached), restore — the re-warm makes post-restore TTFT land in
+    # the cached band, and the step-space split is the witness
+    cl = Cluster(factory, replicas=replicas, lend=True)
+    rng = _np.random.RandomState(2)
+    t = tpls[0]
+
+    def go(c):
+        prompt = list(t) + rng.randint(1, 32000, size=3).tolist()
+        gid = c.submit(prompt, 3)
+        c.drain()
+        assert c.results()[gid] == expected_tokens(prompt, 3)
+
+    go(cl)
+    home = cl.prefix_index.match(t)[1]
+    cl.kill(home)
+    go(cl)          # fallback pays the cold prefill
+    go(cl)          # ... then serves cached
+    fb = cl.prefix_index.match(t)[1]
+    cl.restore(cl.replicas[home].index)
+    go(cl)          # home again (reassign) — REWARMED, not cold
+    hm = cl.replicas[home].engine.metrics.hist
+    cold = cl.replicas[fb].engine.metrics.hist["ttft_cold_steps"]
+    rew = hm["ttft_rewarmed_steps"]
+    assert rew.count >= 1 and rew.max < cold.min, (
+        f"post-restore TTFT {rew.max} steps in the cold band "
+        f"({cold.min}) — the re-warm did not take")
+    return {
+        "lend_hit_rate_single": round(rate_single, 3),
+        "lend_hit_rate_scattered": round(rate_scattered, 3),
+        "lend_hit_rate_cluster": round(rate_lend, 3),
+        "lend_us_per_page": round(lp.mean, 1) if lp.mean else None,
+        "lend_count": lend_count,
+        "lend_rewarm_ttft_steps": rew.max,
+        "lend_cold_ttft_steps": cold.min,
+        "lend_knobs": {
+            "num_requests": num_requests, "templates": templates,
+            "zipf": zipf, "replicas": replicas, "page_size": page_size,
+            "num_pages": num_pages},
+    }
+
+
 def bench_prefix_cache(ctx, num_requests: int = 40, templates: int = 4,
                        zipf: float = 1.1, num_slots: int = 4,
                        page_size: int = 8, num_pages: int = 14,
@@ -2226,6 +2348,15 @@ def main(a2a_primary: bool = False):
         extras.update(bench_prefix_cache(ctx, **psh))
 
     attempt("prefix_cache", _prefix_cache)
+
+    def _lending():
+        # cluster-wide prefix sharing: the hit-rate sandwich (single-
+        # replica ceiling vs scattered floor vs lending fleet, affinity
+        # off), per-lent-page cost, and the post-restore re-warm TTFT
+        # band — every trace bit-identity-asserted (ISSUE 17)
+        extras.update(bench_lending(ctx))
+
+    attempt("lending", _lending)
 
     def _slo():
         # multi-tenant WFQ isolation under the bursty two-class workload:

@@ -77,11 +77,33 @@ p.add_argument("--no-kill", action="store_true",
                help="fault-free run (no kill/restore cycle)")
 p.add_argument("--prefix-cache", action="store_true",
                help="ref-counted prefix caching inside each replica "
-                    "(ISSUE 13; --engine colocated only — SimEngine has "
-                    "no KV to cache). The router's radix index already "
-                    "sends shared-template prompts to one replica, so "
-                    "its cache sees them all; prints an aggregate "
-                    "hit-rate + cached/cold TTFT line to stderr")
+                    "(ISSUE 13; SimEngine runs the same ledger/cache "
+                    "control plane with chunked prefill since ISSUE 17). "
+                    "The router's radix index already sends shared-"
+                    "template prompts to one replica, so its cache sees "
+                    "them all; prints an aggregate hit-rate + cold/"
+                    "cached/rewarmed TTFT line to stderr")
+p.add_argument("--lend", action="store_true",
+               help="cluster-wide prefix sharing (ISSUE 17): on a local "
+                    "cache miss with a remote radix-index hit, the owner "
+                    "replica LENDS its refcount-0 cached pages to the "
+                    "routed replica, and a restored replica re-warms its "
+                    "cache from peers instead of cold re-prefilling. "
+                    "Needs --prefix-cache; prints a lend-rate panel to "
+                    "stderr")
+p.add_argument("--no-affinity", action="store_true",
+               help="disable the router's radix/prefix affinity: "
+                    "rendezvous hashes the FULL prompt, so same-template "
+                    "requests scatter across the fleet — the adversarial "
+                    "placement the lending tier must absorb (the ISSUE "
+                    "17 acceptance compares this + --lend against the "
+                    "single-replica hit rate)")
+p.add_argument("--lend-deadline", type=int, default=4, metavar="STEPS",
+               help="first Backoff rung of the lend ladder, in engine "
+                    "steps (a dead/slow lender burns rungs, exhaustion "
+                    "degrades to local re-prefill)")
+p.add_argument("--lend-retries", type=int, default=2, metavar="N",
+               help="rung count of the lend ladder")
 p.add_argument("--workload", default=None, metavar="SPEC",
                help="bursty two-class trace (ISSUE 14) replacing the "
                     "template workload: key=value pairs (see serve_sim "
@@ -111,8 +133,9 @@ p.add_argument("--artifact", default=None, metavar="DIR",
                     "tracing; a stale artifact is a loud typed error. "
                     "Prints a cold_start summary line to stderr")
 args = p.parse_args()
-if args.prefix_cache and args.engine != "colocated":
-    p.error("--prefix-cache needs --engine colocated")
+if args.lend and not args.prefix_cache:
+    p.error("--lend needs --prefix-cache (lending moves CACHED prefix "
+            "pages; without a cache there is nothing to lend or adopt)")
 if args.artifact is not None and args.engine != "colocated":
     p.error("--artifact needs --engine colocated")
 if ((args.overlap != "off" or args.mesh is not None)
@@ -162,11 +185,16 @@ if args.engine == "sim":
     VOCAB = 32000
 
     def factory(journal):
+        # prefix caching needs chunked prefill (a cache hit resumes the
+        # chunk cursor past the adopted pages — ISSUE 17); one page per
+        # chunk mirrors the colocated engines below
         return SimEngine(num_slots=args.slots, page_size=args.page_size,
                          num_pages=args.pages,
                          pages_per_seq=args.pages_per_seq,
                          journal=journal, checkpoint_every=ckpt_every,
-                         slo=slo_policy)
+                         slo=slo_policy, prefix_cache=args.prefix_cache,
+                         prefill_chunk=(args.page_size
+                                        if args.prefix_cache else None))
 
     def golden(prompt, mnt):
         return expected_tokens(prompt, mnt)
@@ -262,7 +290,9 @@ journal_dir = args.journal_dir or tempfile.mkdtemp(prefix="cluster-sim-")
 # bit-identity of every verified trace vs its fresh-traced golden IS the
 # artifact-transparency check at cluster scale
 cluster = Cluster(factory, replicas=args.replicas, journal_dir=journal_dir,
-                  artifact=artifact)
+                  artifact=artifact, affinity=not args.no_affinity,
+                  lend=args.lend, lend_deadline_steps=args.lend_deadline,
+                  lend_retries=args.lend_retries)
 
 reqs: dict[int, tuple[list[int], int]] = {}
 killed_step = restored_step = None
@@ -358,7 +388,11 @@ if args.prefix_cache:
     # cache-transparency check at cluster scale
     agg: dict[str, int] = {}
     from triton_dist_tpu.serving.metrics import Histogram  # noqa: E402
-    tc, tk = Histogram(), Histogram()
+    # wall-clock split (device engines) AND step-space split (SimEngine)
+    # — cold vs cached vs REWARMED (pages adopted from a peer, ISSUE 17);
+    # the kill/restore acceptance is rewarmed ≈ cached, NOT cold
+    wall_h = {k: Histogram() for k in ("cold", "cached", "rewarmed")}
+    step_h = {k: Histogram() for k in ("cold", "cached", "rewarmed")}
     for rep in cluster.replicas:
         if rep.engine is None:
             continue
@@ -366,12 +400,20 @@ if args.prefix_cache:
         for k in ("prefix_hits", "prefix_misses", "prefix_hit_tokens",
                   "cow_copies", "prefix_evictions"):
             agg[k] = agg.get(k, 0) + c[k]
-        for h, dst in (("ttft_cached_s", tc), ("ttft_cold_s", tk)):
-            src = rep.engine.metrics.hist[h]
-            for v in src._samples:
-                dst.observe(v)
+        for kind in ("cold", "cached", "rewarmed"):
+            for src, dst in ((f"ttft_{kind}_s", wall_h[kind]),
+                             (f"ttft_{kind}_steps", step_h[kind])):
+                for v in rep.engine.metrics.hist[src]._samples:
+                    dst.observe(v)
     hm = lambda h: (None if h.mean is None  # noqa: E731
                     else round(h.mean * 1e6, 1))
+    split = {f"ttft_{k}_us_mean": hm(wall_h[k])
+             for k in ("cold", "cached", "rewarmed")}
+    if any(h.count for h in step_h.values()):   # SimEngine's step space
+        split.update({f"ttft_{k}_steps_mean":
+                      None if step_h[k].mean is None
+                      else round(step_h[k].mean, 2)
+                      for k in ("cold", "cached", "rewarmed")})
     print(json.dumps({
         "prefix_cache": True,
         **agg,
@@ -381,8 +423,25 @@ if args.prefix_cache:
         "router_radix_hits": cluster.metrics.counters["router_radix_hits"],
         "router_radix_misses":
             cluster.metrics.counters["router_radix_misses"],
-        "ttft_cached_us_mean": hm(tc),
-        "ttft_cold_us_mean": hm(tk),
+        **split,
+    }), file=sys.stderr)
+if args.lend:
+    # lend-rate panel (ISSUE 17): how much of the fleet's hit rate the
+    # lending tier bought, and what each lent page cost
+    cm = cluster.metrics
+    lp = cm.hist["lend_us_per_page"]
+    print(json.dumps({
+        "lend": True,
+        "affinity": not args.no_affinity,
+        "lends": cm.counters["lends"],
+        "lent_pages": cm.counters["lent_pages"],
+        "lend_tokens": cm.counters["lend_tokens"],
+        "lend_degradations": cm.counters["lend_degradations"],
+        "rewarmed_prefixes": cm.counters["rewarmed_prefixes"],
+        "lend_rate": round(cm.counters["lends"]
+                           / max(args.requests, 1), 4),
+        "lend_us_per_page_mean": None if lp.mean is None
+        else round(lp.mean, 1),
     }), file=sys.stderr)
 if workload_spec is not None or slo_policy is not None:
     # per-class fleet aggregate (ISSUE 14): summed over alive replicas

@@ -133,6 +133,16 @@ p.add_argument("--prompt-zipf", default=None, metavar="ALPHA:POOL",
                     "with Zipf(ALPHA) popularity and append a short "
                     "random tail — the workload prefix caching exists "
                     "for (e.g. 1.1:8). Deterministic per --seed")
+p.add_argument("--lend-warm", type=int, default=None, metavar="N",
+               help="cluster-wide prefix sharing (ISSUE 17) in one "
+                    "process: a peer LENDER engine prefills the top-N "
+                    "--prompt-zipf pool prefixes, then lends them to the "
+                    "serving engine over the export/adopt page surface "
+                    "BEFORE the trace starts — head-of-pool prompts hit "
+                    "as REWARMED (peer-adopted) pages instead of paying "
+                    "a cold prefill; prints a lend panel to stderr. "
+                    "Needs --prefix-cache + --prompt-zipf on the plain "
+                    "engine (no --mesh/--disagg)")
 p.add_argument("--workload", default=None, metavar="SPEC",
                help="bursty two-class trace (ISSUE 14) replacing the "
                     "uniform generator: key=value pairs, e.g. 'n=200,"
@@ -175,6 +185,12 @@ if (args.prefix_cache and args.prefill_chunk is None
         and not args.disagg and args.mesh is None):
     # the cache rides the chunked path (adoption = cursor jump)
     args.prefill_chunk = 2 * args.page_size
+if args.lend_warm is not None and (
+        not args.prefix_cache or args.prompt_zipf is None
+        or args.disagg or args.mesh is not None):
+    p.error("--lend-warm needs --prefix-cache + --prompt-zipf on the "
+            "plain engine (no --mesh/--disagg): lending moves CACHED "
+            "prefix pages between two engines of the same model")
 if args.prefill_buckets == "pow2":
     buckets = "pow2"
 elif args.prefill_buckets == "exact":
@@ -372,6 +388,41 @@ else:
         arrivals.append((i * args.arrive_every // max(args.arrive_every, 1),
                          prompt, mnt))
 
+lend_stats = None
+if args.lend_warm is not None:
+    # ISSUE 17 demo: a peer lender (same params, its OWN page pool, no
+    # journal) earns the head prefixes' KV by prefilling them, then the
+    # serving engine adopts the pages over the export/adopt surface —
+    # the host twin of ops.lend_pages. Head-of-pool prompts in the trace
+    # below then hit as rewarmed pages before any local prefill ran.
+    from triton_dist_tpu.serving import ServingEngine  # noqa: E402
+    lender = ServingEngine(params, cfg, num_slots=args.slots,
+                           page_size=args.page_size, num_pages=args.pages,
+                           pages_per_seq=args.pages_per_seq,
+                           prefill_chunk=args.prefill_chunk
+                           or 2 * args.page_size,
+                           prefix_cache=True)
+    n_warm = min(args.lend_warm, len(pool))
+    for pre in pool[:n_warm]:
+        lender.submit(pre + [1], 2)
+    lender.run(max_steps=200_000)
+    _t_lend = _time.perf_counter()
+    lent_pages = lent_tokens = 0
+    for pre in pool[:n_warm]:
+        toks, _ids, payload = lender.export_prefix(pre)
+        if toks > 0:
+            got = eng.adopt_prefix(pre, toks, payload)
+            lent_pages += got
+            lent_tokens += got * args.page_size
+    lend_stats = {
+        "lend_warm": n_warm,
+        "lent_pages": lent_pages,
+        "lend_tokens": lent_tokens,
+        "lend_us_per_page": round(
+            (_time.perf_counter() - _t_lend) * 1e6 / max(lent_pages, 1),
+            1),
+    }
+
 if args.crash_at is not None:
     from triton_dist_tpu.shmem.faults import InjectedCrash  # noqa: E402
     try:
@@ -503,7 +554,13 @@ if args.prefix_cache:
                            for k in ("mean", "p99")},
         "ttft_cold_us": {k: us(snap["ttft_cold_s"][k])
                          for k in ("mean", "p99")},
+        # the ISSUE 17 third band: first hit on pages adopted FROM A
+        # PEER (--lend-warm) — the acceptance is rewarmed ≈ cached
+        "ttft_rewarmed_us": {k: us(snap["ttft_rewarmed_s"][k])
+                             for k in ("mean", "p99")},
     }), file=sys.stderr)
+if lend_stats is not None:
+    print(json.dumps({"lend": True, **lend_stats}), file=sys.stderr)
 if args.disagg:
     # two panels: TTFT lives on the prefill worker, ITL/stall on the
     # decode worker — whose decode stall carries ZERO prefill work (the

@@ -95,6 +95,35 @@ def _seg_dropped_signal_kernel(axis, mesh_axes, in_ref, out_ref, flag):
     out_ref[...] = in_ref[...]
 
 
+def _lend_dropped_last_signal_kernel(axis, mesh_axes, in_ref, out_ref,
+                                     flag):
+    """The lend_pages wire (ISSUE 17: lender announces one counted signal
+    per page, borrower gates on the total page count) whose lender
+    FORGETS the LAST page's announcement — the classic off-by-one on the
+    counted protocol: pages-1 signals arrive against a wait budget of
+    pages, so the borrower's delivery gate starves (static
+    under-signal). The pages themselves may well have landed; the
+    ANNOUNCEMENT protocol is what the checker accounts."""
+    from ..shmem import device as shd
+    me = shd.my_pe(axis)
+    pages = 3
+    lender, borrower = 0, 1
+    bpid = shd.pe_at(mesh_axes, axis, borrower)
+
+    @pl.when(me == lender)
+    def _send():
+        # BUG: announces pages-1 of the `pages` puts — the final page's
+        # counted signal is dropped on the floor
+        for _ in range(pages - 1):
+            shd.signal_op(flag, 1, bpid)
+
+    @pl.when(me == borrower)
+    def _recv():
+        shd.signal_wait_until(flag, pages)
+
+    out_ref[...] = in_ref[...]
+
+
 def _over_signal_kernel(axis, mesh_axes, in_ref, out_ref, flag):
     """Arrival counter whose producers double-signal: the wait consumes n-1
     but 2(n-1) arrive — the residue poisons the next call on this scratch
@@ -243,6 +272,10 @@ _ENTRIES = [
     GalleryEntry("seg_dropped_signal", UNDER_SIGNAL,
                  run=lambda ctx: _flag_call(ctx, _seg_dropped_signal_kernel,
                                             "seg_dropped_signal")),
+    GalleryEntry("lend_dropped_last_signal", UNDER_SIGNAL,
+                 run=lambda ctx: _flag_call(
+                     ctx, _lend_dropped_last_signal_kernel,
+                     "lend_dropped_last_signal")),
     GalleryEntry("over_signal", OVER_SIGNAL,
                  run=lambda ctx: _flag_call(ctx, _over_signal_kernel,
                                             "over_signal")),

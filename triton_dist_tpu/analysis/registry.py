@@ -30,6 +30,11 @@ from .capture import FakeContext
 MESH_2D: Tuple[Dict[str, int], ...] = ({"x": 2, "y": 2},)
 MESH_LOCAL: Tuple[Dict[str, int], ...] = ({"x": 1},)
 MESH_PAIR: Tuple[Dict[str, int], ...] = ({"role": 2},)
+# lend_pages' role-gated protocol must balance at ANY axis size (ranks
+# outside the {lender, borrower} pair only hit the entry barrier) — the
+# ISSUE 17 satellite pins n ∈ {2, 3, 4}
+MESH_LEND: Tuple[Dict[str, int], ...] = ({"role": 2}, {"role": 3},
+                                         {"role": 4})
 MESH_1D_AND_2D = DEFAULT_MESHES + MESH_2D
 
 f32 = jnp.float32
@@ -222,6 +227,20 @@ def _run_migrate_pages(ctx):
     migrate_pages(ctx, pool, pool,
                   jnp.array([1, 2, 0, 0], i32), jnp.array([3, 4, 0, 0], i32),
                   jnp.array([2], i32), axis="role")
+
+
+def _run_lend_pages(ctx):
+    from ..ops import lend_pages
+    n_roles = ctx.num_ranks
+    L, num_pages, Hkv, page_size, D = 2, 9, 2, 8, 32
+    pool = jnp.zeros((n_roles, L, num_pages, Hkv, page_size, D), f32)
+    # lender 0 → borrower (last rank): at n > 2 the middle ranks are
+    # pure bystanders — the capture proves their signal books still
+    # balance (entry barrier only)
+    lend_pages(ctx, pool, pool,
+               jnp.array([1, 2, 0, 0], i32), jnp.array([3, 4, 0, 0], i32),
+               jnp.array([2], i32), axis="role",
+               lender=0, borrower=n_roles - 1)
 
 
 # -- EP all-to-all -----------------------------------------------------------
@@ -427,6 +446,12 @@ _ENTRIES = [
     RegistryEntry("zigzag_indices", skip=_SKIP_PURE),
     # page migration (pairwise producer/consumer role protocol)
     RegistryEntry("migrate_pages", _run_migrate_pages, meshes=MESH_PAIR),
+    RegistryEntry("paged_transport",
+                  skip="shared transport host wrapper; protocol checked "
+                       "via migrate_pages and lend_pages"),
+    # cluster page lending (ISSUE 17): same counted-signal protocol,
+    # role-gated — must balance with bystander ranks on the axis
+    RegistryEntry("lend_pages", _run_lend_pages, meshes=MESH_LEND),
     # EP all-to-all
     RegistryEntry("all_to_all_push", _run_all_to_all_push),
     # segmented counted-signal wire (ISSUE 16 overlap schedule)

@@ -23,7 +23,9 @@ from triton_dist_tpu.ops.gemm_reduce_scatter import (  # noqa: F401
 from triton_dist_tpu.ops.autodiff import ag_gemm_diff, gemm_rs_diff  # noqa: F401
 from triton_dist_tpu.ops.ring_attention import (  # noqa: F401
     ring_attention, ring_attention_fwd, ring_attention_bwd, zigzag_indices)
-from triton_dist_tpu.ops.page_migrate import migrate_pages  # noqa: F401
+from triton_dist_tpu.ops.page_migrate import (migrate_pages,  # noqa: F401
+                                              paged_transport)
+from triton_dist_tpu.ops.lend_pages import lend_pages  # noqa: F401
 from triton_dist_tpu.ops.all_to_all import (  # noqa: F401
     EpAllToAllContext, Ep2dAllToAllContext, all_to_all_push,
     all_to_all_push_seg, a2a_wire_bytes,

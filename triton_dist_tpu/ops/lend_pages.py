@@ -1,0 +1,60 @@
+"""KV page lending: the cluster prefix-sharing kernel (ISSUE 17).
+
+Same wire protocol as ``migrate_pages`` — per-(layer, page) one-sided
+``putmem_nbi`` puts plus a counted ``signal_op`` announcement, consumer
+waits on exactly the signals covering what it will read — applied to a
+different serving relationship: the **lender** pushes refcount-0 *cached*
+prefix pages (pages the prefix index retains after their last reference
+dropped — see ``KVPagePool.check_lendable``) into a **borrower**
+replica's reserved destination pages, so a prompt routed away from its
+prefix's home replica still adopts the KV instead of re-prefilling it.
+
+Role semantics vs migration:
+
+- migration moves pages a sequence OWNS (sole ownership via
+  ``check_migratable``) and the source side forgets them — a handoff.
+- lending copies pages nobody references (refcount 0, cached) and the
+  lender KEEPS them — a replication. Greedy-decode determinism makes the
+  bytes identical to what the borrower would have re-prefilled, which is
+  what preserves the bit-identical trace contract (the same argument
+  that makes local prefix-cache adoption safe, stretched across
+  replicas).
+
+The sole-ownership/COW contract is untouched: a lent page is refcount-0
+on the lender (no writer exists there) and lands in a freshly allocated
+page on the borrower (no reader exists yet); both sides' ledgers audit
+clean (``KVPagePool.check``). The host tier (serving/lending.py) wraps
+this call in the PR 7 ``Deadline``/``Backoff``/degrade ladder — a dead
+or slow lender degrades to local re-prefill, never a stall.
+
+Every rank on the role axis enters the SPMD call (one program, like all
+collectives in ops/); ranks outside the ``{lender, borrower}`` pair
+participate only in the entry barrier, which is what keeps the kernel
+sigcheck-clean at any axis size (registered at n ∈ {2, 3, 4})."""
+
+from __future__ import annotations
+
+import jax
+
+from triton_dist_tpu.ops.page_migrate import paged_transport
+from triton_dist_tpu.shmem.context import ShmemContext
+
+
+def lend_pages(ctx: ShmemContext, pool_k: jax.Array, pool_v: jax.Array,
+               src_ids: jax.Array, dst_ids: jax.Array, n_pages: jax.Array,
+               axis: str | None = None, lender: int = 0, borrower: int = 1,
+               tag: jax.Array | int = 0):
+    """Lend ``n_pages`` cached prefix pages from ``lender`` to
+    ``borrower`` over ``axis``. Argument and return contracts are
+    :func:`~triton_dist_tpu.ops.page_migrate.paged_transport`'s:
+    ``src_ids`` are lender-local cached page ids (host-checked via
+    ``KVPagePool.check_lendable`` — refcount-0, index-retained),
+    ``dst_ids`` the borrower's freshly allocated destination ids, and
+    ``landed[borrower] == (count, tag)`` is the delivery ground truth the
+    lending tier gates its prefix-cache insert on."""
+    return paged_transport(ctx, pool_k, pool_v, src_ids, dst_ids, n_pages,
+                           axis=axis, producer=lender, consumer=borrower,
+                           tag=tag, name="lend_pages")
+
+
+__all__ = ["lend_pages"]

@@ -64,11 +64,11 @@ from triton_dist_tpu.shmem.context import ShmemContext
 from triton_dist_tpu.utils import default_interpret
 
 
-def _migrate_kernel(axis, mesh_axes, producer, consumer, n_layers,
-                    interpreting,
-                    n_ref, src_ref, dst_ref, tag_ref, kpool, vpool,
-                    kpool_out, vpool_out, landed_ref,
-                    send_k, recv_k, send_v, recv_v, chunk_sem):
+def _transport_kernel(axis, mesh_axes, producer, consumer, n_layers,
+                      interpreting,
+                      n_ref, src_ref, dst_ref, tag_ref, kpool, vpool,
+                      kpool_out, vpool_out, landed_ref,
+                      send_k, recv_k, send_v, recv_v, chunk_sem):
     """Both roles run this SPMD; ``producer``/``consumer`` are role indices
     along ``axis``. Pools are the [L*P, Hkv, ps, D] page-flattened local
     shards of the symmetric pool (aliased through as outputs).
@@ -191,11 +191,17 @@ def _migrate_kernel(axis, mesh_axes, producer, consumer, n_layers,
         landed_ref[0, 0] = n
 
 
-def migrate_pages(ctx: ShmemContext, pool_k: jax.Array, pool_v: jax.Array,
-                  src_ids: jax.Array, dst_ids: jax.Array, n_pages: jax.Array,
-                  axis: str | None = None, producer: int = 0,
-                  consumer: int = 1, tag: jax.Array | int = 0):
-    """Collective chunk migration over the role axis.
+def paged_transport(ctx: ShmemContext, pool_k: jax.Array, pool_v: jax.Array,
+                    src_ids: jax.Array, dst_ids: jax.Array,
+                    n_pages: jax.Array, axis: str | None = None,
+                    producer: int = 0, consumer: int = 1,
+                    tag: jax.Array | int = 0, name: str = "page_migrate"):
+    """The shared per-(layer, page) put + counted-signal transport core
+    (ISSUE 17 refactor): ``migrate_pages`` (disagg prefill→decode handoff)
+    and ``lend_pages`` (cluster prefix lending) are the SAME wire protocol
+    with different role semantics, so both are thin fronts over this one
+    host wrapper. ``name`` keys the collective id — distinct fronts get
+    distinct collective channels even on the same axis.
 
     ``pool_k``/``pool_v``: symmetric pools from ``create_symm_tensor`` —
     global ``[n_roles, L, P, Hkv, page_size, D]`` sharded ``P(axis)``
@@ -208,13 +214,15 @@ def migrate_pages(ctx: ShmemContext, pool_k: jax.Array, pool_v: jax.Array,
     ``n_pages`` (``[1]`` int32). Entries past ``n_pages`` are never
     dereferenced, so pad with anything in range. ``tag`` is the attempt/
     generation stamp echoed back in the landed report (see
-    ``_migrate_kernel``; 0 for first sends, bumped per retry).
+    ``_transport_kernel``; 0 for first sends, bumped per retry).
 
     Returns ``(pool_k, pool_v, landed [n_roles, 2] int32)`` — pools
     aliased in place, ``landed[consumer] == (count, tag)``: the kernel-
     reported delivered-page count (the signal ledger's ground truth)
-    plus the echoed attempt tag. BOTH roles must enter this call (it is
-    one SPMD program, like every collective in ops/)."""
+    plus the echoed attempt tag. ALL ranks on ``axis`` must enter this
+    call (it is one SPMD program, like every collective in ops/); ranks
+    outside the ``{producer, consumer}`` pair participate only in the
+    entry barrier."""
     axis = axis or ctx.axis_names[0]
     mesh_axes = ctx.axis_names
     interp = default_interpret()
@@ -224,7 +232,7 @@ def migrate_pages(ctx: ShmemContext, pool_k: jax.Array, pool_v: jax.Array,
         flat = lambda a: a.reshape((a.shape[1] * a.shape[2],) + a.shape[3:])
         kpl, vpl = flat(kp), flat(vp)
         pmax = src.shape[0]
-        kernel = lambda *refs: _migrate_kernel(
+        kernel = lambda *refs: _transport_kernel(
             axis, mesh_axes, producer, consumer, L,
             interp is not False, *refs)
         ko, vo, landed = pl.pallas_call(
@@ -245,7 +253,7 @@ def migrate_pages(ctx: ShmemContext, pool_k: jax.Array, pool_v: jax.Array,
                             pltpu.SemaphoreType.REGULAR],
             compiler_params=pltpu.CompilerParams(
                 has_side_effects=True,
-                collective_id=collective_id_for(f"page_migrate_{axis}")),
+                collective_id=collective_id_for(f"{name}_{axis}")),
             interpret=interp,
         )(n, src, dst, tg, kpl, vpl)
         return ko.reshape(kp.shape), vo.reshape(vp.shape), landed
@@ -258,4 +266,16 @@ def migrate_pages(ctx: ShmemContext, pool_k: jax.Array, pool_v: jax.Array,
               jnp.asarray(tag, jnp.int32).reshape(1), pool_k, pool_v)
 
 
-__all__ = ["migrate_pages"]
+def migrate_pages(ctx: ShmemContext, pool_k: jax.Array, pool_v: jax.Array,
+                  src_ids: jax.Array, dst_ids: jax.Array, n_pages: jax.Array,
+                  axis: str | None = None, producer: int = 0,
+                  consumer: int = 1, tag: jax.Array | int = 0):
+    """Collective chunk migration over the role axis — the disaggregated
+    prefill→decode handoff front over :func:`paged_transport` (argument
+    and return contracts documented there)."""
+    return paged_transport(ctx, pool_k, pool_v, src_ids, dst_ids, n_pages,
+                           axis=axis, producer=producer, consumer=consumer,
+                           tag=tag, name="page_migrate")
+
+
+__all__ = ["migrate_pages", "paged_transport"]

@@ -21,7 +21,13 @@ EP/SP overlap ops (see docs/serving.md).
 - prefix_cache — token-keyed radix index over KVPagePool pages (ISSUE
                13): refcounted adoption of cached prefixes, copy-on-
                write on divergence, LRU eviction of refcount-0 pages,
-               and the router-side ReplicaPrefixIndex twin
+               and the cluster-authoritative ReplicaPrefixIndex twin
+- lending    — cluster-wide prefix sharing (ISSUE 17): on a borrower-
+               side cache miss with a remote index hit the owner LENDS
+               its refcount-0 cached pages (ops.lend_pages on device
+               meshes, export/adopt_prefix on host engines), wrapped in
+               the Deadline/Backoff/degrade ladder; a restored replica
+               re-warms its empty cache from peers the same way
 - deadline   — Deadline/Backoff helpers + EngineStallError (the global
                progress watchdog both engines share)
 - journal    — append-only WAL of control-plane events (ISSUE 9)
@@ -57,6 +63,7 @@ from triton_dist_tpu.serving.kv_pool import (KVPagePool, PageLedgerError,
                                              cache_to_pages, page_pool_pspec,
                                              pages_to_cache,
                                              shard_pool_arrays)
+from triton_dist_tpu.serving.lending import PageLendingTier
 from triton_dist_tpu.serving.metrics import Histogram, ServingMetrics
 from triton_dist_tpu.serving.prefix_cache import (PrefixCache,
                                                   ReplicaPrefixIndex)
@@ -83,6 +90,7 @@ __all__ = [
     "Cluster",
     "EngineReplica",
     "SimEngine",
+    "PageLendingTier",
     "expected_tokens",
     "sim_token",
     "shard_pool_arrays",

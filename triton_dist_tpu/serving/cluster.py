@@ -57,7 +57,8 @@ from triton_dist_tpu.serving.engine import (class_label, mark_prefill_start,
 from triton_dist_tpu.serving.journal import ControlJournal
 from triton_dist_tpu.serving.kv_pool import KVPagePool, _fnv1a
 from triton_dist_tpu.serving.metrics import ServingMetrics
-from triton_dist_tpu.serving.prefix_cache import ReplicaPrefixIndex
+from triton_dist_tpu.serving.prefix_cache import (PrefixCache,
+                                                  ReplicaPrefixIndex)
 from triton_dist_tpu.serving.scheduler import (AdmissionRejected,
                                                ContinuousBatchingScheduler,
                                                Request, RequestState,
@@ -91,6 +92,18 @@ class SimEngine:
     step, exactly like a one-chunk prompt). Exposes the same duck-typed
     surface ``serving/checkpoint.py`` restores through, so an
     :class:`EngineReplica` can kill/restore it like the device engines.
+
+    With ``prefix_cache=True`` (ISSUE 17) the instant prefill becomes the
+    device engines' chunked state machine in step space: admission adopts
+    the longest cached full-page prefix (real ``PrefixCache`` over the
+    real ledger), the PREFILLING slot advances ``prefill_chunk`` tokens
+    per step from its cursor, and the first token lands the step the
+    cursor reaches the prompt end — so cold, cached and re-warmed TTFTs
+    separate DETERMINISTICALLY (``ttft_*_steps`` histograms), which is
+    what the cluster lending acceptance asserts on. ``export_prefix`` /
+    ``adopt_prefix`` are the lend surface ``serving/lending.py`` drives;
+    the Sim pool is a pure ledger, so the "transfer" is bookkeeping only
+    (device engines move the actual page bytes — ``ops.lend_pages``).
     """
 
     def __init__(self, num_slots: int = 4, page_size: int = 16,
@@ -102,8 +115,15 @@ class SimEngine:
                  queue_cap: int | None = None,
                  ttl_steps: int | None = None,
                  fault_plan: "faults.FaultPlan | None" = None,
-                 slo: SLOPolicy | None = None):
+                 slo: SLOPolicy | None = None,
+                 prefix_cache: bool = False,
+                 prefill_chunk: int | None = None):
         assert checkpoint_every is None or journal is not None
+        assert prefill_chunk is None or prefill_chunk >= 1
+        assert not prefix_cache or prefill_chunk is not None, (
+            "prefix_cache needs prefill_chunk set — a cache hit resumes "
+            "chunked prefill at its cursor; the instant path has no "
+            "cursor to resume at (same contract as ServingEngine)")
         self.page_size = page_size
         self.pages_per_seq = pages_per_seq
         self.num_slots = num_slots
@@ -111,6 +131,15 @@ class SimEngine:
         self.vocab = vocab
         self.metrics = metrics or ServingMetrics()
         self.alloc = KVPagePool(num_pages + 1, page_size, reserved=1)
+        self.prefill_chunk = prefill_chunk
+        self.prefix_cache = PrefixCache(self.alloc, page_size) \
+            if prefix_cache else None
+        # lend bookkeeping (ISSUE 17): pages adopted FROM a peer replica
+        # (for the rewarmed-vs-cached TTFT split) and a generation counter
+        # for the transient ledger seq-ids adopt_prefix allocates under
+        self._lent_pages: set[int] = set()
+        self._lend_gen = 0
+        self._ttft_kind: dict[int, str] = {}
         self.slo = slo
         self.sched = ContinuousBatchingScheduler(num_slots,
                                                  queue_cap=queue_cap,
@@ -195,20 +224,91 @@ class SimEngine:
     def _can_hold(self, req: Request) -> bool:
         need = -(-len(req.prompt) // self.page_size)
         need -= len(self.alloc.pages_of(req.rid))
-        return self.alloc.free_pages >= max(need, 0)
+        avail = self.alloc.free_pages
+        if self.prefix_cache is not None:
+            avail += self.prefix_cache.evictable
+        return avail >= max(need, 0)
+
+    def _reclaim(self, n_pages: int) -> None:
+        """Evict cached prefixes until ``n_pages`` are allocatable
+        (engine.py's ``_reclaim``, verbatim semantics)."""
+        short = n_pages - self.alloc.free_pages
+        if short > 0 and self.prefix_cache is not None:
+            self.metrics.inc("prefix_evictions",
+                             self.prefix_cache.evict(short))
+
+    def _cache_adopt(self, req: Request) -> None:
+        """Admission-time prefix adoption (engine.py's ``_cache_adopt``
+        in step space): acquire the longest cached full-page prefix and
+        start the prefill cursor past it. Also classifies the request's
+        eventual TTFT — cold (no hit), cached (local hit) or rewarmed
+        (hit on pages a peer lent us)."""
+        cache = self.prefix_cache
+        if cache is None or req.prefill_cursor > 0 \
+                or self.alloc.holds(req.rid):
+            return      # resumed-after-preempt or replayed: re-prefills
+        hit = cache.match(req.prompt)
+        if not hit:
+            self.metrics.inc("prefix_misses")
+            self._ttft_kind[req.rid] = "cold"
+            return
+        self.alloc.acquire(req.rid, hit)
+        req.prefill_cursor = len(hit) * self.page_size
+        req.cache_hit_tokens = req.prefill_cursor
+        self.metrics.inc("prefix_hits")
+        self.metrics.inc("prefix_hit_tokens", req.prefill_cursor)
+        # unlike the device engines there is no argmax to recompute, so a
+        # whole-prompt hit keeps cursor == len(prompt): the first token
+        # emits the admitting step — TTFT identical to a cached hit
+        self._ttft_kind[req.rid] = (
+            "rewarmed" if any(p in self._lent_pages for p in hit)
+            else "cached")
+
+    def _advance_prefill(self, slot: int, req: Request) -> None:
+        """One chunk of step-space prefill; on reaching the prompt end,
+        emit the first token, index the prompt's full pages, and record
+        the cold/cached/rewarmed TTFT split (all deterministic: steps,
+        not wall time)."""
+        sp = len(req.prompt)
+        if req.prefill_cursor < sp:
+            chunk = min(self.prefill_chunk, sp - req.prefill_cursor)
+            req.prefill_cursor += chunk
+            self.metrics.inc("prefill_chunks")
+            self._jlog("chunk", rid=req.rid, cursor=req.prefill_cursor)
+            if req.prefill_cursor < sp:
+                return
+        req.state = RequestState.ACTIVE
+        req.first_token = sim_token(req.prompt, 0, self.vocab)
+        req.generated.append(req.first_token)
+        record_first_token(req, self.metrics, self._steps)
+        self.metrics.inc("tokens_generated")
+        if self.prefix_cache is not None:
+            # index full prompt pages BEFORE decode grows the sequence —
+            # the partial last page (decode writes there) never enters
+            self.prefix_cache.insert(
+                req.prompt,
+                self.alloc.pages_of(req.rid)[:sp // self.page_size])
+        kind = self._ttft_kind.pop(req.rid, "cold")
+        self.metrics.observe(f"ttft_{kind}_steps",
+                             self._steps - req.submit_step)
+        if req.done:
+            self._finish(slot)
 
     def _step_impl(self) -> bool:
         if self.sched.idle:
             return False
-        # admissions: instant "prefill" — first token the admitting step
+        # admissions: instant "prefill" (first token the admitting step)
+        # unless prefill_chunk arms the chunked state machine
         while True:
             adm = self.sched.admissible(self._can_hold)
             if adm is None:
                 break
             slot, req = adm
+            self._cache_adopt(req)
             need = -(-len(req.prompt) // self.page_size)
             have = len(self.alloc.pages_of(req.rid))
             if need > have:
+                self._reclaim(need - have)
                 got = self.alloc.alloc(req.rid, need - have)
                 assert got is not None
             self.sched.activate(slot, req)
@@ -216,15 +316,27 @@ class SimEngine:
             req.state = RequestState.PREFILLING
             mark_prefill_start(req, self.metrics, self._steps)
             self.metrics.inc("prefills")
-            self.metrics.inc("prefill_chunks")
-            req.prefill_cursor = len(req.prompt)
-            req.state = RequestState.ACTIVE
-            req.first_token = sim_token(req.prompt, 0, self.vocab)
-            req.generated.append(req.first_token)
-            record_first_token(req, self.metrics, self._steps)
-            self.metrics.inc("tokens_generated")
-            if req.done:
-                self._finish(slot)
+            if self.prefill_chunk is None:
+                self.metrics.inc("prefill_chunks")
+                req.prefill_cursor = len(req.prompt)
+                req.state = RequestState.ACTIVE
+                req.first_token = sim_token(req.prompt, 0, self.vocab)
+                req.generated.append(req.first_token)
+                record_first_token(req, self.metrics, self._steps)
+                self.metrics.inc("tokens_generated")
+                if req.done:
+                    self._finish(slot)
+        # chunked prefill: every PREFILLING slot (including ones admitted
+        # this very step) advances one chunk; a slot whose cursor reaches
+        # the prompt end emits its first token and joins decode below —
+        # so a whole-prompt cache hit reaches its token the admitting
+        # step, exactly like the instant path (TTFT ≈ cached)
+        if self.prefill_chunk is not None:
+            for slot in range(self.num_slots):
+                req = self.sched.slots[slot]
+                if req is not None \
+                        and req.state is RequestState.PREFILLING:
+                    self._advance_prefill(slot, req)
         # growth + decode: one token per ACTIVE slot, paged growth with
         # the real eviction ladder when the pool runs dry. Token i's KV
         # lands at position len(prompt)+i and the LAST token's KV is
@@ -272,6 +384,7 @@ class SimEngine:
         self.alloc.free_seq(req.rid)
         req.prefill_cursor = 0
         req.first_token = None
+        self._ttft_kind.pop(req.rid, None)   # re-classified on re-admit
         self.sched.evict(slot)
         self.metrics.inc("preemptions")
         self._jlog("preempt", rid=req.rid, slot=slot)
@@ -288,6 +401,56 @@ class SimEngine:
             self.metrics.inc_class("expirations", class_label(req))
             self._jlog("expire", rid=req.rid, reason=str(req.failure),
                        tenant=req.tenant, cls=req.cls)
+
+    # -- cluster page lending (ISSUE 17, serving/lending.py drives) --------
+    def export_prefix(self, prompt) -> tuple[int, list[int], None]:
+        """Lender half: the longest locally cached full-page prefix of
+        ``prompt`` that is LENDABLE — trimmed to the positional prefix
+        ``KVPagePool.check_lendable`` accepts (refcount-0 AND index-
+        retained; a page some live sequence still references is never
+        shipped, keeping the sole-ownership/COW contract untouched).
+        Returns ``(tokens, page_ids, payload)``; the Sim pool is a pure
+        ledger so ``payload`` is None (device engines return the page
+        bytes here — the host twin of what ``ops.lend_pages`` moves)."""
+        if self.prefix_cache is None:
+            return 0, [], None
+        prompt = tuple(int(t) for t in prompt)
+        hit = self.prefix_cache.match(prompt)
+        n = self.alloc.check_lendable(hit)
+        return n * self.page_size, hit[:n], None
+
+    def adopt_prefix(self, prompt, n_tokens: int, payload=None) -> int:
+        """Borrower half: materialize the first ``n_tokens`` of
+        ``prompt`` as locally cached prefix pages. Pages are allocated
+        under a transient lend seq-id, indexed, and immediately released
+        — ``insert`` marked them cacheable, so the release parks them on
+        the cached LRU exactly like a finished prefill's pages. Returns
+        pages newly adopted (0 = nothing to do or pool too tight; the
+        lending tier degrades to cold prefill, never stalls)."""
+        cache = self.prefix_cache
+        if cache is None or n_tokens <= 0:
+            return 0
+        prompt = tuple(int(t) for t in prompt)
+        want = min(n_tokens, len(prompt)) // self.page_size
+        have = cache.match(prompt)
+        if want <= len(have):
+            return 0        # local cache already at least as deep
+        need = want - len(have)
+        self._reclaim(need)
+        sid = ("lend", self._lend_gen)
+        self._lend_gen += 1
+        got = self.alloc.alloc(sid, need)
+        if got is None:
+            return 0        # pool too tight even after eviction
+        # [device engines scatter payload bytes into `got` here]
+        # the first len(have) entries ride existing trie edges (insert is
+        # first-writer-wins: pages for existing runs are ignored), the
+        # fresh pages take the runs beyond the local hit
+        cache.insert(prompt[:want * self.page_size], have + got)
+        self.alloc.free_seq(sid)    # refcount-0 + cacheable → cached LRU
+        self._lent_pages.update(got)
+        self._jlog("lend", tokens=want * self.page_size, pages=need)
+        return need
 
     def run(self, max_steps: int | None = None, arrivals=None,
             recover=None) -> dict[int, list[int]]:
@@ -369,6 +532,13 @@ class SimEngine:
             "admit_ticket": self.sched._admit_ticket,
             "pool": self.alloc.snapshot(),
             "pool_digest": self.alloc.digest(),
+            # prefix index (ISSUE 17): integrity artifact, like the pool
+            # snapshot — restore starts with an EMPTY cache (the cluster
+            # re-warms it from peers; pre-crash pages are never adopted)
+            "prefix_index": None if self.prefix_cache is None
+            else self.prefix_cache.snapshot(),
+            "prefix_digest": None if self.prefix_cache is None
+            else self.prefix_cache.digest(),
             "live": [ckpt_mod.snapshot_request(r) for r in live],
             "finished": [ckpt_mod.snapshot_finished(r)
                          for r in self._finished],
@@ -386,6 +556,13 @@ class SimEngine:
         self.sched = ContinuousBatchingScheduler(
             self.sched.num_slots, queue_cap=self.sched.queue_cap,
             policy=self.sched.policy)
+        if self.prefix_cache is not None:
+            # EMPTY cache over the fresh pool: restored requests re-earn
+            # KV via re-prefill; the cluster's restore() re-warms shared
+            # prefixes from peers through the lending tier
+            self.prefix_cache = PrefixCache(self.alloc, self.page_size)
+        self._lent_pages = set()
+        self._ttft_kind = {}
         self._finished = []
         self._failed = []
         self._rejected = []
@@ -393,6 +570,9 @@ class SimEngine:
             return
         ckpt_mod.audit_pool_snapshot(state["pool"], state["pool_digest"],
                                      self.alloc.num_pages, self.page_size, 1)
+        if state.get("prefix_index") is not None:
+            ckpt_mod.audit_prefix_snapshot(state["prefix_index"],
+                                           state["prefix_digest"])
         self._steps = state["step"]
         self._next_rid = state["next_rid"]
         self.sched._admit_ticket = state["admit_ticket"]
@@ -560,20 +740,39 @@ class Cluster:
 
     def __init__(self, factory, replicas: int = 4,
                  journal_dir: str | None = None, prefix_tokens: int = 8,
-                 spill_threshold: int | None = None, artifact=None):
+                 spill_threshold: int | None = None, artifact=None,
+                 affinity: bool = True, lend: bool = False,
+                 lend_plan: "faults.FaultPlan | None" = None,
+                 lend_deadline_steps: int = 4, lend_retries: int = 2):
         assert replicas >= 1
         self.replicas = [EngineReplica(i, factory, journal_dir,
                                        artifact=artifact)
                          for i in range(replicas)]
         self.prefix_tokens = prefix_tokens
         self.spill_threshold = spill_threshold
-        # cache-aware routing (ISSUE 13): token runs of routed prompts
-        # map to the replica that first served them, so a shared-prefix
-        # prompt follows its KV. Entries are never dropped — a dead
-        # replica's keys fall back to rendezvous below and the affinity
-        # returns the moment the replica is restored.
+        self.affinity = affinity
+        # authoritative cluster prefix index (ISSUE 13 → promoted in
+        # ISSUE 17): token runs of routed prompts map to the replica that
+        # first served them. Two consumers: the router (radix-hit
+        # affinity, gated by ``affinity`` so the lending tier can be
+        # measured without routing help) and the page-lending tier. A
+        # dead replica's entries are PRUNED by kill() — stale entries
+        # would route, and worse LEND, against pages that no longer exist
+        # — and stashed as tombstones that restore() re-warms from peers
+        # and re-registers.
         self.prefix_index = ReplicaPrefixIndex(prefix_tokens)
+        self._tombstones: dict[int, list[tuple[int, ...]]] = {}
         self.metrics = ServingMetrics()
+        # the lending tier is imported lazily: lending.py is pure host
+        # control plane over this module's duck-typed engine surface
+        if lend:
+            from triton_dist_tpu.serving.lending import PageLendingTier
+            self.lending = PageLendingTier(
+                self, plan=lend_plan,
+                deadline_steps=lend_deadline_steps,
+                max_retries=lend_retries)
+        else:
+            self.lending = None
         self._placement: dict[int, tuple[int, int]] = {}  # gid -> (ri, rid)
         self._rindex: dict[tuple[int, int], int] = {}     # (ri, rid) -> gid
         self._requests: dict[int, tuple[tuple[int, ...], int]] = {}
@@ -589,13 +788,22 @@ class Cluster:
         prompt = tuple(int(t) for t in prompt)
         alive = [r for r in self.replicas if r.alive]
         assert alive, "no alive replicas"
-        _, owner = self.prefix_index.match(prompt)
+        owner = None
+        if self.affinity:
+            _, owner = self.prefix_index.match(prompt)
         if owner is not None and self.replicas[owner].alive:
             pick = self.replicas[owner]
             self.metrics.inc("router_radix_hits")
         else:
+            # affinity ON keys rendezvous by the shared prefix (a
+            # template's requests co-locate even before its first index
+            # entry); affinity OFF keys by the FULL prompt — same-prefix
+            # requests scatter across the fleet, the adversarial placement
+            # the lending tier must absorb (the ISSUE 17 acceptance:
+            # cluster hit rate ≈ single-replica hit rate even then)
+            key = prompt[:self.prefix_tokens] if self.affinity else prompt
             pick = max(alive, key=lambda r: (
-                _fnv1a(0x811C9DC5, r.index, *prompt[:self.prefix_tokens]),
+                _fnv1a(0x811C9DC5, r.index, *key),
                 -r.load, -r.index))
             self.metrics.inc("router_radix_misses")
         if (self.spill_threshold is not None
@@ -606,6 +814,13 @@ class Cluster:
     def submit(self, prompt, max_new_tokens: int,
                tenant: str | None = None, cls: str | None = None) -> int:
         rep = self.route(prompt)
+        if self.lending is not None:
+            # borrower-side pre-warm (ISSUE 17): if a PEER owns this
+            # prompt's deepest indexed prefix and the target replica's
+            # cache misses, lend the pages NOW — the request's chunked
+            # prefill then resumes past the adopted prefix, so the lend
+            # latency overlaps admission instead of serializing with it
+            self.lending.lend(rep, prompt)
         # first-writer-wins: runs this prompt ADDS stick to the replica
         # that actually received it, existing runs keep their owner
         self.prefix_index.insert(tuple(int(t) for t in prompt), rep.index)
@@ -655,10 +870,32 @@ class Cluster:
     def kill(self, index: int) -> None:
         self.replicas[index].kill()
         self.metrics.inc("faults_injected")
+        # ISSUE 17 satellite: a dead replica's pages are gone — prune its
+        # index entries so neither the router nor the lending tier targets
+        # them, and stash the tombstoned prefixes for restore-time re-warm
+        self._tombstones[index] = self.prefix_index.prune(index)
 
     def restore(self, index: int) -> dict:
         stats = self.replicas[index].restore()
         self.metrics.inc("restores")
+        tombs = self._tombstones.pop(index, [])
+        if self.lending is not None and tombs:
+            # re-warm the restored replica's cache from peers instead of
+            # letting every shared prefix re-prefill cold (deepest-first:
+            # one lend covers every ancestor tombstone via early-out)
+            self.lending.rewarm(self.replicas[index], tombs)
+        # re-register only AFTER the restore (and re-warm, when lending)
+        # verified: the checkpoint audit ran inside restore(), and the
+        # re-warm adopts through the same audited ledger — re-check it
+        # before the index points traffic back here. reassign OVERWRITES
+        # owners claimed by peers mid-death: the restored replica just
+        # re-warmed exactly these prefixes, so affinity returning to it
+        # is warm, not cold.
+        eng = self.replicas[index].engine
+        if tombs and getattr(eng, "alloc", None) is not None:
+            eng.alloc.check()
+        for prefix in tombs:
+            self.prefix_index.reassign(prefix, index)
         self._harvest()   # replayed finishes reappear — re-record them
         return stats
 

@@ -205,6 +205,13 @@ class ServingEngine:
         # lint are identical with it on or off.
         self.prefix_cache = PrefixCache(self.alloc, page_size) \
             if prefix_cache else None
+        # cluster page lending (ISSUE 17): pages adopted FROM a peer
+        # (splits rewarmed TTFT out of cached), the transient seq-id
+        # generation adopt_prefix allocates under, and the rids whose
+        # admission hit landed on lent pages
+        self._lent_pages: set[int] = set()
+        self._lend_gen = 0
+        self._rewarmed_rids: set[int] = set()
         # multi-tenant SLO policy (ISSUE 14): entirely control-plane —
         # the policy changes WHICH request a slot admits and how many
         # prompt tokens a step co-schedules, never what the compiled
@@ -538,6 +545,10 @@ class ServingEngine:
         req.cache_hit_tokens = req.prefill_cursor
         self.metrics.inc("prefix_hits")
         self.metrics.inc("prefix_hit_tokens", req.prefill_cursor)
+        if any(p in self._lent_pages for p in hit):
+            # the hit rode pages a peer lent us — TTFT reports as
+            # "rewarmed", the kill/restore acceptance band (ISSUE 17)
+            self._rewarmed_rids.add(req.rid)
 
     def _reclaim(self, n_pages: int) -> None:
         """Refill the free list to ``n_pages`` by LRU-evicting cached
@@ -571,6 +582,69 @@ class ServingEngine:
             "v": self.pool["v"].at[:, new].set(self.pool["v"][:, old]),
         }
         self.metrics.inc("cow_copies")
+
+    # -- cluster page lending (ISSUE 17, serving/lending.py drives) -------
+    def export_prefix(self, prompt):
+        """Lender half: the longest locally cached full-page prefix of
+        ``prompt`` that ``KVPagePool.check_lendable`` accepts (refcount-0
+        AND index-retained — no live sequence can observe the copy), plus
+        the page payload. Returns ``(tokens, page_ids, payload)`` where
+        payload is the gathered K/V bytes — the host-mediated twin of the
+        per-(layer, page) puts ``ops.lend_pages`` issues on a device
+        mesh. Gathers are eager array ops, so the one-program-per-path
+        compile contract is untouched (same argument as _cow_writable)."""
+        if self.prefix_cache is None:
+            return 0, [], None
+        prompt = tuple(int(t) for t in prompt)
+        hit = self.prefix_cache.match(prompt)
+        n = self.alloc.check_lendable(hit)
+        if n == 0:
+            return 0, [], None
+        ids = np.asarray(hit[:n], np.int32)
+        payload = {"k": self.pool["k"][:, ids],
+                   "v": self.pool["v"][:, ids]}
+        return n * self.page_size, hit[:n], payload
+
+    def adopt_prefix(self, prompt, n_tokens: int, payload=None) -> int:
+        """Borrower half: land a peer's prefix pages locally. Fresh pages
+        are allocated under a transient lend seq-id, the payload bytes
+        scattered in (eager ``.at[].set`` — no new programs), the runs
+        indexed, and the pages released to the cached LRU — from here on
+        they are ordinary cached pages (admission adopts, COW guards,
+        eviction reclaims). Returns pages newly adopted; 0 degrades to
+        local prefill on the caller's side, never a stall."""
+        cache = self.prefix_cache
+        if cache is None or n_tokens <= 0:
+            return 0
+        prompt = tuple(int(t) for t in prompt)
+        want = min(n_tokens, len(prompt)) // self.page_size
+        have = cache.match(prompt)
+        if want <= len(have):
+            return 0        # local cache already at least as deep
+        need = want - len(have)
+        self._reclaim(need)
+        sid = ("lend", self._lend_gen)
+        self._lend_gen += 1
+        got = self.alloc.alloc(sid, need)
+        if got is None:
+            return 0        # pool too tight even after eviction
+        if payload is not None:
+            # the lender exported `want` pages; ours start past the
+            # local hit depth
+            idx = np.asarray(got, np.int32)
+            self.pool = {
+                "k": self.pool["k"].at[:, idx].set(
+                    payload["k"][:, len(have):want]),
+                "v": self.pool["v"].at[:, idx].set(
+                    payload["v"][:, len(have):want]),
+            }
+        # first len(have) entries ride existing trie edges (insert is
+        # first-writer-wins); the fresh pages take the deeper runs
+        cache.insert(prompt[:want * self.page_size], have + got)
+        self.alloc.free_seq(sid)    # refcount-0 + cacheable → cached LRU
+        self._lent_pages.update(got)
+        self._jlog("lend", tokens=want * self.page_size, pages=need)
+        return need
 
     def _admit_chunked(self, slot: int, req: Request) -> None:
         """Chunked admission does NO prefill math: adopt any cached
@@ -692,10 +766,13 @@ class ServingEngine:
                 req.prompt,
                 self.alloc.pages_of(req.rid)[:sp // self.page_size])
             if req.first_token_time is None:
-                self.metrics.observe(
-                    "ttft_cached_s" if req.cache_hit_tokens
-                    else "ttft_cold_s",
-                    time.perf_counter() - req.submit_time)
+                kind = ("ttft_rewarmed_s"
+                        if req.rid in self._rewarmed_rids
+                        else "ttft_cached_s" if req.cache_hit_tokens
+                        else "ttft_cold_s")
+                self._rewarmed_rids.discard(req.rid)
+                self.metrics.observe(kind,
+                                     time.perf_counter() - req.submit_time)
         record_first_token(req, self.metrics, self._steps)
         self._token[slot] = tok0
         self._pos[slot] = sp
@@ -1127,6 +1204,8 @@ class ServingEngine:
             # fresh pool → fresh (empty) index: every cached mapping
             # pointed at KV the restored process never computed
             self.prefix_cache = PrefixCache(self.alloc, self.page_size)
+        self._lent_pages = set()
+        self._rewarmed_rids = set()
         self.sched = ContinuousBatchingScheduler(
             self.num_slots, queue_cap=self.sched.queue_cap,
             policy=self.sched.policy)

@@ -70,6 +70,11 @@ EVENT_KINDS = (
     "digest_divergence",
     "checkpoint",
     "restore",
+    # cluster page lending (ISSUE 17): pages adopted from a peer replica.
+    # Observability only — replay ignores it (adopted pages are cache
+    # state, and a restored replica re-warms from peers, not from its own
+    # pre-crash journal)
+    "lend",
 )
 
 # Payload keys elided from one-line renderings (bulky checkpoint state).

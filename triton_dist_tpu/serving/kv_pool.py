@@ -428,6 +428,33 @@ class KVPagePool:
                     f"is rewritten at the destination while other "
                     f"sequences still read it here (seq {seq_id!r})")
 
+    def check_lendable(self, page_ids) -> int:
+        """Lending precondition (ISSUE 17): how many pages of the
+        POSITIONAL PREFIX of ``page_ids`` may be lent to a peer replica.
+        A page is lendable iff it is refcount-0 AND retained on the
+        cached LRU list — nobody here reads or writes it, the prefix
+        index alone keeps it alive, so copying its bytes out races with
+        nothing and the COW contract is untouched (the sole-ownership
+        twin of ``check_migratable``, one rung stricter: migration wants
+        exactly one owner, lending wants zero). Pages are positional
+        (page i holds tokens ``[i*page_size, (i+1)*page_size)``), so the
+        lendable run stops at the FIRST non-lendable page — a borrower
+        resumes chunked prefill right there. Out-of-range/reserved ids
+        are loud errors, not a short count: the caller handed us ids
+        straight from its prefix index, so a bad id is ledger
+        corruption."""
+        cached = set(self._cached)
+        n = 0
+        for p in page_ids:
+            if not (self.reserved <= p < self.num_pages):
+                raise PageLedgerError(
+                    f"check_lendable: page {p} outside the real-page "
+                    f"range [{self.reserved}, {self.num_pages})")
+            if self._refs.get(p, 0) != 0 or p not in cached:
+                break
+            n += 1
+        return n
+
     def landed_row(self, seq_id, covered, pages_per_seq: int,
                    fill: int = 0) -> list[int]:
         """Block-table row exposing only the LANDED PREFIX of ``seq_id``'s

@@ -153,6 +153,17 @@ class ServingMetrics:
             # stall-budgeted class was decoding (deadline-aware sizing)
             "quota_throttled": 0,
             "chunk_shrinks": 0,
+            # cluster prefix lending (ISSUE 17): completed lends (one per
+            # borrowed prefix), pages and prompt tokens delivered through
+            # them, lend attempts that degraded to local re-prefill (dead
+            # or slow lender — the request proceeds cold, never stalls),
+            # and prefixes a restored replica re-warmed from peers
+            # instead of cold re-prefilling
+            "lends": 0,
+            "lent_pages": 0,
+            "lend_tokens": 0,
+            "lend_degradations": 0,
+            "rewarmed_prefixes": 0,
         }
         self.hist = {
             "ttft_s": Histogram(),
@@ -218,6 +229,20 @@ class ServingMetrics:
             # wire and observes zeros.
             "exposed_comm_us": Histogram(),
             "overlapped_comm_us": Histogram(),
+            # cluster prefix lending (ISSUE 17): the kill/restore TTFT
+            # split — cold (no cached pages), cached (locally cached
+            # pages adopted), re-warmed (adopted pages arrived via the
+            # lending tier: a peer's lend or a post-restore re-warm).
+            # The ``_steps`` trio is the deterministic engine-step-space
+            # twin the SimEngine/cluster_sim panels report (wall TTFT is
+            # meaningless for a host-only engine); ``ttft_rewarmed_s``
+            # extends the ISSUE 13 wall-clock pair for device engines.
+            "ttft_rewarmed_s": Histogram(),
+            "ttft_cold_steps": Histogram(),
+            "ttft_cached_steps": Histogram(),
+            "ttft_rewarmed_steps": Histogram(),
+            # lend wall time per page (µs) — the bench row
+            "lend_us_per_page": Histogram(),
         }
         self._t0 = time.perf_counter()
 

@@ -202,14 +202,19 @@ class PrefixCache:
 
 
 class ReplicaPrefixIndex:
-    """The cluster router's radix index (ISSUE 13 satellite): page-sized
-    token runs map to the replica that last served that prefix. Pure
-    host-side control plane — no pool, no refcounts — but the same
-    full-run granularity as ``PrefixCache`` so a router hit predicts an
-    engine-side cache hit. First-writer-wins keeps routing sticky and
-    deterministic; a dead replica's entries stay in place (the caller
-    falls back to rendezvous hashing and the affinity returns with the
-    replica)."""
+    """The cluster's authoritative prefix radix index (ISSUE 13
+    satellite, promoted to the lending tier's source of truth in ISSUE
+    17): block-sized token runs map to the replica that last served that
+    prefix. Pure host-side control plane — no pool, no refcounts — but
+    the same full-run granularity as ``PrefixCache`` so an index hit
+    predicts an engine-side cache hit. Two consumers now share it: the
+    router (radix-hit affinity) and the page-lending tier
+    (serving/lending.py), which on a borrower-side miss asks the owner
+    replica to lend the prefix pages. First-writer-wins keeps both
+    sticky and deterministic. A dead replica's entries are PRUNED by the
+    cluster's ``kill()`` (stale entries would route — and worse, lend —
+    against pages that no longer exist); the pruned prefixes come back
+    via ``insert`` when the replica is restored and re-warmed."""
 
     def __init__(self, block: int):
         assert block >= 1
@@ -242,6 +247,57 @@ class ReplicaPrefixIndex:
                 child = (replica, {})
                 node[run] = child
             node = child[1]
+
+    def reassign(self, prompt, replica: int) -> None:
+        """Set ``replica`` as owner of EVERY node along ``prompt``'s
+        full-run path, creating missing nodes — the restore-path inverse
+        of ``prune`` (ISSUE 17). Unlike ``insert`` this overwrites: a
+        restored replica reclaims its tombstoned prefixes (it just
+        re-warmed exactly those pages from peers, so routing them back is
+        warm), which is the "affinity returns the moment the replica is
+        restored" contract the kill/restore test pins."""
+        node = self._root
+        for run in self._runs(prompt):
+            child = node.get(run)
+            if child is None:
+                child = (replica, {})
+            elif child[0] != replica:
+                child = (replica, child[1])
+            node[run] = child
+            node = child[1]
+
+    def prune(self, replica: int) -> list[tuple[int, ...]]:
+        """Drop every node owned by ``replica`` — with its WHOLE subtree,
+        like ``PrefixCache`` eviction: a child run's claim is meaningless
+        once its parent's entry is gone (ISSUE 17 satellite). Foreign-
+        owned descendants inside a dropped subtree are acceptable
+        collateral — they re-register on their owners' next submits.
+        Returns the full token paths of every ``replica``-owned node
+        removed (deepest included), insertion-ordered: the tombstone
+        list the cluster re-warms from peers and re-registers once the
+        restored replica verifies."""
+        tombstones: list[tuple[int, ...]] = []
+
+        def collect(children: dict, path: tuple) -> None:
+            for run, (owner, sub) in children.items():
+                p = path + run
+                if owner == replica:
+                    tombstones.append(p)
+                collect(sub, p)
+
+        def walk(children: dict, path: tuple) -> None:
+            for run in list(children):
+                owner, sub = children[run]
+                p = path + run
+                if owner == replica:
+                    tombstones.append(p)
+                    collect(sub, p)
+                    del children[run]
+                else:
+                    walk(sub, p)
+
+        walk(self._root, ())
+        return tombstones
 
 
 __all__ = ["PrefixCache", "ReplicaPrefixIndex"]
