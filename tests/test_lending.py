@@ -256,6 +256,60 @@ def test_export_adopt_between_engines():
     borrower.alloc.check()
 
 
+@pytest.mark.quick
+def test_adopt_prefix_pins_local_hit_under_pool_pressure():
+    """Regression: the borrower's PARTIAL local hit sits refcount-0 on
+    the cached LRU, so the reclaim that makes room for the lent pages
+    could evict it out from under the insert (re-popping the hit page
+    into the fresh allocation → 'already indexed', or indexing a
+    free-listed page). adopt_prefix must PIN the hit before reclaiming:
+    under pressure the eviction takes another cached page — never the
+    hit — and the lend deepens the existing prefix cleanly."""
+    lender = SimEngine(num_slots=2, page_size=PS, num_pages=17,
+                       pages_per_seq=8, prefix_cache=True,
+                       prefill_chunk=PS)
+    borrower = SimEngine(num_slots=2, page_size=PS, num_pages=17,
+                         pages_per_seq=8, prefix_cache=True,
+                         prefill_chunk=PS)
+    t = _templates(1, seed=13)[0]
+    prompt = t + (7, 8, 9)
+    lender.submit(list(prompt), 3)
+    lender.run()
+    toks, _, payload = lender.export_prefix(prompt)
+    assert toks == 3 * PS
+
+    # the borrower caches ONLY the template's first page (the partial
+    # hit, oldest on the LRU)...
+    borrower.submit(list(t[:PS] + (1, 2, 3)), 2)
+    borrower.run()
+    assert len(borrower.prefix_cache.match(t)) == 1
+    # ...then an unrelated page lands behind it on the LRU
+    rng = np.random.RandomState(5)
+    u = tuple(int(x) for x in rng.randint(1, 997, size=PS)) + (4, 5, 6)
+    borrower.submit(list(u), 2)
+    borrower.run()
+    assert borrower.alloc.cached_pages == 2
+
+    # soak the free list down to ONE page: landing the 2 missing pages
+    # forces a reclaim, and the unpinned LRU victim would be the hit
+    free = borrower.alloc.free_pages
+    assert free >= 1
+    if free > 1:
+        assert borrower.alloc.alloc("soak", free - 1) is not None
+
+    assert borrower.adopt_prefix(prompt, toks, payload) == 2
+    # the hit survived (the decoy was evicted instead) and was deepened
+    assert len(borrower.prefix_cache.match(t)) == 3
+    assert not borrower.prefix_cache.match(u), \
+        "the decoy page should have been the eviction victim"
+    borrower.alloc.check()
+
+    borrower.alloc.free_seq("soak")    # give the pool room to decode
+    rid = borrower.submit(list(prompt), 2)
+    out = borrower.run()
+    assert out[rid] == expected_tokens(prompt, 2)
+
+
 # ---------------------------------------------------------------------------
 # acceptance: cluster hit rate == single-replica hit rate, affinity OFF
 # ---------------------------------------------------------------------------
@@ -372,6 +426,49 @@ def test_restore_rewarms_from_peers(tmp_path):
     _assert_golden(cl, sent)
     for rep in cl.replicas:
         rep.engine.alloc.check()
+
+
+@pytest.mark.quick
+def test_cold_restore_does_not_steal_claimed_prefixes(tmp_path):
+    """With lending OFF a restored replica's cache is empty by contract
+    (no re-warm ran): a prefix a peer claimed — and re-earned — during
+    the downtime must STAY with that warm peer; reassigning it to the
+    cold restoree would route template traffic at an empty cache. An
+    UNCLAIMED tombstone still returns home: both sides are equally cold
+    there, and affinity entries are never dropped."""
+    cl = _mk_cluster(replicas=3, tmp_path=tmp_path)
+    assert cl.lending is None
+    rng = np.random.RandomState(3)
+    sent = {}
+
+    def go(t):
+        prompt = t + tuple(int(x) for x in rng.randint(1, 997, size=3))
+        gid = cl.submit(list(prompt), 2)
+        sent[gid] = (prompt, 2)
+        cl.drain()
+        return gid
+
+    # find two templates rendezvous-routed to the SAME home (pigeonhole
+    # over 6 templates × 3 replicas guarantees a pair; deterministic)
+    homes: dict[int, list[tuple]] = {}
+    for t in _templates(6, seed=17):
+        go(t)
+        homes.setdefault(cl.prefix_index.match(t)[1], []).append(t)
+    a, b = next(v for v in homes.values() if len(v) >= 2)[:2]
+    home = cl.prefix_index.match(a)[1]
+
+    cl.kill(home)
+    go(a)                        # a fallback peer claims + re-earns `a`
+    peer = cl.prefix_index.match(a)[1]
+    assert peer is not None and peer != home
+    assert cl.prefix_index.match(b)[1] is None, "pruned, nobody claimed"
+
+    cl.restore(home)
+    assert cl.prefix_index.match(a)[1] == peer, (
+        "cold restoree stole a prefix its peer holds warm")
+    assert cl.prefix_index.match(b)[1] == home, (
+        "unclaimed affinity did not return to the restored replica")
+    _assert_golden(cl, sent)
 
 
 # ---------------------------------------------------------------------------

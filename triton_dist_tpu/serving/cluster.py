@@ -403,15 +403,18 @@ class SimEngine:
                        tenant=req.tenant, cls=req.cls)
 
     # -- cluster page lending (ISSUE 17, serving/lending.py drives) --------
-    def export_prefix(self, prompt) -> tuple[int, list[int], None]:
+    def export_prefix(self, prompt,
+                      payload: bool = True) -> tuple[int, list[int], None]:
         """Lender half: the longest locally cached full-page prefix of
         ``prompt`` that is LENDABLE — trimmed to the positional prefix
         ``KVPagePool.check_lendable`` accepts (refcount-0 AND index-
         retained; a page some live sequence still references is never
         shipped, keeping the sole-ownership/COW contract untouched).
         Returns ``(tokens, page_ids, payload)``; the Sim pool is a pure
-        ledger so ``payload`` is None (device engines return the page
-        bytes here — the host twin of what ``ops.lend_pages`` moves)."""
+        ledger so the payload slot is always None (device engines return
+        the page bytes here — the host twin of what ``ops.lend_pages``
+        moves — and skip the gather when ``payload=False``, the cheap
+        depth-only probe rewarm's peer selection uses)."""
         if self.prefix_cache is None:
             return 0, [], None
         prompt = tuple(int(t) for t in prompt)
@@ -436,11 +439,18 @@ class SimEngine:
         if want <= len(have):
             return 0        # local cache already at least as deep
         need = want - len(have)
-        self._reclaim(need)
         sid = ("lend", self._lend_gen)
         self._lend_gen += 1
+        if have:
+            # pin the local hit under the lend sid BEFORE reclaiming:
+            # `have` sits refcount-0 on the cached LRU, so an unpinned
+            # reclaim under pool pressure could evict it out from under
+            # the insert below (same acquire-first order as _cache_adopt)
+            self.alloc.acquire(sid, have)
+        self._reclaim(need)
         got = self.alloc.alloc(sid, need)
         if got is None:
+            self.alloc.free_seq(sid)    # unpin the hit
             return 0        # pool too tight even after eviction
         # [device engines scatter payload bytes into `got` here]
         # the first len(have) entries ride existing trie edges (insert is
@@ -888,14 +898,23 @@ class Cluster:
         # verified: the checkpoint audit ran inside restore(), and the
         # re-warm adopts through the same audited ledger — re-check it
         # before the index points traffic back here. reassign OVERWRITES
-        # owners claimed by peers mid-death: the restored replica just
-        # re-warmed exactly these prefixes, so affinity returning to it
-        # is warm, not cold.
+        # the current owner, so a tombstone comes back only if the
+        # restored cache actually holds it warm (a deep lend covers its
+        # ancestor tombstones — the match sees them all) OR nobody else
+        # claimed it mid-death (unowned affinity returns even cold: both
+        # sides are equally cold, and entries are never dropped). A
+        # prefix a peer claimed that the restoree could not re-warm —
+        # every claimed prefix when lending is off, the cache being
+        # empty by contract — stays with the peer that holds it warm;
+        # first-writer-wins re-registers on the next submit routed here.
         eng = self.replicas[index].engine
         if tombs and getattr(eng, "alloc", None) is not None:
             eng.alloc.check()
+        cache = getattr(eng, "prefix_cache", None)
         for prefix in tombs:
-            self.prefix_index.reassign(prefix, index)
+            warm = cache is not None and cache.match(prefix)
+            if warm or self.prefix_index.match(prefix)[1] is None:
+                self.prefix_index.reassign(prefix, index)
         self._harvest()   # replayed finishes reappear — re-record them
         return stats
 

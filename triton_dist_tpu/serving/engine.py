@@ -584,7 +584,7 @@ class ServingEngine:
         self.metrics.inc("cow_copies")
 
     # -- cluster page lending (ISSUE 17, serving/lending.py drives) -------
-    def export_prefix(self, prompt):
+    def export_prefix(self, prompt, payload: bool = True):
         """Lender half: the longest locally cached full-page prefix of
         ``prompt`` that ``KVPagePool.check_lendable`` accepts (refcount-0
         AND index-retained — no live sequence can observe the copy), plus
@@ -592,7 +592,9 @@ class ServingEngine:
         payload is the gathered K/V bytes — the host-mediated twin of the
         per-(layer, page) puts ``ops.lend_pages`` issues on a device
         mesh. Gathers are eager array ops, so the one-program-per-path
-        compile contract is untouched (same argument as _cow_writable)."""
+        compile contract is untouched (same argument as _cow_writable).
+        ``payload=False`` is the cheap depth-only probe (peer selection
+        in ``PageLendingTier.rewarm``): no bytes are gathered."""
         if self.prefix_cache is None:
             return 0, [], None
         prompt = tuple(int(t) for t in prompt)
@@ -600,10 +602,12 @@ class ServingEngine:
         n = self.alloc.check_lendable(hit)
         if n == 0:
             return 0, [], None
+        if not payload:
+            return n * self.page_size, hit[:n], None
         ids = np.asarray(hit[:n], np.int32)
-        payload = {"k": self.pool["k"][:, ids],
-                   "v": self.pool["v"][:, ids]}
-        return n * self.page_size, hit[:n], payload
+        kv = {"k": self.pool["k"][:, ids],
+              "v": self.pool["v"][:, ids]}
+        return n * self.page_size, hit[:n], kv
 
     def adopt_prefix(self, prompt, n_tokens: int, payload=None) -> int:
         """Borrower half: land a peer's prefix pages locally. Fresh pages
@@ -622,11 +626,18 @@ class ServingEngine:
         if want <= len(have):
             return 0        # local cache already at least as deep
         need = want - len(have)
-        self._reclaim(need)
         sid = ("lend", self._lend_gen)
         self._lend_gen += 1
+        if have:
+            # pin the local hit under the lend sid BEFORE reclaiming:
+            # `have` sits refcount-0 on the cached LRU, so an unpinned
+            # reclaim under pool pressure could evict it out from under
+            # the insert below (same acquire-first order as _cache_adopt)
+            self.alloc.acquire(sid, have)
+        self._reclaim(need)
         got = self.alloc.alloc(sid, need)
         if got is None:
+            self.alloc.free_seq(sid)    # unpin the hit
             return 0        # pool too tight even after eviction
         if payload is not None:
             # the lender exported `want` pages; ours start past the

@@ -96,8 +96,9 @@ class PageLendingTier:
         if owner is None or owner == borrower.index:
             return 0
         lender = self.cluster.replicas[owner]
-        if not lender.alive:
-            return 0
+        if not lender.alive \
+                or getattr(lender.engine, "export_prefix", None) is None:
+            return 0    # engines without the lend surface never lend
         return self._transfer(lender, borrower, prompt)
 
     # -- restore-path re-warm ----------------------------------------------
@@ -105,9 +106,11 @@ class PageLendingTier:
         """Re-warm a restored ``replica``'s empty cache from peers: for
         each kill-time tombstoned prefix (deepest-first — one deep lend
         covers every ancestor, whose adopt then early-outs) probe every
-        alive peer's ``export_prefix`` and borrow from the deepest
-        exporter (ties → lowest index, deterministic). Returns total
-        pages adopted."""
+        alive peer with a depth-only ``export_prefix(payload=False)``
+        (no K/V bytes gathered) and borrow from the deepest exporter
+        (ties → lowest index, deterministic); only the chosen lender
+        gathers payload, inside ``_transfer``. Returns total pages
+        adopted."""
         engine = replica.engine
         if getattr(engine, "prefix_cache", None) is None \
                 or getattr(engine, "adopt_prefix", None) is None:
@@ -122,7 +125,8 @@ class PageLendingTier:
                         or getattr(peer.engine, "export_prefix",
                                    None) is None):
                     continue
-                toks, _, _ = peer.engine.export_prefix(prefix)
+                toks, _, _ = peer.engine.export_prefix(prefix,
+                                                       payload=False)
                 if toks > best_toks:
                     best_toks, best_peer = toks, peer
             if best_peer is None:
