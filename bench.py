@@ -1967,6 +1967,168 @@ def a2a_dispatch_model_us(measured_n1_us: float, n: int,
     return measured_n1_us + wire_us + (n - 1) * _HOP_US
 
 
+def bench_autoscale(ctx, n: int = 1500, num_slots: int = 8,
+                    page_size: int = 8, num_pages: int = 129,
+                    pages_per_seq: int = 8, max_replicas: int = 4) -> dict:
+    """Elastic autoscaling rows (ISSUE 18): the diurnal two-class
+    workload served twice — a static fleet pinned at ``max_replicas``
+    (the peak-provisioned golden) and an elastic fleet starting at ONE
+    replica under the ``Autoscaler`` — with the two result dicts
+    asserted EQUAL token for token: every scale-up, graceful drain and
+    lend-ahead changed the schedule, never the outputs.
+
+    - ``autoscale_replica_steps_saved_pct``: engine steps the elastic
+      fleet did NOT pay vs the static peak (both MEASURED runs, not a
+      counterfactual), asserted > 0 alongside >= 1 scale-up and >= 1
+      retire — a run that never scaled would price nothing.
+    - ``autoscale_chat_p99_ttft_steps``: whole-run chat TTFT tail under
+      the chat-priority WFQ policy, asserted within the chat budget —
+      elasticity must not cost the interactive class its SLO.
+    - ``autoscale_*_attainment``: the controller's own windowed per-class
+      attainment at end of run (its scaling signal, newest window only).
+    - ``scale_up_ttft_us``: wall time for ONE mid-run scale-up of the
+      real jitted engine — ``EngineReplica`` build seeded from a
+      persisted AOT artifact through first token — with
+      ``aot_programs`` asserted > 0 and fresh traces asserted ZERO:
+      scale-up latency is artifact load, not compilation.
+    """
+    import tempfile as _tf
+    from collections import deque as _dq
+
+    import numpy as _np  # noqa: F401  (parity with sibling benches)
+
+    from triton_dist_tpu.serving import (Autoscaler, Cluster, SimEngine,
+                                         expected_tokens, generate_arrivals,
+                                         parse_slo, parse_workload)
+
+    budgets = {"chat": 12, "batch": 20}
+    wspec = parse_workload(f"n={n},rate=0.25,burst_every=300,"
+                           "burst_len=60,burst_x=10,seed=7")
+    arrivals = generate_arrivals(wspec, vocab=32000, page_size=page_size)
+
+    def factory(journal):
+        # chat-priority WFQ keeps chat TTFT flat through burst fronts,
+        # so BATCH is the binding scaling class — reactive TTFT sensing
+        # lags by the TTFT itself, and the class that can wait carries it
+        return SimEngine(num_slots=num_slots, page_size=page_size,
+                         num_pages=num_pages, pages_per_seq=pages_per_seq,
+                         journal=journal, prefix_cache=True,
+                         prefill_chunk=page_size,
+                         slo=parse_slo("chat_weight=4,batch_weight=1"))
+
+    def run(jdir, elastic):
+        cl = Cluster(factory, replicas=1 if elastic else max_replicas,
+                     journal_dir=jdir, lend=True, spill_threshold=10)
+        asc = None
+        if elastic:
+            asc = Autoscaler(cl, budgets, window=32, min_samples=6,
+                             cooldown=20, warm_steps=1, min_replicas=1,
+                             max_replicas=max_replicas,
+                             journal=Autoscaler.journal_path_for(jdir))
+        pend = _dq(arrivals)
+        reqs = {}
+        i = 0
+        while pend:
+            while pend and pend[0][0] <= i:
+                _, prompt, mnt, tenant, cls = pend.popleft()
+                reqs[cl.submit(prompt, mnt, tenant=tenant,
+                               cls=cls)] = (prompt, mnt)
+            cl.step()
+            if asc is not None:
+                asc.step()
+            i += 1
+        idle = 0
+        while idle < 3:
+            idle = 0 if cl.step() else idle + 1
+            if asc is not None:
+                asc.step()
+        res = cl.results()
+        assert len(res) == wspec.n and not cl.failed_gids, (
+            f"{len(res)}/{wspec.n} finished, {len(cl.failed_gids)} failed")
+        for gid, toks in res.items():
+            assert toks == expected_tokens(*reqs[gid]), (
+                f"gid {gid} diverged from the closed-form golden")
+        return cl, asc, res
+
+    with _tf.TemporaryDirectory(prefix="bench-autoscale-s-") as jd:
+        cl_s, _, res_static = run(jd, elastic=False)
+        static_steps = cl_s.metrics.counters["replica_steps"]
+    with _tf.TemporaryDirectory(prefix="bench-autoscale-e-") as jd:
+        cl_e, asc, res_elastic = run(jd, elastic=True)
+    assert res_elastic == res_static, (
+        "elastic fleet results diverged from the static-peak golden — "
+        "a scale event changed tokens")
+    cm = cl_e.metrics
+    rsteps = cm.counters["replica_steps"]
+    assert cm.counters["scale_ups"] >= 1 and cm.counters["retires"] >= 1, (
+        f"the diurnal run must ride the swing (ups "
+        f"{cm.counters['scale_ups']}, retires {cm.counters['retires']})")
+    saved = 100.0 * (1 - rsteps / max(static_steps, 1))
+    assert saved > 0, (
+        f"elastic fleet paid {rsteps} replica steps vs static "
+        f"{static_steps} — autoscaling must save engine time")
+    chat_p99 = cm.hist[cm.class_key("ttft_steps", "chat")].percentile(99)
+    assert chat_p99 <= budgets["chat"], (
+        f"chat p99 TTFT {chat_p99} steps blew the {budgets['chat']}-step "
+        f"budget — elasticity cost the interactive class its SLO")
+    out = {
+        "autoscale_scale_ups": cm.counters["scale_ups"],
+        "autoscale_retires": cm.counters["retires"],
+        "autoscale_requeues": cm.counters["requeues"],
+        "autoscale_lend_aheads": cm.counters["lend_aheads"],
+        "autoscale_replica_steps": rsteps,
+        "autoscale_static_replica_steps": static_steps,
+        "autoscale_replica_steps_saved_pct": round(saved, 1),
+        "autoscale_chat_p99_ttft_steps": chat_p99,
+        "autoscale_batch_p99_ttft_steps":
+            cm.hist[cm.class_key("ttft_steps", "batch")].percentile(99),
+        "autoscale_verified_requests": len(res_elastic),
+    }
+    for _cls, b_ttft in sorted(budgets.items()):
+        if asc.attain.count(("ttft", _cls)):
+            out[f"autoscale_{_cls}_attainment"] = round(
+                asc.attain.attainment(("ttft", _cls), b_ttft), 3)
+
+    # -- scale-up-to-first-token off the AOT artifact (real engine) ---------
+    from triton_dist_tpu.aot import (ArtifactSpec, build_artifact,
+                                     load_artifact, make_engine)
+    from triton_dist_tpu.serving.cluster import EngineReplica
+
+    spec = ArtifactSpec(
+        model={"kind": "llama", "vocab_size": 128, "d_model": 64,
+               "n_layers": 2, "n_heads": 4, "n_kv_heads": 2,
+               "d_ff": 128, "max_seq_len": 64, "dtype": "float32"},
+        engines=[{"kind": "colocated", "num_slots": 4, "page_size": 8,
+                  "num_pages": 9, "pages_per_seq": 4, "prefill_chunk": 8}])
+    cfg = spec.model_config()
+    params = spec.init_params()
+    with _tf.TemporaryDirectory(prefix="bench-autoscale-a-") as tdir:
+        art = load_artifact(build_artifact(spec, f"{tdir}/artifact"),
+                            spec=spec)
+
+        def cfactory(journal, artifact=None):
+            return make_engine(spec.engines[0], params, cfg,
+                               artifact=artifact)
+
+        # exactly what Cluster.add_replica builds mid-run, timed from
+        # construction (artifact seeding included) through first token
+        t0 = time.perf_counter()
+        rep = EngineReplica(1, cfactory, None, artifact=art)
+        rep.engine.submit(list(range(1, 12)), 2)
+        while not rep.engine._finished:
+            rep.engine.step()
+        su_s = time.perf_counter() - t0
+        stats = rep.engine.compile_stats
+        fresh = {k: v for k, v in stats.items()
+                 if k.endswith("_compiles") and v}
+        assert stats["aot_programs"] > 0 and not fresh, (
+            f"scale-up must seed from the artifact, not compile: {stats}")
+        out["scale_up_ttft_us"] = round(su_s * 1e6, 1)
+        out["scale_up_build_us"] = round(rep.build_s * 1e6, 1)
+        out["scale_up_aot_programs"] = stats["aot_programs"]
+    return out
+
+
 # The reference's perf-shape table (test_ag_gemm_intra_node.py:153-160):
 # AG-GEMM M/N/K per model family, M = 8192 token rows.
 MODEL_SHAPES = {
@@ -2366,6 +2528,15 @@ def main(a2a_primary: bool = False):
         extras.update(bench_slo(ctx, **ssh))
 
     attempt("slo", _slo)
+
+    def _autoscale():
+        # elastic fleet vs the static-peak golden on the diurnal swing:
+        # result dicts asserted equal, replica-steps saved, per-class
+        # attainment, and the scale-up-to-first-token split off the AOT
+        # artifact with aot_programs > 0 asserted (ISSUE 18)
+        extras.update(bench_autoscale(ctx))
+
+    attempt("autoscale", _autoscale)
 
     def _aot():
         # persisted-artifact cold start vs fresh traces (>=10x on CPU,

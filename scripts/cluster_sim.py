@@ -8,6 +8,10 @@ request's trace verified bit-identical to its single-replica golden.
     python scripts/cluster_sim.py --requests 250000 --replicas 8
     python scripts/cluster_sim.py --requests 200 --engine colocated
     python scripts/cluster_sim.py --no-kill                # fault-free
+    python scripts/cluster_sim.py --autoscale --prefix-cache --lend \
+        --pages 129 --min-replicas 1 --max-replicas 4 \
+        --workload 'n=1500,rate=0.25,burst_every=300,burst_len=60,\
+burst_x=10,seed=7'                                         # ISSUE 18
 
 The default engine is ``SimEngine`` (serving/cluster.py): the REAL page
 ledger / scheduler / journal / checkpoint control plane with a closed-
@@ -114,6 +118,49 @@ p.add_argument("--slo", default=None, metavar="SPEC",
                help="per-replica multi-tenant SLO policy (ISSUE 14): "
                     "chat/batch WFQ weights + per-class overrides + "
                     "token-bucket quotas (see serve_sim --slo)")
+p.add_argument("--autoscale", action="store_true",
+               help="elastic fleet (ISSUE 18): start at --min-replicas "
+                    "and let the Autoscaler grow/shrink on windowed "
+                    "per-class SLO attainment, draining gracefully "
+                    "(journal-cursor requeue + lend-ahead) on the way "
+                    "down. Needs --workload (the attainment sensor is "
+                    "per-class); overrides --replicas, disables the "
+                    "default kill/restore schedule (inject crashes with "
+                    "--crash-mid-drain), and defaults --slo to the "
+                    "chat-priority WFQ policy so batch — not the "
+                    "latency-lagged chat signal — is the binding class. "
+                    "Prints an autoscale panel to stderr")
+p.add_argument("--min-replicas", type=int, default=1, metavar="N",
+               help="autoscale floor AND the starting fleet size")
+p.add_argument("--max-replicas", type=int, default=4, metavar="N",
+               help="autoscale ceiling; also the static-peak "
+                    "counterfactual the panel's replica-steps-saved "
+                    "row is measured against")
+p.add_argument("--slo-budget", default="chat:12,batch:20", metavar="SPEC",
+               help="per-class step-space budgets 'cls:ttft[/itl],...' "
+                    "the attainment windows police (parse_budgets)")
+p.add_argument("--slo-window", type=int, default=32, metavar="N",
+               help="attainment window: finished-request samples kept "
+                    "per (kind, class) series")
+p.add_argument("--slo-min-samples", type=int, default=6, metavar="N",
+               help="samples a series needs before it can drive scaling")
+p.add_argument("--cooldown", type=int, default=20, metavar="STEPS",
+               help="controller steps between membership changes "
+                    "(thrash control, with the up/down hysteresis band)")
+p.add_argument("--warm-steps", type=int, default=1, metavar="STEPS",
+               help="cluster steps a scale-up spends WARMING before it "
+                    "admits (models the artifact-load window)")
+p.add_argument("--spill-threshold", type=int, default=None, metavar="N",
+               help="router load spill threshold (default: 10 under "
+                    "--autoscale — affinity must not pin a template to "
+                    "an overloaded replica while peers sit idle — "
+                    "otherwise off)")
+p.add_argument("--crash-mid-drain", action="store_true",
+               help="kill the first replica observed DRAINING (once): "
+                    "the controller auto-restores it, journal replay "
+                    "requeues its live requests, the drain resumes and "
+                    "retires — and every trace must STILL verify "
+                    "bitwise (--autoscale only)")
 p.add_argument("--mesh", default=None, metavar="TPxSPxEP",
                help="run each colocated replica as a ShardedServingEngine "
                     "on this TP/SP/EP mesh serving the tiny MoE model "
@@ -144,6 +191,24 @@ if ((args.overlap != "off" or args.mesh is not None)
             "device programs to overlap)")
 if args.overlap != "off" and args.mesh is None:
     args.mesh = "1x1x1"
+if args.crash_mid_drain and not args.autoscale:
+    p.error("--crash-mid-drain needs --autoscale (only elastic drains "
+            "can crash mid-drain)")
+if args.autoscale:
+    if args.workload is None:
+        p.error("--autoscale needs --workload (the attainment sensor is "
+                "per-class; the template workload has no classes)")
+    if not 1 <= args.min_replicas <= args.max_replicas:
+        p.error("--autoscale needs 1 <= --min-replicas <= --max-replicas")
+    # chat-priority WFQ keeps chat TTFT flat through burst fronts, which
+    # makes BATCH the binding scaling class — reactive TTFT sensing lags
+    # by the TTFT itself, so the class that can wait must carry the lag
+    if args.slo is None:
+        args.slo = "chat_weight=4,batch_weight=1"
+    if args.spill_threshold is None:
+        args.spill_threshold = 10
+    args.replicas = args.min_replicas
+    args.no_kill = True     # fault injection is --crash-mid-drain here
 
 # multi-tenant SLO scheduling (ISSUE 14): both specs fail loudly NAMING
 # the bad field instead of silently replaying a default-shaped trace
@@ -162,6 +227,13 @@ if args.workload is not None:
     except ValueError as e:
         p.error(str(e))
     args.requests = workload_spec.n
+budgets = None
+if args.autoscale:
+    from triton_dist_tpu.serving.autoscaler import parse_budgets  # noqa: E402
+    try:
+        budgets = parse_budgets(args.slo_budget)
+    except (AssertionError, ValueError) as e:
+        p.error(f"--slo-budget: {e}")
 
 kill_at = args.kill_at if args.kill_at is not None else args.requests // 2
 restore_after = (args.restore_after if args.restore_after is not None
@@ -291,8 +363,18 @@ journal_dir = args.journal_dir or tempfile.mkdtemp(prefix="cluster-sim-")
 # artifact-transparency check at cluster scale
 cluster = Cluster(factory, replicas=args.replicas, journal_dir=journal_dir,
                   artifact=artifact, affinity=not args.no_affinity,
+                  spill_threshold=args.spill_threshold,
                   lend=args.lend, lend_deadline_steps=args.lend_deadline,
                   lend_retries=args.lend_retries)
+asc = None
+if args.autoscale:
+    from triton_dist_tpu.serving.autoscaler import Autoscaler  # noqa: E402
+    asc = Autoscaler(cluster, budgets, window=args.slo_window,
+                     min_samples=args.slo_min_samples,
+                     min_replicas=args.min_replicas,
+                     max_replicas=args.max_replicas,
+                     cooldown=args.cooldown, warm_steps=args.warm_steps,
+                     journal=Autoscaler.journal_path_for(journal_dir))
 
 reqs: dict[int, tuple[list[int], int]] = {}
 killed_step = restored_step = None
@@ -303,11 +385,35 @@ submitted = 0
 _t_first = None  # wall clock when the cluster's first token surfaced
 
 
+_crash_fired_at = None
+
+
+def _maybe_crash_mid_drain() -> None:
+    """Forced crash-mid-drain (once): kill the first DRAINING replica we
+    see; the controller's next tick restores it, journal replay requeues
+    its live requests, and the drain resumes."""
+    global _crash_fired_at
+    if not args.crash_mid_drain or _crash_fired_at is not None:
+        return
+    for rep in cluster.replicas:
+        if rep.draining and rep.engine is not None:
+            cluster.kill(rep.index)
+            _crash_fired_at = (rep.index, cluster._cluster_steps)
+            print(json.dumps({"crash_mid_drain": {
+                "replica": rep.index,
+                "at_step": cluster._cluster_steps}}), file=sys.stderr)
+            break
+
+
 def _step() -> None:
-    """cluster.step() + first-token clock (engine._finished is harvested
-    and cleared inside step, so the summary can't read it post-drain)."""
+    """cluster.step() + the controller tick + first-token clock
+    (engine._finished is harvested and cleared inside step, so the
+    summary can't read it post-drain)."""
     global _t_first
     cluster.step()
+    if asc is not None:
+        asc.step()
+        _maybe_crash_mid_drain()
     if _t_first is None and cluster._results:
         _t_first = time.perf_counter()
 
@@ -368,7 +474,18 @@ else:
             submitted += 1
             _maybe_kill_restore()
         _step()
-results = cluster.drain()
+if asc is not None:
+    # drain the tail with the controller still ticking: a crash-mid-drain
+    # landing near the end needs its auto-restore, and a quiet cluster
+    # step right after one is NOT quiescence — hence the idle debounce
+    idle = 0
+    while idle < 3:
+        idle = 0 if cluster.step() else idle + 1
+        asc.step()
+        _maybe_crash_mid_drain()
+    results = cluster.results()
+else:
+    results = cluster.drain()
 if _t_first is None and cluster._results:
     _t_first = time.perf_counter()
 wall = time.perf_counter() - t0
@@ -379,7 +496,7 @@ mismatched = [g for g, toks in results.items()
               if toks != golden(*reqs[g])]
 ok = not missing and not mismatched
 
-per_replica = [0] * args.replicas
+per_replica = [0] * len(cluster.replicas)   # elastic: may exceed seed N
 for gid, (ri, _) in cluster._placement.items():
     per_replica[ri] += 1
 if args.prefix_cache:
@@ -477,6 +594,83 @@ if args.engine == "colocated":
         "cold_start_to_first_token_s":
             None if _t_first is None else round(_t_first - _t_cold0, 4),
     }}), file=sys.stderr)
+
+if args.autoscale:
+    # autoscale panel (ISSUE 18): the fleet-size timeline against the
+    # offered rate, per-class attainment, the replica-steps-saved row
+    # against the static-peak counterfactual (a fleet of --max-replicas
+    # stepping every cluster step — the provisioning the autoscaler
+    # replaces; counterfactual, not a second run), and the scale-up-to-
+    # first-token split (replica build/artifact-load wall time vs fresh
+    # compiles — the latter must be zero with an artifact)
+    from triton_dist_tpu.serving.workload import rate_at  # noqa: E402
+    cm = cluster.metrics
+    csteps = cluster._cluster_steps
+    rsteps = cm.counters["replica_steps"]
+    static_peak = args.max_replicas * csteps
+    att_rows = {}
+    for _cls in sorted(budgets):
+        b_ttft, b_itl = budgets[_cls]
+        for _kind, _budget in (("ttft", b_ttft), ("itl", b_itl)):
+            if _budget is None:
+                continue
+            _key = (_kind, _cls)
+            if asc.attain.count(_key):
+                att_rows[f"{_kind}_{_cls}_attainment"] = round(
+                    asc.attain.attainment(_key, _budget), 3)
+        # whole-run step-space tail next to the windowed attainment — the
+        # window only remembers the newest --slo-window finishes
+        _h = cm.hist.get(cm.class_key("ttft_steps", _cls))
+        if _h is not None and _h.count:
+            att_rows[f"ttft_{_cls}_p99_steps"] = _h.percentile(99)
+    _bs = asc.scale_up_build_s
+    panel = {
+        "autoscale": True,
+        "min_replicas": args.min_replicas,
+        "max_replicas": args.max_replicas,
+        "fleet_final": cluster.lifecycle_counts(),
+        "scale_ups": cm.counters["scale_ups"],
+        "drains_done": cm.counters["drains_done"],
+        "retires": cm.counters["retires"],
+        "requeues": cm.counters["requeues"],
+        "lend_aheads": cm.counters["lend_aheads"],
+        "lend_ahead_pages": cm.counters["lend_ahead_pages"],
+        "lend_ahead_noops": cm.counters["lend_ahead_noops"],
+        "cluster_steps": csteps,
+        "replica_steps": rsteps,
+        "static_peak_replica_steps": static_peak,
+        "replica_steps_saved_pct": round(
+            100.0 * (1 - rsteps / max(static_peak, 1)), 1),
+        "warm_steps": args.warm_steps,
+        "scale_up_build_s_mean": None if not _bs
+        else round(sum(_bs) / len(_bs), 6),
+        **att_rows,
+        "controller_journal": None if asc.journal is None
+        else asc.journal.path,
+        "crash_mid_drain": None if not args.crash_mid_drain else (
+            None if _crash_fired_at is None
+            else {"replica": _crash_fired_at[0],
+                  "at_step": _crash_fired_at[1]}),
+        # every membership event with the offered rate at that step —
+        # rate_at is the SAME function the generator drew arrivals from,
+        # so the two timelines always agree
+        "timeline": [
+            {"step": s, "kind": k, "replica": i,
+             "offered_rate": rate_at(workload_spec, s)}
+            for s, k, i in cluster.scale_history],
+    }
+    if args.engine == "colocated":
+        # the split's other half: late joiners must seed from the
+        # artifact — fresh traces at scale-up time would put compile
+        # latency inside the scale-up-to-first-token window
+        _late = [r.engine for r in cluster.replicas
+                 if r.index >= args.min_replicas and r.engine is not None]
+        panel["scale_up_aot_programs"] = sum(
+            e.compile_stats.get("aot_programs", 0) for e in _late)
+        panel["scale_up_fresh_compiles"] = sum(
+            v for e in _late for k, v in e.compile_stats.items()
+            if k.endswith("_compiles"))
+    print(json.dumps(panel), file=sys.stderr)
 
 if args.mesh is not None:
     # overlap panel (ISSUE 16): fleet-aggregated per-step EP wire split

@@ -40,14 +40,21 @@ EP/SP overlap ops (see docs/serving.md).
 - workload   — bursty two-class trace generation (ISSUE 14): Zipf prompt
                sharing × chat-vs-batch × diurnal bursts, plus the
                --workload / --slo CLI spec parsers
+- autoscaler — elastic fleet control (ISSUE 18): a deterministic policy
+               loop over windowed per-class TTFT/ITL SLO attainment that
+               scales replicas up from the AOT artifact and down through
+               the graceful drain ladder (requeue, lend-ahead, retire),
+               journaling every decision so a controller restart resumes
+               the fleet from the journal
 """
 
+from triton_dist_tpu.serving.autoscaler import Autoscaler, parse_budgets
 from triton_dist_tpu.serving.checkpoint import (Checkpoint,
                                                 CheckpointIntegrityError,
                                                 capture, latest, restore)
 from triton_dist_tpu.serving.cluster import (Cluster, EngineReplica,
-                                             SimEngine, expected_tokens,
-                                             sim_token)
+                                             ReplicaState, SimEngine,
+                                             expected_tokens, sim_token)
 from triton_dist_tpu.serving.compose import DisaggShardedEngine
 from triton_dist_tpu.serving.deadline import (Backoff, Deadline,
                                               EngineStallError)
@@ -64,7 +71,8 @@ from triton_dist_tpu.serving.kv_pool import (KVPagePool, PageLedgerError,
                                              pages_to_cache,
                                              shard_pool_arrays)
 from triton_dist_tpu.serving.lending import PageLendingTier
-from triton_dist_tpu.serving.metrics import Histogram, ServingMetrics
+from triton_dist_tpu.serving.metrics import (AttainmentWindow, Histogram,
+                                             ServingMetrics)
 from triton_dist_tpu.serving.prefix_cache import (PrefixCache,
                                                   ReplicaPrefixIndex)
 from triton_dist_tpu.serving.scheduler import (AdmissionRejected, ClassSpec,
@@ -76,8 +84,8 @@ from triton_dist_tpu.serving.sharded import (MESH_AXES,
                                              ShardedServingEngine,
                                              serving_mesh)
 from triton_dist_tpu.serving.workload import (WorkloadSpec,
-                                              generate_arrivals,
-                                              parse_slo, parse_workload)
+                                              generate_arrivals, parse_slo,
+                                              parse_workload, rate_at)
 
 __all__ = [
     "ServingEngine",
@@ -89,7 +97,10 @@ __all__ = [
     "DisaggShardedEngine",
     "Cluster",
     "EngineReplica",
+    "ReplicaState",
     "SimEngine",
+    "Autoscaler",
+    "parse_budgets",
     "PageLendingTier",
     "expected_tokens",
     "sim_token",
@@ -117,6 +128,7 @@ __all__ = [
     "parse_workload",
     "generate_arrivals",
     "parse_slo",
+    "rate_at",
     "KVPagePool",
     "PageLedgerError",
     "PrefixCache",
@@ -129,4 +141,5 @@ __all__ = [
     "RequestState",
     "ServingMetrics",
     "Histogram",
+    "AttainmentWindow",
 ]

@@ -1,0 +1,255 @@
+"""Elastic autoscaling for the replica fleet (ISSUE 18).
+
+The static fleet serves the ISSUE 14 diurnal swing at peak provisioning
+or not at all. This module closes that gap with a deterministic policy
+loop over the cluster's per-class SLO attainment: grow the fleet when a
+class's windowed TTFT/ITL attainment falls below the scale-up threshold,
+drain the highest-index replica when attainment is comfortably above the
+scale-down threshold AND the survivors can seat the current load. Like
+T3's contract (PAPERS.md), the controller may change the *schedule* —
+here, fleet membership — but never the observable outputs: every request
+trace stays bitwise identical to the closed-form golden through any
+schedule of scale-ups, drains and crashes, because membership changes
+only move WHERE a prompt re-earns its KV, never WHAT the deterministic
+decode produces from it.
+
+The sensing is :class:`~triton_dist_tpu.serving.metrics.AttainmentWindow`
+over the cluster's step-space latency feed — engine steps, not wall
+clock, so the same trace always yields the same decisions. Thrash
+control is hysteresis (separate up/down thresholds with a dead band
+between them) plus a cooldown after every membership change, so a burst
+front triggers ONE scale-up, not one per bad sample.
+
+Scale-up spins an :class:`EngineReplica` from the PR 15 AOT artifact
+mid-run: the new engine reaches its first token with zero fresh traces
+(``aot_programs`` asserted in the bench), so scale-up-to-first-token is
+dominated by artifact load, not compilation. Scale-down runs the
+graceful ladder in cluster.py: ``DRAINING`` stops admission, queued
+requests requeue through the journal cursor, in-flight decodes finish in
+place, hot prefixes lend ahead to their rendezvous successors
+(lending.py), and only then the replica retires.
+
+Every decision is journaled (``scale_up``/``drain_begin``/``drain_done``
+/``retire`` — journal.py) through a controller-private ControlJournal,
+so a controller crash loses nothing: :meth:`Autoscaler.resume` reloads
+the journal, re-adopts the fleet view and the cooldown clock, and the
+policy loop continues where it stopped. Replica crashes compose with the
+PR 12 machinery — a replica that dies mid-drain is auto-restored
+(journal replay requeues its live requests) and its drain resumes.
+"""
+
+from __future__ import annotations
+
+import os
+
+from triton_dist_tpu.serving.cluster import ReplicaState
+from triton_dist_tpu.serving.journal import ControlJournal
+from triton_dist_tpu.serving.kv_pool import _fnv1a
+from triton_dist_tpu.serving.metrics import AttainmentWindow
+
+__all__ = ["Autoscaler", "parse_budgets"]
+
+# scale_history kinds the controller journals; kill/restore ride the
+# replica's own journal, warm promotion is implicit in the scale_up step
+_JOURNALED = ("scale_up", "drain_begin", "drain_done", "retire")
+
+
+def parse_budgets(spec: str) -> dict[str, tuple[int, int | None]]:
+    """Parse a CLI budget spec: ``cls:ttft[/itl][,cls:ttft[/itl]]`` with
+    budgets in engine steps — e.g. ``chat:8/2,batch:64``. Step space,
+    like every other SLO knob here: deterministic and replay-stable."""
+    out: dict[str, tuple[int, int | None]] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        cls, _, bud = part.partition(":")
+        assert bud, f"budget spec {part!r} needs cls:ttft[/itl]"
+        ttft, _, itl = bud.partition("/")
+        out[cls.strip()] = (int(ttft), int(itl) if itl else None)
+    return out
+
+
+class Autoscaler:
+    """Deterministic policy loop over one :class:`Cluster`.
+
+    ``budgets`` maps class label -> TTFT budget in engine steps, or
+    ``(ttft, itl)`` tuples to police inter-token latency too. The fleet
+    attainment is the MINIMUM attainment across every budgeted series
+    with at least ``min_samples`` observations in the window — the worst
+    class drives scaling, which is what a per-class SLO means.
+
+    Call :meth:`step` once per cluster step, AFTER ``cluster.step()``.
+    """
+
+    def __init__(self, cluster, budgets, *, window: int = 128,
+                 min_samples: int = 8, min_replicas: int = 1,
+                 max_replicas: int = 8, up_below: float = 0.9,
+                 down_above: float = 0.98, cooldown: int = 64,
+                 warm_steps: int = 1,
+                 journal: "ControlJournal | str | None" = None):
+        assert 1 <= min_replicas <= max_replicas
+        assert 0.0 < up_below <= down_above <= 1.0, (
+            "hysteresis needs up_below <= down_above — a dead band, "
+            "not an oscillator")
+        assert cooldown >= 1 and window >= 1
+        self.cluster = cluster
+        self.budgets = {
+            cls: (b if isinstance(b, tuple) else (int(b), None))
+            for cls, b in budgets.items()}
+        assert self.budgets, "at least one class budget required"
+        self.window = window
+        self.min_samples = min_samples
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.up_below = up_below
+        self.down_above = down_above
+        self.cooldown = cooldown
+        self.warm_steps = warm_steps
+        if isinstance(journal, str):
+            journal = ControlJournal(path=journal)
+        self.journal = journal
+        self.attain = AttainmentWindow(window)
+        self.decisions: list[tuple[int, str, int]] = []
+        self.scale_up_build_s: list[float] = []
+        self._now = 0
+        self._last_event = -cooldown    # first decision needs no warmup
+        self._hcursor = 0               # cluster.scale_history high-water
+
+    # -- sensing -----------------------------------------------------------
+    def _ingest(self) -> None:
+        for cls, ttft, itl in self.cluster.drain_latency_feed():
+            self.attain.observe(("ttft", cls), ttft)
+            if itl is not None:
+                self.attain.observe(("itl", cls), itl)
+
+    def attainment(self) -> float | None:
+        """Worst windowed attainment across budgeted series with enough
+        samples; None until any budgeted class has ``min_samples``."""
+        worst = None
+        for cls, (b_ttft, b_itl) in self.budgets.items():
+            for kind, budget in (("ttft", b_ttft), ("itl", b_itl)):
+                if budget is None:
+                    continue
+                key = (kind, cls)
+                if self.attain.count(key) < self.min_samples:
+                    continue
+                a = self.attain.attainment(key, budget)
+                worst = a if worst is None else min(worst, a)
+        return worst
+
+    # -- journal -----------------------------------------------------------
+    def _digest(self) -> int:
+        counts = self.cluster.lifecycle_counts()
+        return _fnv1a(0x811C9DC5, len(self.cluster.replicas),
+                      *(counts.get(s.value, 0) for s in ReplicaState))
+
+    def _journal_history(self) -> None:
+        """Journal every new cluster scale event (cursor-read: manual
+        drains in tests/sims land in the controller journal too).
+        ``hseq`` — the event's index in ``cluster.scale_history`` — is
+        what resume() rebuilds the cursor from."""
+        hist = self.cluster.scale_history
+        while self._hcursor < len(hist):
+            cstep, kind, index = hist[self._hcursor]
+            if self.journal is not None and kind in _JOURNALED:
+                self.journal.append(kind, self._now, self._digest(),
+                                    replica=index, cluster_step=cstep,
+                                    hseq=self._hcursor)
+            self._hcursor += 1
+
+    # -- the policy step ---------------------------------------------------
+    def step(self) -> tuple[str, int] | None:
+        """One controller tick: sense, journal, heal, decide. Returns
+        the decision taken this tick (kind, replica index) or None."""
+        self._now += 1
+        c = self.cluster
+        self._ingest()
+        self._journal_history()
+        # crash-mid-drain fallback (PR 12 ladder): a replica that died
+        # DRAINING is restored — journal replay requeues its live
+        # requests, the drain pass moves them to peers, it retires
+        for rep in c.replicas:
+            if (rep.lifecycle is ReplicaState.KILLED
+                    and rep._prekill is ReplicaState.DRAINING):
+                c.restore(rep.index)
+                self._journal_history()
+        if self._now - self._last_event < self.cooldown:
+            return None
+        att = self.attainment()
+        if att is None:
+            return None
+        active = [r for r in c.replicas if r.admitting]
+        warming = [r for r in c.replicas
+                   if r.lifecycle is ReplicaState.WARMING]
+        fleet = len(active) + len(warming)   # capacity present or en route
+        if att < self.up_below and fleet < self.max_replicas:
+            rep = c.add_replica(warm_steps=self.warm_steps)
+            self.scale_up_build_s.append(rep.build_s)
+            self._last_event = self._now
+            self._journal_history()
+            self.decisions.append((self._now, "scale_up", rep.index))
+            return ("scale_up", rep.index)
+        if (att >= self.down_above and not warming
+                and len(active) > self.min_replicas
+                and self._can_drain(active)):
+            victim = max(active, key=lambda r: r.index)
+            c.begin_drain(victim.index)
+            self._last_event = self._now
+            self._journal_history()
+            self.decisions.append((self._now, "drain_begin", victim.index))
+            return ("drain_begin", victim.index)
+        return None
+
+    def _can_drain(self, active) -> bool:
+        """Only drain when the survivors can SEAT the fleet's current
+        load — attainment says the SLO is met, this says removing a
+        replica won't immediately un-meet it (the down-side half of the
+        hysteresis dead band)."""
+        load = sum(r.load for r in active)
+        slots = sum(r._sched.num_slots for r in active)
+        victim_slots = max(active, key=lambda r: r.index)._sched.num_slots
+        return load <= slots - victim_slots
+
+    # -- controller restart ------------------------------------------------
+    @classmethod
+    def resume(cls, cluster, journal_path: str, budgets, **kw
+               ) -> "Autoscaler":
+        """Rebuild a controller from its journal after a crash: reload
+        the scale-event log, re-attach the append handle (same ladder as
+        EngineReplica.restore), and re-adopt the fleet view — the
+        history cursor from the newest ``hseq``, the cooldown clock from
+        the newest event's controller step. The attainment window starts
+        empty (latency samples are re-earned, like KV — the cooldown
+        carried over keeps the fresh window from thrashing), and the
+        cluster's lifecycle states are cross-checked against what the
+        journal says retired."""
+        j = ControlJournal.load(journal_path)
+        j.path = journal_path
+        j._fh = open(journal_path, "a", encoding="utf-8")
+        asc = cls(cluster, budgets, journal=j, **kw)
+        retired_in_journal: set[int] = set()
+        for e in j.entries:
+            if e["kind"] not in _JOURNALED:
+                continue
+            asc._now = max(asc._now, e["step"])
+            asc._last_event = max(asc._last_event, e["step"])
+            asc._hcursor = max(asc._hcursor, e["hseq"] + 1)
+            asc.decisions.append((e["step"], e["kind"], e["replica"]))
+            if e["kind"] == "retire":
+                retired_in_journal.add(e["replica"])
+        # the journal is the controller's truth — every replica it
+        # recorded retired must actually be out of the fleet
+        for i in retired_in_journal:
+            assert cluster.replicas[i].lifecycle is ReplicaState.RETIRED, (
+                f"journal says replica {i} retired but cluster has it "
+                f"{cluster.replicas[i].lifecycle.value}")
+        # events the dead controller never journaled replay through the
+        # cursor on the next step() — nothing is lost, nothing doubled
+        return asc
+
+    @staticmethod
+    def journal_path_for(journal_dir: str) -> str:
+        """The controller's private journal path, namespaced beside the
+        replicas' ``journal-r{i}.jsonl`` files."""
+        return os.path.join(journal_dir, "journal-controller.jsonl")

@@ -20,6 +20,8 @@ Restore pipeline::
         submit  -> engine.submit(...)      re-enqueue post-snapshot arrivals
         finish  -> tokens from the entry   settle post-snapshot completions
         reject/expire/fail -> terminals    re-settle typed terminals
+        requeue -> drop from queue         the request moved to a peer replica
+                                           during an elastic drain (ISSUE 18)
         ▼
     engine._steps = max(S, last entry step); decode resumes
 
@@ -198,6 +200,13 @@ def restore(engine: Any, ckpt: Checkpoint | None,
             elif kind in ("reject", "expire", "fail"):
                 engine._restore_terminal(e["rid"], kind, e.get("reason", ""),
                                          e.get("error_type"))
+                replayed += 1
+            elif kind == "requeue":
+                # elastic drain (ISSUE 18): the request moved to a peer
+                # replica AFTER its submit was journaled here — drop it
+                # from the replayed queue or the restored engine would
+                # serve a request the cluster already re-placed
+                engine._pop_queued(e["rid"])
                 replayed += 1
             # admit/chunk/grow/preempt/handoff/migrate/checkpoint/restore/
             # digest_divergence entries carry no state restore needs: slot

@@ -44,6 +44,7 @@ requests against it bitwise.
 
 from __future__ import annotations
 
+import enum
 import os
 import time
 from collections import deque
@@ -639,6 +640,28 @@ class SimEngine:
         return list(self._failed) + list(self._rejected)
 
 
+class ReplicaState(enum.Enum):
+    """Replica lifecycle (ISSUE 18). The router admits only ACTIVE
+    replicas; DRAINING replicas keep stepping (they finish in-flight
+    decodes and may still LEND — that is drain-time lend-ahead) but
+    receive no new work; WARMING replicas exist (their engine is built,
+    the AOT artifact loaded) but neither admit nor step until the
+    cluster promotes them; KILLED is the crash state (engine gone,
+    journal on disk is the surviving truth — restore() returns the
+    replica to whatever it was doing when it died, which is how a crash
+    mid-drain resumes the drain rather than resurrecting an admitting
+    replica); RETIRED is terminal — a drain completed, the journal
+    closed, the engine dropped. Fleet indices are append-only: a retired
+    index is never reused, so journal paths and rendezvous scores stay
+    stable across any schedule of scale events."""
+
+    WARMING = "warming"
+    ACTIVE = "active"
+    DRAINING = "draining"
+    RETIRED = "retired"
+    KILLED = "killed"
+
+
 class EngineReplica:
     """One engine + one PRIVATE journal + one failure domain.
 
@@ -659,9 +682,39 @@ class EngineReplica:
                                           f"journal-r{index}.jsonl")
                              if journal_dir is not None else None)
         self.journal = ControlJournal(path=self.journal_path)
+        self.lifecycle = ReplicaState.WARMING
+        t0 = time.perf_counter()
         self.engine = self._build(self.journal)
-        self.alive = True
+        # scale-up-to-first-token split: with an AOT artifact threaded
+        # the build is dominated by artifact load, not tracing — the
+        # number cluster_sim's autoscale panel reports per scale-up
+        self.build_s = time.perf_counter() - t0
+        self.lifecycle = ReplicaState.ACTIVE
+        self.warm_remaining = 0
         self.failovers = 0
+        # crash bookkeeping: what the replica was doing when kill() hit
+        # (restore() resumes THAT state — a crash mid-drain must come
+        # back DRAINING, not admitting) and, for a drain interrupted by
+        # a crash, the kill-time tombstones finish_drain still lends
+        # ahead (prune already ran at kill time, so the drain-completion
+        # prune would otherwise find nothing to hand off)
+        self._prekill = ReplicaState.ACTIVE
+        self._drain_prefixes: list[tuple[int, ...]] = []
+
+    @property
+    def alive(self) -> bool:
+        """An engine exists and can step/lend. DRAINING and WARMING
+        replicas are alive — only KILLED and RETIRED are not."""
+        return self.engine is not None
+
+    @property
+    def admitting(self) -> bool:
+        """The router's gate: only ACTIVE replicas receive new work."""
+        return self.lifecycle is ReplicaState.ACTIVE
+
+    @property
+    def draining(self) -> bool:
+        return self.lifecycle is ReplicaState.DRAINING
 
     def _build(self, journal):
         """AOT artifact (ISSUE 15): thread the artifact through BOTH the
@@ -713,11 +766,15 @@ class EngineReplica:
 
     def kill(self) -> None:
         """Fail the replica: close the journal's append handle (the
-        on-disk jsonl is the surviving truth) and drop the engine."""
+        on-disk jsonl is the surviving truth) and drop the engine.
+        Legal in ANY alive state — killing a DRAINING replica is the
+        crash-mid-drain case, and ``_prekill`` remembers the state so
+        restore() resumes the drain instead of re-admitting."""
         assert self.alive, f"replica {self.index} is already dead"
+        self._prekill = self.lifecycle
         self.journal.close()
         self.engine = None
-        self.alive = False
+        self.lifecycle = ReplicaState.KILLED
         self.failovers += 1
 
     def restore(self) -> dict:
@@ -725,8 +782,12 @@ class EngineReplica:
         path-backed), rebuild a fresh engine through the factory, restore
         from the newest checkpoint — or replay the ENTIRE journal when
         none was cut — then re-attach the append handle so post-restore
-        events keep journaling to the same file."""
-        assert not self.alive, f"replica {self.index} is alive"
+        events keep journaling to the same file. The replica comes back
+        in its pre-kill lifecycle state: a crash mid-drain resumes
+        DRAINING (replay requeues its live requests, the cluster's drain
+        pass hands them to peers and retires it), never admitting."""
+        assert self.lifecycle is ReplicaState.KILLED, \
+            f"replica {self.index} is not killed"
         if self.journal_path is not None:
             j = ControlJournal.load(self.journal_path)
             # .load() returns an in-memory journal: re-attach the file so
@@ -738,8 +799,18 @@ class EngineReplica:
         self.journal = j
         self.engine = self._build(j)
         stats = ckpt_mod.restore(self.engine, ckpt_mod.latest(j), j)
-        self.alive = True
+        self.lifecycle = self._prekill
         return stats
+
+    def retire(self) -> None:
+        """Terminal exit of a completed drain: close the journal, drop
+        the engine. Unlike kill() there is nothing to restore — every
+        request either finished (harvested) or was requeued to a peer."""
+        assert self.lifecycle is ReplicaState.DRAINING, \
+            f"replica {self.index} is not draining"
+        self.journal.close()
+        self.engine = None
+        self.lifecycle = ReplicaState.RETIRED
 
 
 class Cluster:
@@ -755,6 +826,11 @@ class Cluster:
                  lend_plan: "faults.FaultPlan | None" = None,
                  lend_deadline_steps: int = 4, lend_retries: int = 2):
         assert replicas >= 1
+        # kept for elastic scale-up: add_replica() builds late joiners
+        # through the same factory/journal_dir/artifact as the seed fleet
+        self._factory = factory
+        self._journal_dir = journal_dir
+        self._artifact = artifact
         self.replicas = [EngineReplica(i, factory, journal_dir,
                                        artifact=artifact)
                          for i in range(replicas)]
@@ -785,23 +861,65 @@ class Cluster:
             self.lending = None
         self._placement: dict[int, tuple[int, int]] = {}  # gid -> (ri, rid)
         self._rindex: dict[tuple[int, int], int] = {}     # (ri, rid) -> gid
-        self._requests: dict[int, tuple[tuple[int, ...], int]] = {}
+        # gid -> (prompt, max_new_tokens, tenant, cls): enough to re-place
+        # the request on a peer when its replica drains (ISSUE 18)
+        self._requests: dict[
+            int, tuple[tuple[int, ...], int, str | None, str | None]] = {}
         self._results: dict[int, list[int]] = {}
         self._failed: set[int] = set()
         self._next_gid = 0
+        # elastic autoscaling (ISSUE 18): every membership event, append-
+        # only — (cluster_step, kind, replica index). The Autoscaler
+        # journals from this feed (cursor-read, so manual scale events in
+        # tests/sims are journaled too); panels read it whole.
+        self.scale_history: list[tuple[int, str, int]] = []
+        # per-finish (cls, ttft_steps, itl_steps|None) — the autoscaler's
+        # attainment sensor drains this; bounded so a run without an
+        # autoscaler attached never grows it past the window
+        self._latency_feed: deque = deque(maxlen=4096)
+        self._cluster_steps = 0
+
+    @property
+    def admitting_replicas(self) -> list[EngineReplica]:
+        """The router's candidate set: ACTIVE replicas only. Draining,
+        warming, killed and retired replicas are all distinguishable
+        here — none admit, but DRAINING ones still step and lend."""
+        return [r for r in self.replicas if r.admitting]
+
+    def lifecycle_counts(self) -> dict[str, int]:
+        """Fleet composition by lifecycle state (panel/debug summary)."""
+        out: dict[str, int] = {}
+        for r in self.replicas:
+            out[r.lifecycle.value] = out.get(r.lifecycle.value, 0) + 1
+        return out
+
+    def rendezvous_owner(self, prompt) -> int:
+        """Load-free rendezvous winner for ``prompt`` over the current
+        admitting set — the pure hash placement, no affinity index, no
+        load tie-break. This is the function whose stability under
+        membership change the O(1/N) churn tests pin: adding or removing
+        one replica moves only the keys the new replica wins (or the
+        removed replica owned), ≈ 1/N of a fixed population."""
+        prompt = tuple(int(t) for t in prompt)
+        key = prompt[:self.prefix_tokens] if self.affinity else prompt
+        cands = self.admitting_replicas
+        assert cands, "no admitting replicas"
+        return max(cands, key=lambda r: (
+            _fnv1a(0x811C9DC5, r.index, *key), -r.index)).index
 
     def route(self, prompt) -> EngineReplica:
         """Longest radix-index hit wins (the deepest run's replica most
         likely holds the prefix KV); rendezvous hashing with least-loaded
-        tie-break handles misses and dead affinity targets. Pure function
-        of (index state, alive set, prompt, load) — still deterministic."""
+        tie-break handles misses and non-admitting affinity targets. Pure
+        function of (index state, admitting set, prompt, load) — still
+        deterministic through any schedule of scale events."""
         prompt = tuple(int(t) for t in prompt)
-        alive = [r for r in self.replicas if r.alive]
-        assert alive, "no alive replicas"
+        cands = self.admitting_replicas
+        assert cands, "no admitting replicas"
         owner = None
         if self.affinity:
             _, owner = self.prefix_index.match(prompt)
-        if owner is not None and self.replicas[owner].alive:
+        if owner is not None and self.replicas[owner].admitting:
             pick = self.replicas[owner]
             self.metrics.inc("router_radix_hits")
         else:
@@ -812,17 +930,21 @@ class Cluster:
             # the lending tier must absorb (the ISSUE 17 acceptance:
             # cluster hit rate ≈ single-replica hit rate even then)
             key = prompt[:self.prefix_tokens] if self.affinity else prompt
-            pick = max(alive, key=lambda r: (
+            pick = max(cands, key=lambda r: (
                 _fnv1a(0x811C9DC5, r.index, *key),
                 -r.load, -r.index))
             self.metrics.inc("router_radix_misses")
         if (self.spill_threshold is not None
                 and pick.load > self.spill_threshold):
-            pick = min(alive, key=lambda r: (r.load, r.index))
+            pick = min(cands, key=lambda r: (r.load, r.index))
         return pick
 
-    def submit(self, prompt, max_new_tokens: int,
-               tenant: str | None = None, cls: str | None = None) -> int:
+    def _place(self, gid: int, prompt, max_new_tokens: int,
+               tenant: str | None, cls: str | None) -> EngineReplica:
+        """Route + lend + index + submit + book one request under an
+        existing gid — the shared tail of submit() and the drain-time
+        requeue (which re-places a moved request under its ORIGINAL
+        gid, so callers' handles survive the move)."""
         rep = self.route(prompt)
         if self.lending is not None:
             # borrower-side pre-warm (ISSUE 17): if a PEER owns this
@@ -835,26 +957,57 @@ class Cluster:
         # that actually received it, existing runs keep their owner
         self.prefix_index.insert(tuple(int(t) for t in prompt), rep.index)
         rid = rep.submit(prompt, max_new_tokens, tenant=tenant, cls=cls)
-        gid = self._next_gid
-        self._next_gid += 1
         self._placement[gid] = (rep.index, rid)
         self._rindex[(rep.index, rid)] = gid
         self._requests[gid] = (tuple(int(t) for t in prompt),
-                               max_new_tokens)
+                               max_new_tokens, tenant, cls)
+        return rep
+
+    def submit(self, prompt, max_new_tokens: int,
+               tenant: str | None = None, cls: str | None = None) -> int:
+        gid = self._next_gid
+        self._next_gid += 1
+        self._place(gid, prompt, max_new_tokens, tenant, cls)
         self.metrics.inc("requests_submitted")
         return gid
 
     def step(self) -> bool:
         progressed = False
+        # warming → active: promotion is a cluster-step event, so a
+        # scale-up becomes routable at a deterministic point in the trace
+        # (warm_remaining models the artifact-load window in step space)
         for rep in self.replicas:
-            if rep.alive:
+            if rep.lifecycle is ReplicaState.WARMING:
+                rep.warm_remaining -= 1
+                if rep.warm_remaining <= 0:
+                    rep.lifecycle = ReplicaState.ACTIVE
+                    progressed = True
+        stepped = 0
+        for rep in self.replicas:
+            if rep.alive and rep.lifecycle is not ReplicaState.WARMING:
                 progressed |= rep.step()
+                stepped += 1
+        self.metrics.inc("replica_steps", stepped)
+        self._cluster_steps += 1
+        self.metrics.observe("fleet_size", sum(
+            1 for r in self.replicas if r.lifecycle in
+            (ReplicaState.ACTIVE, ReplicaState.WARMING)))
         self._harvest()
+        # drain pass: a DRAINING replica sheds its queue every step (the
+        # journal-cursor requeue — normally once at drain_begin, again
+        # after a crash-mid-drain restore replays its live requests) and
+        # retires the step it reaches quiescence
+        for rep in self.replicas:
+            if rep.draining and rep.engine is not None:
+                progressed |= self._requeue_queued(rep) > 0
+                if rep.idle:
+                    self._finish_drain(rep)
+                    progressed = True
         return progressed
 
     def _harvest(self) -> None:
         for rep in self.replicas:
-            if not rep.alive:
+            if rep.engine is None:
                 continue
             fin = rep.engine._finished
             if fin:
@@ -869,6 +1022,7 @@ class Cluster:
                             self.metrics.observe(
                                 "ttft_s",
                                 req.first_token_time - req.submit_time)
+                        self._observe_latency(req)
                     self._results[gid] = list(req.generated)
                 rep.engine._finished = []
             for req in rep.engine.failed:
@@ -877,9 +1031,136 @@ class Cluster:
                     self._failed.add(gid)
                     self.metrics.inc("failed_requests")
 
+    def _observe_latency(self, req) -> None:
+        """Deterministic step-space TTFT/ITL for one first-time finish —
+        the per-class series the autoscaler's attainment windows sample
+        (engine-local steps: both stamps come off the same clock, so a
+        requeued request measures from its re-placement)."""
+        if req.first_token_step is None or req.submit_step is None:
+            return
+        cls = getattr(req, "cls", None) or "default"
+        ttft = req.first_token_step - req.submit_step
+        self.metrics.observe("ttft_steps", ttft)
+        self.metrics.observe_class("ttft_steps", cls, ttft)
+        itl = None
+        fin_step = getattr(req, "finish_step", None)
+        if fin_step is not None and len(req.generated) > 1:
+            itl = ((fin_step - req.first_token_step)
+                   / (len(req.generated) - 1))
+            self.metrics.observe("itl_steps", itl)
+            self.metrics.observe_class("itl_steps", cls, itl)
+        self._latency_feed.append((cls, ttft, itl))
+
+    # -- elastic membership (ISSUE 18) -------------------------------------
+    def _scale_event(self, kind: str, index: int) -> None:
+        self.scale_history.append((self._cluster_steps, kind, index))
+
+    def add_replica(self, warm_steps: int = 0) -> EngineReplica:
+        """Grow the fleet: build a late joiner through the same factory
+        (and AOT artifact — it reaches its first token with zero fresh
+        traces, which is what makes mid-run scale-up affordable) under
+        the next never-used index. The replica joins WARMING and is
+        promoted to ACTIVE ``warm_steps`` cluster steps later (0 = the
+        next step), so the membership change lands at a deterministic
+        point in the trace."""
+        assert warm_steps >= 0
+        rep = EngineReplica(len(self.replicas), self._factory,
+                            self._journal_dir, artifact=self._artifact)
+        rep.lifecycle = ReplicaState.WARMING
+        rep.warm_remaining = warm_steps
+        self.replicas.append(rep)
+        self.metrics.inc("scale_ups")
+        self.metrics.observe("scale_up_build_s", rep.build_s)
+        self._scale_event("scale_up", rep.index)
+        return rep
+
+    def begin_drain(self, index: int) -> int:
+        """Start a graceful drain: the replica stops admitting NOW and
+        its queued (never-admitted) requests move to peers immediately —
+        each one re-routed under its original gid, journaled as a
+        ``requeue`` on the source engine so a crash after the move never
+        re-serves it. In-flight PREFILLING/ACTIVE slots finish where
+        they sit (their KV exists only there; determinism means a peer
+        would regenerate identical tokens, but letting them run costs no
+        correctness and no handoff). step()'s drain pass retires the
+        replica at quiescence. Returns the number of requests moved."""
+        rep = self.replicas[index]
+        assert rep.admitting, (
+            f"replica {index} is {rep.lifecycle.value}, not active")
+        assert any(r.admitting and r.index != index for r in self.replicas), \
+            "cannot drain the last admitting replica"
+        rep.lifecycle = ReplicaState.DRAINING
+        self.metrics.inc("drains_begun")
+        self._scale_event("drain_begin", index)
+        return self._requeue_queued(rep)
+
+    def _requeue_queued(self, rep: EngineReplica) -> int:
+        """The journal-cursor requeue: pop every QUEUED request off the
+        draining replica's intake (admitted slots stay — they finish
+        in place) and re-place it on an admitting peer under the same
+        gid. KV is never moved — the peer re-earns it from the prompt
+        and the determinism contract regenerates identical tokens, the
+        same restart-from-prompt argument restore runs on."""
+        sched = rep._sched
+        moved = 0
+        # snapshot: _place mutates nothing on THIS replica, but pop first
+        # so a reroute back here (impossible — it no longer admits) or an
+        # assert can't leave the queue half-walked
+        queued = list(sched.queue)
+        for req in queued:
+            gid = self._rindex.pop((rep.index, req.rid), None)
+            if gid is None:
+                continue    # replay artifact not booked here — drop
+            sched.queue.remove(req)
+            del self._placement[gid]
+            rep.engine._jlog("requeue", rid=req.rid)
+            prompt, mnt, tenant, cls = self._requests[gid]
+            self._place(gid, prompt, mnt, tenant, cls)
+            moved += 1
+        if moved:
+            self.metrics.inc("requeues", moved)
+        return moved
+
+    def _successor_of(self, prefix) -> EngineReplica | None:
+        """Rendezvous successor for a drained prefix: the admitting
+        replica that wins the SAME key route() would hash once the
+        drainee is gone — so lend-ahead lands pages exactly where the
+        prefix's future traffic will rendezvous."""
+        prefix = tuple(int(t) for t in prefix)
+        key = prefix[:self.prefix_tokens] if self.affinity else prefix
+        cands = self.admitting_replicas
+        if not cands:
+            return None
+        return max(cands, key=lambda r: (
+            _fnv1a(0x811C9DC5, r.index, *key), -r.index))
+
+    def _finish_drain(self, rep: EngineReplica) -> None:
+        """Quiescence reached: hand the drainee's hot prefix-index
+        entries to their rendezvous successors (drain-time lend-ahead,
+        the PR 17 surface pushed instead of pulled), prune what could
+        not move, retire."""
+        # prune returns the drainee's owned prefixes; a crash-mid-drain
+        # already pruned at kill time and stashed them on the replica
+        tombs = list(rep._drain_prefixes)
+        rep._drain_prefixes = []
+        tombs += self.prefix_index.prune(rep.index)
+        if self.lending is not None and tombs:
+            placed = self.lending.lend_ahead(rep, tombs,
+                                             self._successor_of)
+            for prefix, succ in placed.items():
+                # the successor now holds the pages warm — point the
+                # index at it so the very next route() radix-hits there
+                self.prefix_index.reassign(prefix, succ)
+        rep.retire()
+        self.metrics.inc("drains_done")
+        self.metrics.inc("retires")
+        self._scale_event("drain_done", rep.index)
+        self._scale_event("retire", rep.index)
+
     def kill(self, index: int) -> None:
         self.replicas[index].kill()
         self.metrics.inc("faults_injected")
+        self._scale_event("kill", index)
         # ISSUE 17 satellite: a dead replica's pages are gone — prune its
         # index entries so neither the router nor the lending tier targets
         # them, and stash the tombstoned prefixes for restore-time re-warm
@@ -888,7 +1169,18 @@ class Cluster:
     def restore(self, index: int) -> dict:
         stats = self.replicas[index].restore()
         self.metrics.inc("restores")
+        self._scale_event("restore", index)
         tombs = self._tombstones.pop(index, [])
+        if self.replicas[index].draining:
+            # crash-mid-drain fallback: the replica came back DRAINING —
+            # it will never admit again, so re-warming its cache or
+            # re-registering its index entries would aim traffic at a
+            # retiree. Stash the kill-time tombstones instead: the drain
+            # pass requeues the replayed queue to peers and finish_drain
+            # lends THESE prefixes ahead to their successors.
+            self.replicas[index]._drain_prefixes = tombs
+            self._harvest()   # replayed finishes reappear — re-record
+            return stats
         if self.lending is not None and tombs:
             # re-warm the restored replica's cache from peers instead of
             # letting every shared prefix re-prefill cold (deepest-first:
@@ -931,6 +1223,13 @@ class Cluster:
     def failed_gids(self) -> set[int]:
         return set(self._failed)
 
+    def drain_latency_feed(self) -> list[tuple[str, int, float | None]]:
+        """Drain the per-finish (cls, ttft_steps, itl_steps) feed — the
+        autoscaler's attainment sensor calls this once per step."""
+        out = list(self._latency_feed)
+        self._latency_feed.clear()
+        return out
 
-__all__ = ["Cluster", "EngineReplica", "SimEngine", "expected_tokens",
-           "sim_token", "SIM_VOCAB"]
+
+__all__ = ["Cluster", "EngineReplica", "ReplicaState", "SimEngine",
+           "expected_tokens", "sim_token", "SIM_VOCAB"]

@@ -30,6 +30,16 @@ Event kinds written by the engines:
 ``checkpoint``     full engine snapshot (``state`` payload + ``journal_seq``
                    high-water mark); see :mod:`serving.checkpoint`
 ``restore``        a restore completed (replayed entry count)
+``requeue``        elastic drain (ISSUE 18): a queued request left THIS
+                   engine for a peer replica (rid) — replay drops it so a
+                   post-requeue crash never re-serves a moved request
+``scale_up``       controller: a replica was added to the fleet (replica,
+                   fleet, attainment)
+``drain_begin``    controller: a replica stopped admitting and began its
+                   graceful drain (replica, requeued)
+``drain_done``     controller: a drain reached quiescence — in-flight work
+                   finished or requeued, lend-ahead ran (replica)
+``retire``         controller: the drained replica left the fleet (replica)
 =================  ============================================================
 
 Entries are plain JSON-able dicts ``{"seq", "step", "kind", "digest", ...}``
@@ -75,6 +85,15 @@ EVENT_KINDS = (
     # state, and a restored replica re-warms from peers, not from its own
     # pre-crash journal)
     "lend",
+    # elastic autoscaling (ISSUE 18). "requeue" lives in the ENGINE
+    # journal and is replayed (it cancels an earlier "submit" — the
+    # request moved to a peer); the scale kinds live in the CONTROLLER
+    # journal and are what an autoscaler restart resumes the fleet from.
+    "requeue",
+    "scale_up",
+    "drain_begin",
+    "drain_done",
+    "retire",
 )
 
 # Payload keys elided from one-line renderings (bulky checkpoint state).

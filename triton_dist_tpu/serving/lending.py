@@ -44,6 +44,12 @@ are known (the kill-time tombstones) and their KV usually survives on
 peers — so the cluster re-warms the cache via lends instead of letting
 every shared prefix re-prefill cold, and post-restore TTFT for template
 traffic lands in the cached band, not the cold one.
+
+``lend_ahead`` (ISSUE 18) is the same machinery inverted for elastic
+drains: where ``lend`` pulls on a borrower miss and ``rewarm`` pulls
+after a restore, a DRAINING replica **pushes** its hot prefixes to the
+rendezvous successors that will inherit their traffic, then retires —
+so a graceful scale-down costs the fleet no cold re-prefills at all.
 """
 
 from __future__ import annotations
@@ -136,6 +142,52 @@ class PageLendingTier:
                 total += adopted
                 self.cluster.metrics.inc("rewarmed_prefixes")
         return total
+
+    # -- drain-time lend-ahead (ISSUE 18) ----------------------------------
+    def lend_ahead(self, draining, prefixes,
+                   successor_of) -> dict[tuple, int]:
+        """The ROADMAP lend-ahead follow-on, done at drain time: PUSH a
+        draining replica's hot prefixes to their rendezvous successors
+        before it retires, so the prefix's future traffic radix-hits a
+        warm peer instead of re-prefilling cold. ``prefixes`` are the
+        drainee's pruned index entries (deepest-first after dedup — one
+        deep push covers every ancestor); ``successor_of(prefix)``
+        resolves the admitting replica that will win the prefix's
+        rendezvous once the drainee is gone. Each push is probed with
+        the depth-only ``export_prefix(payload=False)`` (nothing
+        lendable → skip, no ladder burned) and shipped through the same
+        ``_transfer`` retry/degrade ladder as a pull — a dead or slow
+        successor burns Backoff rungs and DEGRADES to cold re-prefill
+        on the successor (``lend_degradations``), never blocking the
+        retire. Engines without the lend surface (mixed fleets) make
+        the whole call a typed no-op, counted as ``lend_ahead_noops``.
+        Returns {prefix: successor index} for the pushes that landed —
+        the cluster re-points its index at exactly those."""
+        m = self.cluster.metrics
+        engine = draining.engine
+        if engine is None \
+                or getattr(engine, "export_prefix", None) is None:
+            m.inc("lend_ahead_noops")
+            return {}
+        uniq = list(dict.fromkeys(tuple(t) for t in prefixes))
+        uniq.sort(key=len, reverse=True)    # stable within a length
+        placed: dict[tuple, int] = {}
+        for prefix in uniq:
+            toks, _, _ = engine.export_prefix(prefix, payload=False)
+            if toks <= 0:
+                continue    # nothing lendable here — successor goes cold
+            succ = successor_of(prefix)
+            if succ is None or succ.engine is None:
+                continue
+            if getattr(succ.engine, "adopt_prefix", None) is None:
+                m.inc("lend_ahead_noops")
+                continue    # mixed fleet: successor can't adopt
+            adopted = self._transfer(draining, succ, prefix)
+            if adopted > 0:
+                placed[prefix] = succ.index
+                m.inc("lend_aheads")
+                m.inc("lend_ahead_pages", adopted)
+        return placed
 
     # -- the transfer ladder -----------------------------------------------
     def _transfer(self, lender, borrower, prompt) -> int:

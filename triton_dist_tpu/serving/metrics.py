@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import time
+from collections import deque
 
 
 class Histogram:
@@ -64,6 +65,45 @@ class Histogram:
             "p90": self.percentile(90),
             "p99": self.percentile(99),
         }
+
+
+class AttainmentWindow:
+    """Windowed SLO attainment over keyed latency series (ISSUE 18).
+
+    The autoscaler's sensor: per key (e.g. ``("ttft", "chat")``) a
+    bounded FIFO window of the most recent observations; ``attainment``
+    is the fraction of the window at or under a budget. Deterministic by
+    construction — observations arrive in engine-step order and the
+    window is a plain deque, so the same trace always yields the same
+    scale decisions (no wall clock, no decay constants to drift)."""
+
+    def __init__(self, window: int = 128):
+        assert window >= 1
+        self.window = window
+        self._series: dict = {}
+
+    def observe(self, key, value: float) -> None:
+        s = self._series.get(key)
+        if s is None:
+            s = self._series[key] = deque(maxlen=self.window)
+        s.append(float(value))
+
+    def count(self, key) -> int:
+        s = self._series.get(key)
+        return len(s) if s is not None else 0
+
+    def attainment(self, key, budget: float) -> float | None:
+        """Fraction of the window ≤ ``budget``; None while empty."""
+        s = self._series.get(key)
+        if not s:
+            return None
+        return sum(1 for v in s if v <= budget) / len(s)
+
+    def snapshot(self) -> dict:
+        return {str(k): {"count": len(s),
+                         "newest": s[-1] if s else None}
+                for k, s in sorted(self._series.items(), key=lambda t:
+                                   str(t[0]))}
 
 
 class ServingMetrics:
@@ -164,6 +204,25 @@ class ServingMetrics:
             "lend_tokens": 0,
             "lend_degradations": 0,
             "rewarmed_prefixes": 0,
+            # elastic autoscaling (ISSUE 18): fleet membership changes
+            # (replicas added / drains begun / drains reaching quiescence
+            # / replicas retired), queued requests a draining replica
+            # handed back through its journal cursor for re-placement on
+            # a peer, total replica-steps actually run (the counterfactual
+            # bench row divides this by static-peak provisioning), drain-
+            # time lend-ahead pushes (one per prefix landed on its
+            # rendezvous successor, plus the pages they carried), and
+            # lend-ahead attempts that degraded to a typed no-op because
+            # an engine lacked the lend surface (mixed fleets)
+            "scale_ups": 0,
+            "drains_begun": 0,
+            "drains_done": 0,
+            "retires": 0,
+            "requeues": 0,
+            "replica_steps": 0,
+            "lend_aheads": 0,
+            "lend_ahead_pages": 0,
+            "lend_ahead_noops": 0,
         }
         self.hist = {
             "ttft_s": Histogram(),
@@ -243,6 +302,17 @@ class ServingMetrics:
             "ttft_rewarmed_steps": Histogram(),
             # lend wall time per page (µs) — the bench row
             "lend_us_per_page": Histogram(),
+            # elastic autoscaling (ISSUE 18): deterministic step-space
+            # TTFT/ITL (the series the per-class SLO attainment windows
+            # sample — wall clock would make scale decisions replay-
+            # unstable), fleet size sampled once per cluster step, and
+            # the wall seconds each scale-up spent building its engine
+            # (artifact load dominates when an AOT artifact is threaded —
+            # the scale-up-to-first-token split cluster_sim reports)
+            "ttft_steps": Histogram(),
+            "itl_steps": Histogram(),
+            "fleet_size": Histogram(),
+            "scale_up_build_s": Histogram(),
         }
         self._t0 = time.perf_counter()
 
@@ -324,4 +394,4 @@ class ServingMetrics:
         print(self.json_line(), file=file)
 
 
-__all__ = ["Histogram", "ServingMetrics"]
+__all__ = ["AttainmentWindow", "Histogram", "ServingMetrics"]

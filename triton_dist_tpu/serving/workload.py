@@ -134,12 +134,17 @@ def parse_workload(spec: str) -> WorkloadSpec:
     return WorkloadSpec(**kw).validate()
 
 
-def _rate_at(spec: WorkloadSpec, step: int) -> float:
+def rate_at(spec: WorkloadSpec, step: int) -> float:
     """The square-wave diurnal rate: ``rate * burst_x`` inside the burst
-    window of each period, ``rate`` outside."""
+    window of each period, ``rate`` outside. Public since ISSUE 18 — the
+    autoscale panel plots the offered-rate timeline against the fleet-
+    size timeline from this exact function, so the two always agree."""
     if spec.burst_len and (step % spec.burst_every) < spec.burst_len:
         return spec.rate * spec.burst_x
     return spec.rate
+
+
+_rate_at = rate_at
 
 
 def generate_arrivals(spec: WorkloadSpec, vocab: int = 32000,
@@ -243,4 +248,4 @@ def parse_slo(spec: str) -> SLOPolicy:
 
 
 __all__ = ["WorkloadSpec", "parse_workload", "generate_arrivals",
-           "parse_slo"]
+           "parse_slo", "rate_at"]
