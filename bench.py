@@ -959,6 +959,111 @@ def bench_decode(ctx, i1: int, i2: int, B: int = 1, Hq: int = 32,
     return res
 
 
+def bench_flash_decode_dist(Hq: int = 8, Hkv: int = 4, D: int = 128,
+                            page_size: int = 512) -> dict:
+    """Distributed flash-decode rows (ISSUE 19): ONE request's pages
+    sharded over an SP rank sweep n ∈ {1, 2, 4} at context lengths
+    {8k, 32k, 64k} tokens.
+
+    - ``flash_decode_dist_us``: measured per-call wall latency per
+      (n, length). On the CPU interpret mesh ranks run SERIALIZED, so
+      this wall clock is an API smoke number, not the scaling story.
+    - the scaling story is the wire-fit model — ``fd_attn_split_us``,
+      the SAME model the engine metrics and serve_sim panels quote:
+      local partial walk ∝ ceil(pages/n) vs fixed-order fold wait
+      ∝ (n−1) partial-slab rows. ``attn_model_total_us`` is ASSERTED
+      sublinear in rank count at every length: a page's KV bytes
+      (2·Hkv·ps·D·itemsize) dwarf its slab row (Hq·(D+128)·4), so
+      halving the local walk always buys more than the extra fold
+      slabs cost. The assertion covers the full {1,2,4} sweep even
+      when the device count caps the measured runs (the model is pure
+      host math).
+    - bit-identity vs the n=1 golden is ASSERTED per length: per-page
+      partials + the one fixed (page, rank) fold order mean the output
+      cannot move with the mesh — the op-level twin of the engine's
+      cross-mesh trace contract.
+    """
+    import numpy as _np
+
+    from triton_dist_tpu.ops.flash_decode import flash_decode_dist
+    from triton_dist_tpu.serving.sharded import fd_attn_split_us
+    from triton_dist_tpu.shmem.context import initialize_distributed
+    from triton_dist_tpu.utils import on_cpu
+
+    n_dev = len(jax.devices())
+    ns = [n for n in (1, 2, 4) if n <= n_dev]
+    page_kv = 2 * Hkv * page_size * D * 4           # f32 pool
+    slab_row = Hq * (D + 128) * 4
+    rows = {}
+    for s_tok in (8192, 32768, 65536):
+        pages = s_tok // page_size
+        q = jax.random.normal(jax.random.key(0), (1, Hq, D), jnp.float32)
+        kp = jax.random.normal(jax.random.key(1),
+                               (pages, Hkv, page_size, D), jnp.float32)
+        vp = jax.random.normal(jax.random.key(2),
+                               (pages, Hkv, page_size, D), jnp.float32)
+        kn = jax.random.normal(jax.random.key(3), (1, Hkv, D), jnp.float32)
+        vn = jax.random.normal(jax.random.key(4), (1, Hkv, D), jnp.float32)
+        bt = jnp.arange(pages, dtype=jnp.int32)[None]
+        pos = jnp.array([s_tok - 1], jnp.int32)
+        kv = jnp.array([s_tok], jnp.int32)
+
+        key = f"{s_tok // 1024}k"
+        rows[key] = {}
+        golden = None
+        model_total = {}
+        for n in ns:
+            ctx = initialize_distributed(axis_names=("x",), mesh_shape=(n,))
+            fn = jax.jit(lambda q_, kn_, vn_, kp_, vp_, _c=ctx:
+                         flash_decode_dist(_c, q_, kn_, vn_, kp_, vp_,
+                                           bt, pos, kv, axis="x")[0])
+            kps, vps = ctx.shard(kp, P("x")), ctx.shard(vp, P("x"))
+            out = jax.block_until_ready(fn(q, kn, vn, kps, vps))  # compile
+
+            def measure(fn=fn, kps=kps, vps=vps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(q, kn, vn, kps, vps))
+                return time.perf_counter() - t0
+
+            s = _best_of(measure, n=2)
+            if golden is None:
+                golden = _np.asarray(out)
+            else:
+                assert _np.array_equal(_np.asarray(out), golden), (
+                    f"flash_decode_dist at n={n}, {key} tokens changed "
+                    "bits vs the n=1 golden — the fixed-order fold "
+                    "contract broke")
+            local, fold = fd_attn_split_us(n, 1, 1, pages, page_kv,
+                                           slab_row)
+            model_total[n] = local + fold
+            rows[key][f"n{n}"] = {
+                "flash_decode_dist_us": round(s * 1e6, 1),
+                "attn_local_model_us": round(local, 2),
+                "attn_fold_wait_model_us": round(fold, 2),
+                "attn_model_total_us": round(local + fold, 2),
+            }
+        rows[key]["bit_identical"] = True
+        for n in (1, 2, 4):
+            if n not in model_total:
+                local, fold = fd_attn_split_us(n, 1, 1, pages, page_kv,
+                                               slab_row)
+                model_total[n] = local + fold
+        assert model_total[4] < model_total[2] < model_total[1], (
+            f"modeled per-step attention not sublinear in rank count at "
+            f"{key}: {model_total} — the fold-slab wire cost outweighs "
+            "the local-walk savings at this shape")
+        rows[key]["model_sublinear"] = True
+    return {
+        "flash_decode_dist": rows,
+        "flash_decode_dist_knobs": {
+            "Hq": Hq, "Hkv": Hkv, "head_dim": D, "page_size": page_size,
+            "pool_dtype": "float32", "page_kv_bytes": page_kv,
+            "slab_row_bytes": slab_row,
+            "wall_clock": "interpret-smoke" if on_cpu() else "device",
+            "model": "wire-fit (serving/sharded.py fd_attn_split_us)"},
+    }
+
+
 def bench_serving(ctx, i1: int, i2: int, B: int = 1, Hq: int = 32,
                   Hkv: int = 8, D: int = 128, S: int = 4096,
                   page_size: int = 128, num_slots: int = 4,
@@ -2444,6 +2549,14 @@ def main(a2a_primary: bool = False):
         extras.update(bench_decode(ctx, i1=di1, i2=di2, **dec_shape))
 
     attempt("decode", _decode)
+
+    def _flash_decode_dist():
+        # one-request KV sharded over the SP axis (ISSUE 19): rank sweep
+        # at {8k, 32k, 64k}-token contexts, bit-identity vs the n=1
+        # golden asserted, modeled attention split asserted sublinear
+        extras.update(bench_flash_decode_dist())
+
+    attempt("flash_decode_dist", _flash_decode_dist)
 
     def _serving():
         # paged-decode serving extras at the SAME attention shape as

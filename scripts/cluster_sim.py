@@ -448,6 +448,12 @@ if workload_spec is not None:
         p.error(f"workload spec field 'plen': plen+mnt-1 = "
                 f"{workload_spec.plen[1] + workload_spec.mnt[1] - 1} "
                 f"exceeds pages_per_seq*page_size = {cap}")
+    if (workload_spec.long > 0
+            and workload_spec.lplen[1] + workload_spec.mnt[1] - 1 > cap):
+        p.error(f"workload spec field 'lplen': lplen+mnt-1 = "
+                f"{workload_spec.lplen[1] + workload_spec.mnt[1] - 1} "
+                f"exceeds pages_per_seq*page_size = {cap} — raise "
+                f"--pages-per-seq (long-context prompts span many pages)")
     pending = deque(generate_arrivals(workload_spec, vocab=VOCAB,
                                       page_size=args.page_size))
     i = 0
@@ -575,6 +581,40 @@ if workload_spec is not None or slo_policy is not None:
                 dst[k] += row[k]
     print(json.dumps({"per_class": agg_cls,
                       "quota_throttled": throttled}), file=sys.stderr)
+    if workload_spec is not None and workload_spec.long > 0:
+        # long-class panel (ISSUE 19): the long tenants' fleet view —
+        # whether 64k-class prompts finished inside their TTL, how often
+        # the chunk budget clamped a dispatch to protect decode ITL, and
+        # the long-vs-fleet TTFT tail the clamp is trading against
+        from triton_dist_tpu.serving.metrics import Histogram  # noqa: E402
+        _lt, _li = Histogram(), Histogram()
+        _shrinks = 0
+        for rep in cluster.replicas:
+            if rep.engine is None:
+                continue
+            m = rep.engine.metrics
+            _shrinks += m.counters.get("chunk_shrinks", 0)
+            for src, dst in ((m.hist.get(m.class_key("ttft_s", "long")),
+                              _lt),
+                             (m.hist.get(m.class_key("itl_s", "long")),
+                              _li)):
+                for v in (src._samples if src is not None else ()):
+                    dst.observe(v)
+        _us = lambda v: (None if v is None  # noqa: E731
+                         else round(v * 1e6, 1))
+        _row = agg_cls.get("long", {})
+        print(json.dumps({
+            "long_class": True,
+            "long_share": workload_spec.long,
+            "lplen": list(workload_spec.lplen),
+            "finished": _row.get("finished", 0),
+            "rejections": _row.get("rejections", 0),
+            "expirations": _row.get("expirations", 0),
+            "chunk_shrinks": _shrinks,
+            "ttft_long_p50_us": _us(_lt.percentile(50)),
+            "ttft_long_p99_us": _us(_lt.percentile(99)),
+            "itl_long_p99_us": _us(_li.percentile(99)),
+        }), file=sys.stderr)
 # cold-start summary (ISSUE 15): fleet-wide fresh traces paid before any
 # token, plus wall time from cold start (artifact load / replica builds)
 # to the cluster's first token. Printed for every --engine colocated run

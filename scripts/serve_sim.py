@@ -81,6 +81,16 @@ p.add_argument("--wire", choices=("auto", "fp8", "none"), default="auto",
                     "resolves PER RANK COUNT), 'fp8' (pinned e4m3 — use "
                     "this when comparing tokens across mesh shapes), "
                     "'none' (full-width wire)")
+p.add_argument("--long-context", action="store_true",
+               help="distributed flash-decode (ISSUE 19) for --mesh: the "
+                    "KV pool is laid out interleaved so each SP rank owns "
+                    "every sp-th page of EVERY request, decode attention "
+                    "runs flash_decode_dist (per-page softmax partials, "
+                    "one-sided folds), and one request's context may span "
+                    "the whole mesh. Tokens stay bit-identical to the "
+                    "replicated layout at any rank count. Prints a MODELED "
+                    "per-step attention split (local scan vs fold wait) "
+                    "to stderr")
 p.add_argument("--overlap", choices=("off", "ep", "ep+sp"), default="off",
                help="fine-grained compute/comm overlap for --mesh "
                     "(ISSUE 16): 'ep' microbatches each EP dispatch so "
@@ -180,6 +190,9 @@ elif args.model == "moe":
     args.mesh = "1x1x1"
 if args.overlap != "off" and (args.mesh is None or args.disagg):
     p.error("--overlap rides the sharded engine: needs --mesh (or "
+            "--model moe) and is not plumbed through --disagg")
+if args.long_context and (args.mesh is None or args.disagg):
+    p.error("--long-context rides the sharded engine: needs --mesh (or "
             "--model moe) and is not plumbed through --disagg")
 if (args.prefix_cache and args.prefill_chunk is None
         and not args.disagg and args.mesh is None):
@@ -314,6 +327,7 @@ def mk_engine(fresh=False):
         eng = ShardedServingEngine(params, cfg, serving_mesh(tp, sp, ep),
                                    prefill_chunk=args.prefill_chunk or 8,
                                    wire_dtype=wire, overlap=args.overlap,
+                                   long_context=args.long_context,
                                    **common)
         if not fresh:
             # wire=auto resolves PER DISPATCH SIZE and rank count (PR 8
@@ -356,6 +370,12 @@ if workload_spec is not None:
         p.error(f"workload spec field 'plen': plen+mnt-1 = "
                 f"{workload_spec.plen[1] + workload_spec.mnt[1] - 1} "
                 f"exceeds pages_per_seq*page_size = {cap}")
+    if (workload_spec.long > 0
+            and workload_spec.lplen[1] + workload_spec.mnt[1] - 1 > cap):
+        p.error(f"workload spec field 'lplen': lplen+mnt-1 = "
+                f"{workload_spec.lplen[1] + workload_spec.mnt[1] - 1} "
+                f"exceeds pages_per_seq*page_size = {cap} — raise "
+                f"--pages-per-seq (long-context prompts span many pages)")
     arrivals = generate_arrivals(workload_spec, vocab=vocab,
                                  page_size=args.page_size)
 elif args.prompt_zipf is not None:
@@ -617,6 +637,20 @@ else:
             "overlapped_comm_us_mean": round(
                 snap["overlapped_comm_us"]["mean"] or 0.0, 2),
         }), file=sys.stderr)
+        if args.long_context:
+            # long-context panel (ISSUE 19): the per-step decode attention
+            # split under the wire-fit model — local page scan (shrinks
+            # with SP rank count, each rank walks 1/n of the pages) vs
+            # fold wait (the fixed-order partial merge). MODELED, labeled
+            # as such — CPU interpret wall clock cannot show the split
+            print(json.dumps({
+                "long_context": True,
+                "kv_layout": eng.alloc.layout,
+                "attn_local_us_mean": round(
+                    snap["attn_local_us"]["mean"] or 0.0, 3),
+                "attn_fold_wait_us_mean": round(
+                    snap["attn_fold_wait_us"]["mean"] or 0.0, 3),
+            }), file=sys.stderr)
     print(json.dumps({
         "prefill_chunk": args.prefill_chunk,
         "prefill_chunks": snap["prefill_chunks"],

@@ -124,6 +124,34 @@ def _lend_dropped_last_signal_kernel(axis, mesh_axes, in_ref, out_ref,
     out_ref[...] = in_ref[...]
 
 
+def _fold_dropped_slice_signal_kernel(axis, mesh_axes, in_ref, out_ref,
+                                      flag):
+    """The flash_decode_dist fold wire (ISSUE 19: every rank announces its
+    page-partial slab to each peer with one counted ``signal_op``; each
+    consumer's fold gates on ONE count per remote slab it folds, in
+    canonical rank order) where RANK 0 forgets to announce its slab:
+    every peer budgets n-1 announcement counts but only n-2 ever arrive,
+    so the fold's slice gate starves waiting on rank 0's partial (static
+    under-signal). The slab bytes may well have landed — the announcement
+    protocol is what the checker accounts."""
+    from ..shmem import device as shd
+    me = shd.my_pe(axis)
+    n = shd.n_pes(axis)
+
+    @pl.when(me != 0)
+    def _():
+        # BUG: rank 0 skips this announce loop entirely — its partial
+        # slab is never signalled to any consumer
+        for p in range(1, n):
+            pid = shd.pe_at(mesh_axes, axis, lax.rem(me + p, n))
+            shd.signal_op(flag, 1, pid)
+
+    # one count consumed per remote slab, in canonical fold order
+    for _ in range(n - 1):
+        shd.signal_wait_until(flag, 1)
+    out_ref[...] = in_ref[...]
+
+
 def _over_signal_kernel(axis, mesh_axes, in_ref, out_ref, flag):
     """Arrival counter whose producers double-signal: the wait consumes n-1
     but 2(n-1) arrive — the residue poisons the next call on this scratch
@@ -276,6 +304,10 @@ _ENTRIES = [
                  run=lambda ctx: _flag_call(
                      ctx, _lend_dropped_last_signal_kernel,
                      "lend_dropped_last_signal")),
+    GalleryEntry("fold_dropped_slice_signal", UNDER_SIGNAL,
+                 run=lambda ctx: _flag_call(
+                     ctx, _fold_dropped_slice_signal_kernel,
+                     "fold_dropped_slice_signal")),
     GalleryEntry("over_signal", OVER_SIGNAL,
                  run=lambda ctx: _flag_call(ctx, _over_signal_kernel,
                                             "over_signal")),

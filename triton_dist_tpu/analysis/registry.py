@@ -336,6 +336,17 @@ def _run_pool_ag_start_local(ctx):
     pool_ag_start_local(ctx, pages, pages, axis="x")
 
 
+def _run_flash_decode_dist(ctx):
+    from ..ops import flash_decode_dist
+    n = ctx.num_ranks
+    q = jnp.zeros((1, 4, 128), f32)
+    pages = jnp.zeros((4 * n, 2, 8, 128), f32)
+    new = jnp.zeros((1, 2, 128), f32)
+    flash_decode_dist(ctx, q, new, new, pages, pages,
+                      jnp.zeros((1, 4), i32), jnp.array([3], i32),
+                      jnp.array([4], i32), axis="x")
+
+
 # -- grouped GEMM / MoE ------------------------------------------------------
 
 def _gg_grouped_gemm():
@@ -486,6 +497,9 @@ _ENTRIES = [
     RegistryEntry("sp_paged_attend_write", _run_sp_paged_attend_write),
     # start-local signal-gated pool allgather (ISSUE 16 SP overlap)
     RegistryEntry("pool_ag_start_local", _run_pool_ag_start_local),
+    # distributed flash-decode: per-page partial slab exchange + fixed-
+    # order page fold (ISSUE 19 long-context serving)
+    RegistryEntry("flash_decode_dist", _run_flash_decode_dist),
     # grouped GEMM
     RegistryEntry("grouped_gemm", _local(_gg_grouped_gemm),
                   meshes=MESH_LOCAL),

@@ -35,7 +35,7 @@ from triton_dist_tpu.ops.all_to_all import (  # noqa: F401
 from triton_dist_tpu.ops.flash_decode import (  # noqa: F401
     gqa_decode_partial, gqa_decode_paged, paged_kv_write, decode_combine,
     ll_ag_merge, sp_gqa_flash_decode, sp_paged_attend_write,
-    pool_ag_start_local)
+    pool_ag_start_local, flash_decode_dist)
 from triton_dist_tpu.ops.group_gemm import (  # noqa: F401
     PackedGatedWeights, align_tokens_by_expert, used_block_count,
     emit_grouped_gemm, grouped_gemm, pack_gated_weights, grouped_gemm_gated,

@@ -699,6 +699,347 @@ def sp_paged_attend_write(ctx: ShmemContext, q: jax.Array,
     return sm(*args)
 
 
+# -- distributed flash-decode: one request's KV sharded over the SP mesh ----
+#
+# `sp_paged_attend_write` shards the pool across REQUESTS: every rank
+# allgathers the whole pool and attends over all of it, so one long
+# request's attention cost is replicated n times. `flash_decode_dist`
+# shards ONE request's pages: each rank walks only the block-table pages
+# resident in its pool slice, computes an independent softmax partial PER
+# PAGE, announces the partial slab with one-sided puts + a counted
+# `signal_op`, and every rank folds all slabs in a single FIXED order.
+#
+# Why per-PAGE partials (not one per-rank online-softmax partial): the
+# fold must be bitwise identical at every mesh size n. A per-rank running
+# (m, l, acc) partial bakes the rank's page count into its rounding, so
+# merging two ranks' partials ≠ one rank's partial over both slices at the
+# last bit. A per-page partial is a pure function of (q, that page's K/V)
+# — identical floats no matter which rank computed it — and the fold
+# visits pages in block-table order with ranks 0..n-1 interleaved at each
+# page, where at most ONE rank's entry per page is real and every other
+# entry is the neutral (out=0, lse=NEG_INF) element applied as an EXACT
+# no-op (a `where` select of the untouched carry, never an arithmetic
+# identity — `acc*1 + 0` can still flip a -0.0). The carry therefore
+# walks the same float sequence at n=1, 2, 4, ... for ANY page→rank
+# placement, which is also what makes the pool layout (blocked vs
+# round-robin interleaved) a pure balance knob. A psum/lse-psum would
+# re-associate by rank count — exactly what sigcheck's rank-count-
+# dependent-reduction lint rejects — so it is refused by construction.
+
+_FD_EMPTY = NEG_INF / 2  # "no entry" threshold: real lse never gets here
+
+
+def _fd_partial_kernel(kl_ref, bt_ref, rk_ref, q_ref, k_ref, v_ref,
+                       out_ref, lse_ref, *, page_size: int, p_local: int,
+                       sm_scale: float, n_kv_heads: int):
+    """Grid (B, pages_per_seq): one INDEPENDENT softmax partial per
+    block-table page — no carry between steps, so any rank (or any
+    distribution of pages over ranks) produces bit-identical entries for
+    the pages it owns. Non-local / dead pages emit the neutral element."""
+    b = pl.program_id(0)
+    s = pl.program_id(1)
+    page = bt_ref[b, s]
+    base = rk_ref[0] * p_local
+    mine = jnp.logical_and(page >= base, page < base + p_local)
+    live = jnp.logical_and(mine, s * page_size < kl_ref[b])
+
+    Hq, D = out_ref.shape[2], out_ref.shape[3]
+    G = Hq // n_kv_heads
+    q = q_ref[0].reshape(n_kv_heads, G, D)
+    k = k_ref[0]                                   # [Hkv, page_size, D]
+    v = v_ref[0]
+    scores = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * sm_scale   # [Hkv, G, ps]
+    scores = scores.reshape(Hq, page_size)
+    pos = s * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, scores.shape, 1)
+    scores = jnp.where(pos < kl_ref[b], scores, NEG_INF)
+    m = jnp.max(scores, axis=1, keepdims=True)     # [Hq, 1]
+    p = jnp.exp(scores - m)
+    l = jnp.sum(p, axis=1, keepdims=True)          # [Hq, 1]
+    pv = jax.lax.dot_general(
+        p.reshape(n_kv_heads, G, page_size).astype(v.dtype), v,
+        (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).reshape(Hq, D)
+    l_safe = jnp.where(l > 0, l, 1.0)
+    # keep: a live page always has ≥1 unmasked key, but garbage pool rows
+    # under a dead step may be anything — the select (not a multiply)
+    # guarantees the neutral entry regardless
+    keep = jnp.logical_and(live, l > 0)
+    out_ref[0, 0] = jnp.where(keep, pv / l_safe, 0.0)
+    lse_ref[0, 0] = jnp.broadcast_to(
+        jnp.where(keep, m + jnp.log(l_safe), NEG_INF), lse_ref.shape[2:])
+
+
+def _fd_page_partials(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                      block_table: jax.Array, kv_len: jax.Array,
+                      rank: jax.Array, sm_scale: float | None = None):
+    """Per-page partial slab for one rank's pool slice: returns packed
+    (out ‖ lse) [B, S, Hq, D+128] f32. ``k_pages``/``v_pages`` are the
+    LOCAL slice [p_local, Hkv, page_size, D]; ``block_table`` holds GLOBAL
+    device rows — rank r owns rows [r*p_local, (r+1)*p_local)."""
+    B, Hq, D = q.shape
+    p_local, Hkv, page_size, _ = k_pages.shape
+    S = block_table.shape[1]
+    sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+
+    def page_index(b, s, kl, bt, rk):
+        # clamp into the local slice: non-local steps fetch an arbitrary
+        # in-bounds page (their compute is discarded by the select)
+        loc = bt[b, s] - rk[0] * p_local
+        return (jnp.clip(loc, 0, p_local - 1), 0, 0, 0)
+
+    kernel = functools.partial(_fd_partial_kernel, page_size=page_size,
+                               p_local=p_local, sm_scale=sm_scale,
+                               n_kv_heads=Hkv)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(B, S),
+            in_specs=[
+                pl.BlockSpec((1, Hq, D), lambda b, s, kl, bt, rk: (b, 0, 0)),
+                pl.BlockSpec((1, Hkv, page_size, D), page_index),
+                pl.BlockSpec((1, Hkv, page_size, D), page_index),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, Hq, D),
+                             lambda b, s, kl, bt, rk: (b, s, 0, 0)),
+                pl.BlockSpec((1, 1, Hq, 128),
+                             lambda b, s, kl, bt, rk: (b, s, 0, 0)),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, S, Hq, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, S, Hq, 128), jnp.float32),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * Hq * S * page_size * D,
+            bytes_accessed=q.size + B * S * Hkv * page_size * D * 2,
+            transcendentals=B * Hq * S * page_size),
+        interpret=default_interpret(),
+    )(kv_len, block_table, rank, q, k_pages, v_pages)
+    return jnp.concatenate([out, lse], axis=-1)
+
+
+def _fd_fold(stacked: jax.Array, D: int, out_dtype):
+    """Fixed-order fold of page partials: ``stacked`` [T, rows, D+128] in
+    fold order (page-major, rank-minor — T = S at n=1, S*n otherwise).
+    Neutral entries (lse == NEG_INF) are EXACT no-ops: the carry is passed
+    through a select untouched, so the float sequence the carry walks is
+    the n=1 page-order sequence at every mesh size. Never a psum."""
+    init = (jnp.zeros(stacked.shape[1:-1] + (D,), jnp.float32),
+            jnp.full(stacked.shape[1:-1] + (1,), NEG_INF, jnp.float32),
+            jnp.zeros(stacked.shape[1:-1] + (1,), jnp.float32))
+
+    def step(carry, x):
+        acc, m, denom = carry
+        xo, xl = x[..., :D], x[..., D:D + 1]
+        empty = xl <= _FD_EMPTY
+        new_m = jnp.maximum(m, xl)
+        scale = jnp.exp(m - new_m)
+        w = jnp.exp(xl - new_m)
+        return (jnp.where(empty, acc, acc * scale + xo * w),
+                jnp.where(empty, m, new_m),
+                jnp.where(empty, denom, denom * scale + w)), None
+
+    (acc, _m, denom), _ = lax.scan(step, init, stacked)
+    return (acc / jnp.where(denom > 0, denom, 1.0)).astype(out_dtype)
+
+
+def _fd_fold_kernel(axis, mesh_axes, S, BH, D, out_dtype,
+                    part_ref, out_ref, ws_ref, bufs, obuf,
+                    csems, send_sems, recv_sems, sig):
+    """One-sided partial exchange + fixed-order page fold (the
+    `paged_transport` seg-push idiom): put my page-partial slab to every
+    peer and announce it with one counted ``signal_op``; consume peers'
+    slabs in CANONICAL rank order, each gated by exactly one announcement
+    count plus that slab's delivery credits. My own slab's VMEM fetch is
+    UNGATED — local partials land while remote slabs are still in flight
+    (overlap the schedule). The fold itself then walks (page s, rank r)
+    in the one fixed order shared with the XLA/CPU path — at each page
+    exactly one rank's entry is real, the rest are exact no-ops — so the
+    reduction order never changes with n (never a psum).
+
+    The entry barrier is required for the same reason as
+    ``_ll_ag_merge_kernel``: the ws arrival buffer is reused across calls.
+    VMEM note: all n slabs are resident during the fold (n*S*B*Hq*(D+128)
+    f32) — fine for decode batches; streaming a per-page double buffer is
+    the round-7 lever for 100k-context on-chip runs."""
+    me = shd.my_pe(axis)
+    n = shd.n_pes(axis)
+    shd.barrier_all((axis,), mesh_axes=mesh_axes)
+
+    rdmas = []
+    for p in range(1, n):
+        dst = lax.rem(me + p, n)
+        pid = shd.pe_at(mesh_axes, axis, dst)
+        rdmas.append(shd.putmem_nbi(ws_ref.at[me], part_ref,
+                                    send_sems.at[dst], recv_sems.at[me],
+                                    pid))
+        # announce my partial slab the moment its put is in flight
+        shd.signal_op(sig, 1, pe=pid)
+
+    def fetch(r):
+        @pl.when(r == me)
+        def _():
+            # own slab: no gate — it never rides the wire
+            pltpu.make_async_copy(part_ref, bufs.at[r], csems.at[r]).start()
+
+        @pl.when(r != me)
+        def _():
+            # exactly the signals this fold step consumes: one partial
+            # announcement, then the slab's delivery credits
+            shd.signal_wait_until(sig, 1)
+            shd.wait_recv(ws_ref.at[r], recv_sems.at[r])
+            pltpu.make_async_copy(ws_ref.at[r], bufs.at[r],
+                                  csems.at[r]).start()
+
+    # page 0 of the fold touches every rank's slab, so full residency is
+    # the minimal wait set; gate in canonical order, fetches overlapping
+    fetch(0)
+    for r in range(n):
+        if r + 1 < n:
+            fetch(r + 1)
+        pltpu.make_async_copy(bufs.at[r], bufs.at[r], csems.at[r]).wait()
+
+    def fold_step(t, carry):
+        acc, m, denom = carry
+        r = lax.rem(t, n)
+        s = t // n
+        x = bufs[r, pl.ds(s * BH, BH), :]
+        xo, xl = x[..., :D], x[..., D:D + 1]
+        empty = xl <= _FD_EMPTY
+        new_m = jnp.maximum(m, xl)
+        scale = jnp.exp(m - new_m)
+        w = jnp.exp(xl - new_m)
+        return (jnp.where(empty, acc, acc * scale + xo * w),
+                jnp.where(empty, m, new_m),
+                jnp.where(empty, denom, denom * scale + w))
+
+    init = (jnp.zeros((BH, D), jnp.float32),
+            jnp.full((BH, 1), NEG_INF, jnp.float32),
+            jnp.zeros((BH, 1), jnp.float32))
+    acc, _m, denom = lax.fori_loop(0, S * n, fold_step, init)
+    obuf[...] = (acc / jnp.where(denom > 0, denom, 1.0)).astype(out_dtype)
+    pltpu.sync_copy(obuf, out_ref)   # ANY-space outputs need a DMA store
+    shd.quiet(*rdmas)
+
+
+def flash_decode_dist(ctx: ShmemContext, q: jax.Array,
+                      k_new: jax.Array, v_new: jax.Array,
+                      k_pages: jax.Array, v_pages: jax.Array,
+                      block_table: jax.Array, pos: jax.Array,
+                      kv_len: jax.Array, axis: str = "sp",
+                      active: jax.Array | None = None):
+    """Distributed flash-decode over a page pool sharded on ``axis``: the
+    single-request SP axis (ROADMAP item 2). Same contract as
+    ``sp_paged_attend_write`` — q [B, Hq, D]; k/v_new [B, Hkv, D];
+    k/v_pages [P, Hkv, page_size, D] GLOBAL views sharded P(axis) on the
+    page dim; block_table [B, S] DEVICE rows; pos/kv_len [B] — returns
+    (attn [B, Hq, D], k_pages, v_pages) with the pools still sharded.
+
+    Unlike ``sp_paged_attend_write`` (pool allgather + replicated walk:
+    per-rank attention cost ∝ FULL kv_len), each rank here walks only the
+    block-table pages resident in its own slice and ships one packed
+    partial slab — per-rank attention compute ∝ kv_len/n, the property
+    that makes 64k–100k-token contexts servable. The combine is the
+    fixed-order page fold (see the section comment above): bitwise
+    identical at any n and any page→rank placement, so the n=1 route —
+    which runs the SAME per-page partial + fold math — IS the golden.
+    """
+    n = ctx.axis_size(axis)
+    B, Hq, D = q.shape
+    S = block_table.shape[1]
+
+    if n == 1:
+        kp, vp = paged_kv_write(k_pages, v_pages, k_new, v_new,
+                                block_table, pos, active=active)
+        packed = _fd_page_partials(q, kp, vp, block_table, kv_len,
+                                   jnp.zeros((1,), jnp.int32))
+        stacked = packed.transpose(1, 0, 2, 3).reshape(S, B * Hq, D + 128)
+        return _fd_fold(stacked, D, q.dtype).reshape(B, Hq, D), kp, vp
+
+    assert k_pages.shape[0] % n == 0, (
+        f"pool pages {k_pages.shape[0]} not divisible by |{axis}|={n} — "
+        "pad the pool to a multiple of the SP axis (the sharded engine "
+        "does this; the allocator never hands out the padding pages)")
+    from triton_dist_tpu.ops.all_to_all import _xla_wire
+    wire_xla = _xla_wire(ctx, axis)
+    if not wire_xla and not default_interpret() and D % 128:
+        raise ValueError(
+            f"flash_decode_dist on compiled TPU needs a lane-multiple "
+            f"head dim: head_dim={D} (the packed (out ‖ lse) slab slices "
+            "would be unaligned on the wire)")
+    mesh_axes = ctx.axis_names
+    has_active = active is not None
+    BH = B * Hq
+    W = D + 128
+
+    def f(kp_l, vp_l, q, kn, vn, bt, pos, kl, *act):
+        r = lax.axis_index(axis)
+        p_local = kp_l.shape[0]
+        page_size = kp_l.shape[2]
+        # scatter the new rows that land on locally-owned pages (the
+        # sp_paged_attend_write OOB-drop idiom: every row written once)
+        rows = jnp.arange(pos.shape[0])
+        page = bt[rows, pos // page_size]
+        if has_active:
+            page = jnp.where(act[0], page, 0)
+        loc = page - r * p_local
+        ok = (loc >= 0) & (loc < p_local)
+        idx = jnp.where(ok, loc, p_local)   # OOB sentinel → dropped write
+        slot = pos % page_size
+        kp_l = kp_l.at[idx, :, slot].set(kn, mode="drop")
+        vp_l = vp_l.at[idx, :, slot].set(vn, mode="drop")
+
+        packed = _fd_page_partials(q, kp_l, vp_l, bt, kl,
+                                   r.astype(jnp.int32)[None])
+        slab = packed.transpose(1, 0, 2, 3).reshape(S * BH, W)
+
+        if wire_xla:
+            g = lax.all_gather(slab, axis, axis=0, tiled=False)
+            # reorder to the ONE fold order: page-major, rank-minor
+            stacked = g.reshape(n, S, BH, W).transpose(1, 0, 2, 3)
+            out = _fd_fold(stacked.reshape(S * n, BH, W), D, q.dtype)
+        else:
+            kernel = lambda *refs: _fd_fold_kernel(
+                axis, mesh_axes, S, BH, D, q.dtype, *refs)
+            out, _ws = pl.pallas_call(
+                kernel,
+                out_shape=(
+                    jax.ShapeDtypeStruct((BH, D), q.dtype),
+                    jax.ShapeDtypeStruct((n, S * BH, W), slab.dtype),
+                ),
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 2,
+                scratch_shapes=[
+                    pltpu.VMEM((n, S * BH, W), jnp.float32),
+                    pltpu.VMEM((BH, D), q.dtype),
+                    pltpu.SemaphoreType.DMA((n,)),   # slab VMEM fetches
+                    pltpu.SemaphoreType.DMA((n,)),   # send credits
+                    pltpu.SemaphoreType.DMA((n,)),   # delivery credits
+                    pltpu.SemaphoreType.REGULAR,     # counted announces
+                ],
+                compiler_params=pltpu.CompilerParams(
+                    has_side_effects=True,
+                    collective_id=collective_id_for(f"fd_fold_{axis}")),
+                interpret=default_interpret(),
+            )(slab)
+        return out.reshape(B, Hq, D), kp_l, vp_l
+
+    sm = ctx.shard_map(
+        f,
+        in_specs=(P(axis), P(axis)) + (P(),) * (6 + int(has_active)),
+        out_specs=(P(), P(axis), P(axis)))
+    args = (k_pages, v_pages, q, k_new, v_new, block_table, pos, kv_len)
+    if has_active:
+        args += (active,)
+    return sm(*args)
+
+
 __all__ = ["gqa_decode_partial", "gqa_decode_paged", "paged_kv_write",
            "decode_combine", "ll_ag_merge", "sp_gqa_flash_decode",
-           "sp_paged_attend_write", "pool_ag_start_local"]
+           "sp_paged_attend_write", "pool_ag_start_local",
+           "flash_decode_dist"]

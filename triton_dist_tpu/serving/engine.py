@@ -197,7 +197,9 @@ class ServingEngine:
         # ledger knows the padded device page range (padding pages are
         # never handed out and never check_migratable-accepted)
         self.alloc = KVPagePool(num_pages + 1, page_size, reserved=1,
-                                sp_ranks=getattr(self, "_pool_sp_ranks", 1))
+                                sp_ranks=getattr(self, "_pool_sp_ranks", 1),
+                                layout=getattr(self, "_pool_layout",
+                                               "blocked"))
         # prefix cache (ISSUE 13): a radix index over full-page token
         # runs of this pool's pages. Host-side control plane only — it
         # changes WHICH pages a block table points at, never what the
@@ -371,6 +373,23 @@ class ServingEngine:
         self._pos_dev = jnp.asarray(self._pos)
         self._bt_dev = jnp.asarray(self._bt)
 
+    # -- ledger id → device row (ISSUE 19) --------------------------------
+    # The ledger allocates in ID space; the device arrays are indexed in
+    # ROW space (``KVPagePool.device_row`` — identity under the default
+    # blocked layout, the round-robin bijection under the long-context
+    # interleaved layout). EVERY id that crosses the host→device boundary
+    # — block-table uploads and host-side pool gathers/scatters — goes
+    # through these two helpers; journal/digest/snapshot payloads stay in
+    # id space, so the control-plane trace is layout-independent.
+
+    def _device_rows(self, ids) -> np.ndarray:
+        return np.asarray([self.alloc.device_row(int(p)) for p in ids],
+                          np.int32)
+
+    def _device_bt_row(self, rid) -> np.ndarray:
+        return self._device_rows(
+            self.alloc.block_table_row(rid, self.pages_per_seq))
+
     # -- request intake ---------------------------------------------------
     def _ttl_for(self, req: Request) -> int | None:
         """Effective TTL: the class's override when the policy sets one,
@@ -492,7 +511,7 @@ class ServingEngine:
         # only the prompt's pages are handed off; in-page padding tail
         # rows hold padded K/V but decode overwrites position p before
         # any read of kv_len > p sees it
-        bt_row = jnp.asarray(np.asarray(pages, np.int32)[None])
+        bt_row = jnp.asarray(self._device_rows(pages)[None])
         self.pool = {
             "k": cache_to_pages(cache["k"], self.pool["k"], bt_row),
             "v": cache_to_pages(cache["v"], self.pool["v"], bt_row),
@@ -506,8 +525,7 @@ class ServingEngine:
         record_first_token(req, self.metrics, self._steps)
         self._token[slot] = tok0
         self._pos[slot] = sp
-        row = self.alloc.block_table_row(req.rid, self.pages_per_seq)
-        self._bt[slot] = np.asarray(row, np.int32)
+        self._bt[slot] = self._device_bt_row(req.rid)
         self._dirty = True
         if req.done:            # max_new_tokens == 1 or tok0 == eos_id
             self._finish(slot)
@@ -577,9 +595,10 @@ class ServingEngine:
         old, new = res
         # the chunk's attention reads this page's earlier rows through
         # the patched block-table row, so the copy must precede dispatch
+        o, w = self.alloc.device_row(old), self.alloc.device_row(new)
         self.pool = {
-            "k": self.pool["k"].at[:, new].set(self.pool["k"][:, old]),
-            "v": self.pool["v"].at[:, new].set(self.pool["v"][:, old]),
+            "k": self.pool["k"].at[:, w].set(self.pool["k"][:, o]),
+            "v": self.pool["v"].at[:, w].set(self.pool["v"][:, o]),
         }
         self.metrics.inc("cow_copies")
 
@@ -604,7 +623,7 @@ class ServingEngine:
             return 0, [], None
         if not payload:
             return n * self.page_size, hit[:n], None
-        ids = np.asarray(hit[:n], np.int32)
+        ids = self._device_rows(hit[:n])
         kv = {"k": self.pool["k"][:, ids],
               "v": self.pool["v"][:, ids]}
         return n * self.page_size, hit[:n], kv
@@ -642,7 +661,7 @@ class ServingEngine:
         if payload is not None:
             # the lender exported `want` pages; ours start past the
             # local hit depth
-            idx = np.asarray(got, np.int32)
+            idx = self._device_rows(got)
             self.pool = {
                 "k": self.pool["k"].at[:, idx].set(
                     payload["k"][:, len(have):want]),
@@ -724,7 +743,16 @@ class ServingEngine:
             return 0
         C = self.prefill_chunk
         budget = self._step_prefill_budget()
-        c_eff = C if budget is None else max(1, min(C, budget))
+        # the prefilling request's OWN class chunk budget (ISSUE 19):
+        # a long-context tier drips its 64k prompt through admission at
+        # its declared per-step rate even when nothing is decoding
+        spec = self.sched.class_spec(req)
+        own = spec.chunk_budget if spec is not None else None
+        c_eff = C
+        for b in (budget, own):
+            if b is not None:
+                c_eff = min(c_eff, b)
+        c_eff = max(1, c_eff)
         if c_eff < C:
             self.metrics.inc("chunk_shrinks")
         sp = len(req.prompt)
@@ -745,9 +773,7 @@ class ServingEngine:
             for i in range(start // self.page_size,
                            (end - 1) // self.page_size + 1):
                 self._cow_writable(req, i)
-        row = np.asarray(
-            self.alloc.block_table_row(req.rid, self.pages_per_seq),
-            np.int32)
+        row = self._device_bt_row(req.rid)
         t0 = time.perf_counter()
         tok_dev, self.pool = self._chunk_step(
             self.params, jnp.asarray(toks),
@@ -965,9 +991,7 @@ class ServingEngine:
             limits[slot] = lim
             # refresh AFTER growth — the kernel writes this scan's (k, v)
             # into pages ensure() may just have allocated
-            row = np.asarray(
-                self.alloc.block_table_row(req.rid, self.pages_per_seq),
-                np.int32)
+            row = self._device_bt_row(req.rid)
             if not np.array_equal(row, self._bt[slot]):
                 self._bt[slot] = row
                 self._dirty = True
@@ -1210,7 +1234,8 @@ class ServingEngine:
         read of it, so stale device bytes are unreachable."""
         self.alloc = KVPagePool(self.alloc.num_pages, self.page_size,
                                 reserved=self.alloc.reserved,
-                                sp_ranks=self.alloc.sp_ranks)
+                                sp_ranks=self.alloc.sp_ranks,
+                                layout=self.alloc.layout)
         if self.prefix_cache is not None:
             # fresh pool → fresh (empty) index: every cached mapping
             # pointed at KV the restored process never computed

@@ -99,16 +99,37 @@ class KVPagePool:
     never handed out, and are refused by ``check_migratable``. Every
     allocation DECISION (and hence ``digest()``) is independent of
     ``sp_ranks``; only ``page_shard`` / ``device_pages`` see the layout.
+
+    ``layout`` (ISSUE 19) picks the ledger-id → device-row placement:
+
+    - ``"blocked"`` (default): device row == page id — consecutive ids
+      land on the same SP shard, the across-REQUESTS balance the pool-
+      allgather attention path wants.
+    - ``"interleaved"``: row ``(id % sp_ranks) * (device_pages /
+      sp_ranks) + id // sp_ranks`` — consecutive ids round-robin across
+      SP shards, so ONE long sequence's pages spread evenly over the
+      mesh (the ``flash_decode_dist`` long-context mode, where per-rank
+      attention compute is ∝ the LOCAL page count).
+
+    Either way the map is a bijection over ``[0, device_pages)`` with
+    row 0 fixed (the scratch page parks in shard 0's slice under both),
+    and it is pure DEVICE layout: allocator ids, snapshots, and
+    ``digest()`` never see it — the fixed-order page fold makes the
+    attention result placement-invariant, so layout is a balance knob,
+    never a decision input.
     """
 
     def __init__(self, num_pages: int, page_size: int, reserved: int = 0,
-                 sp_ranks: int = 1):
+                 sp_ranks: int = 1, layout: str = "blocked"):
         assert num_pages > reserved >= 0
         assert sp_ranks >= 1
+        assert layout in ("blocked", "interleaved"), (
+            f"layout must be 'blocked' or 'interleaved', got {layout!r}")
         self.num_pages = num_pages
         self.page_size = page_size
         self.reserved = reserved
         self.sp_ranks = sp_ranks
+        self.layout = layout
         # device page count: padded up so the page dim splits evenly over
         # the SP axis (the padding pages are invisible to the allocator)
         self.device_pages = num_pages + (-num_pages) % sp_ranks
@@ -162,16 +183,30 @@ class KVPagePool:
         eviction scan order. Copy; mutations go through ``uncache``."""
         return list(self._cached)
 
+    def device_row(self, page_id: int) -> int:
+        """Device-array row (page-dim index) holding ledger page
+        ``page_id`` — identity under ``"blocked"``, the round-robin
+        bijection under ``"interleaved"``. Every id that crosses to the
+        device (block-table entries, host-side pool gathers/scatters)
+        goes through here; everything that stays in the ledger (digest,
+        snapshot, journal payloads) never does."""
+        if not 0 <= page_id < self.device_pages:
+            raise PageLedgerError(
+                f"page {page_id} outside the device range "
+                f"[0, {self.device_pages})")
+        if self.layout == "blocked":
+            return page_id
+        return ((page_id % self.sp_ranks)
+                * (self.device_pages // self.sp_ranks)
+                + page_id // self.sp_ranks)
+
     def page_shard(self, page_id: int) -> int:
         """Which SP rank's device shard holds ``page_id`` under the
         ``page_pool_pspec`` even split of the padded page dim. Pure layout
         introspection — no allocation decision may depend on it (that
         would fork the replicated control plane across mesh sizes)."""
-        if not 0 <= page_id < self.device_pages:
-            raise PageLedgerError(
-                f"page {page_id} outside the device range "
-                f"[0, {self.device_pages})")
-        return page_id // (self.device_pages // self.sp_ranks)
+        return self.device_row(page_id) \
+            // (self.device_pages // self.sp_ranks)
 
     def digest(self) -> int:
         """Cheap order-sensitive ledger digest (32-bit FNV-1a) over the
@@ -215,12 +250,14 @@ class KVPagePool:
 
     @classmethod
     def from_snapshot(cls, snap: dict, num_pages: int, page_size: int,
-                      reserved: int = 0, sp_ranks: int = 1) -> "KVPagePool":
+                      reserved: int = 0, sp_ranks: int = 1,
+                      layout: str = "blocked") -> "KVPagePool":
         """Rebuild a ledger from ``snapshot()`` output (geometry is not in
         the snapshot — it comes from the engine's own configuration, which
-        a restore never changes; ``sp_ranks`` is device layout only and
-        does not affect the rebuilt digest)."""
-        pool = cls(num_pages, page_size, reserved, sp_ranks=sp_ranks)
+        a restore never changes; ``sp_ranks``/``layout`` are device layout
+        only and do not affect the rebuilt digest)."""
+        pool = cls(num_pages, page_size, reserved, sp_ranks=sp_ranks,
+                   layout=layout)
         pool._free = [int(p) for p in snap["free"]]
         pool._owned = {sid: [int(p) for p in pages]
                        for sid, pages in snap["owned"]}

@@ -288,6 +288,17 @@ class ServingMetrics:
             # wire and observes zeros.
             "exposed_comm_us": Histogram(),
             "overlapped_comm_us": Histogram(),
+            # long-context serving (ISSUE 19): per-decode-step attention
+            # split under ``flash_decode_dist`` — the local per-page
+            # partial walk (∝ this rank's OWN slice of the block-table
+            # pages: the half that shrinks as the SP mesh grows) vs the
+            # fixed-order fold's wait on the remote partial slabs.
+            # MODELED on the same wire fit as exposed/overlapped_comm_us
+            # (CPU runs serialize ranks and cannot exhibit the real
+            # overlap), labeled as such in docs/serving.md; zeros outside
+            # long_context mode.
+            "attn_local_us": Histogram(),
+            "attn_fold_wait_us": Histogram(),
             # cluster prefix lending (ISSUE 17): the kill/restore TTFT
             # split — cold (no cached pages), cached (locally cached
             # pages adopted), re-warmed (adopted pages arrived via the

@@ -84,13 +84,20 @@ class ClassSpec:
     (None = inherit), so a batch tier can run a tight queue while chat
     keeps a deep one. ``stall_budget`` caps the prefill tokens
     co-scheduled per step WHILE a request of this class is decoding —
-    the deadline-aware chunk-sizing control (None = no cap)."""
+    the deadline-aware chunk-sizing control (None = no cap).
+    ``chunk_budget`` (ISSUE 19) is the dual knob on the PREFILL side: it
+    caps the prompt tokens one of THIS class's own prefills may consume
+    per step, so a 64k-token ``long`` prompt drips through admission
+    without monopolizing the co-scheduled chunk slot (None = the engine's
+    full ``prefill_chunk``). Both are runtime scalars into the one
+    compiled chunk program — never a shape."""
     name: str
     weight: int = 1
     level: int = 0
     queue_cap: int | None = None
     ttl_steps: int | None = None
     stall_budget: int | None = None
+    chunk_budget: int | None = None
 
     def __post_init__(self):
         assert self.name, "class name must be non-empty"
@@ -99,6 +106,7 @@ class ClassSpec:
         assert self.queue_cap is None or self.queue_cap >= 1
         assert self.ttl_steps is None or self.ttl_steps >= 1
         assert self.stall_budget is None or self.stall_budget >= 1
+        assert self.chunk_budget is None or self.chunk_budget >= 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -145,15 +153,42 @@ class SLOPolicy:
                    batch_queue_cap: int | None = None,
                    batch_ttl_steps: int | None = None,
                    chat_stall_budget: int | None = None,
-                   quotas: Mapping[str, tuple[int, int]] | None = None
-                   ) -> "SLOPolicy":
+                   quotas: Mapping[str, tuple[int, int]] | None = None,
+                   long_weight: int | None = None,
+                   long_chunk_budget: int | None = None,
+                   long_stall_budget: int | None = None,
+                   long_queue_cap: int | None = None,
+                   long_ttl_steps: int | None = None) -> "SLOPolicy":
         """The canonical two-tier policy the sims/tests/bench use: a
         protected ``chat`` tier (level 0) and a best-effort ``batch``
-        tier (level 1) that absorbs shedding and preemption first."""
+        tier (level 1) that absorbs shedding and preemption first.
+
+        Any ``long_*`` kwarg set (ISSUE 19) inserts the long-context
+        tier between them — ``chat`` L0, ``long`` L1, ``batch`` L2 — so
+        overload pressure still evicts batch before a half-prefilled 64k
+        prompt, and chat ITL stays protected from long prefill via the
+        tier's ``chunk_budget``/``stall_budget``. With every ``long_*``
+        kwarg None the returned policy is the two-class one, bit-for-bit
+        (the third class is pay-for-play)."""
+        long_kw = (long_weight, long_chunk_budget, long_stall_budget,
+                   long_queue_cap, long_ttl_steps)
+        if all(v is None for v in long_kw):
+            return cls(classes=(
+                ClassSpec("chat", weight=chat_weight, level=0,
+                          stall_budget=chat_stall_budget),
+                ClassSpec("batch", weight=batch_weight, level=1,
+                          queue_cap=batch_queue_cap,
+                          ttl_steps=batch_ttl_steps),
+            ), quotas=quotas or {})
         return cls(classes=(
             ClassSpec("chat", weight=chat_weight, level=0,
                       stall_budget=chat_stall_budget),
-            ClassSpec("batch", weight=batch_weight, level=1,
+            ClassSpec("long", weight=long_weight or 1, level=1,
+                      chunk_budget=long_chunk_budget,
+                      stall_budget=long_stall_budget,
+                      queue_cap=long_queue_cap,
+                      ttl_steps=long_ttl_steps),
+            ClassSpec("batch", weight=batch_weight, level=2,
                       queue_cap=batch_queue_cap,
                       ttl_steps=batch_ttl_steps),
         ), quotas=quotas or {})

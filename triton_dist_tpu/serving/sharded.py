@@ -52,7 +52,8 @@ from triton_dist_tpu.layers.ep_a2a_layer import EPAll2AllLayer
 from triton_dist_tpu.models.moe import MoEConfig, moe_mlp_ep_overlap
 from triton_dist_tpu.ops.all_to_all import _DEFAULT_WIRE_FIT, a2a_wire_bytes
 from triton_dist_tpu.ops.allgather_gemm import GemmConfig, tp_column_linear
-from triton_dist_tpu.ops.flash_decode import sp_paged_attend_write
+from triton_dist_tpu.ops.flash_decode import (flash_decode_dist,
+                                              sp_paged_attend_write)
 from triton_dist_tpu.serving import checkpoint as ckpt_mod
 from triton_dist_tpu.serving.engine import ServingEngine
 from triton_dist_tpu.serving.journal import ControlJournal
@@ -76,6 +77,37 @@ def serving_mesh(tp: int = 1, sp: int = 1, ep: int = 1) -> ShmemContext:
     so the engine, bench rows, and serve_sim all agree on spelling)."""
     return initialize_distributed(axis_names=MESH_AXES,
                                   mesh_shape=(tp, sp, ep))
+
+
+def fd_attn_split_us(n_sp: int, n_layers: int, slots: int, steps: int,
+                     page_kv_bytes: int, slab_row_bytes: int
+                     ) -> tuple[float, float]:
+    """Modeled per-decode-step attention split for ``flash_decode_dist``
+    (ISSUE 19) — the long-context twin of ``_comm_split_us``, priced on
+    the SAME PR 8 wire fit (t = t0 + bytes/BW) so serve_sim, bench.py and
+    the engine metrics all quote one model:
+
+    - ``attn_local_us``: the per-page partial walk. Each rank streams
+      only its own slice of the block-table pages — ``ceil(steps/n_sp)``
+      pages per slot per layer at ``page_kv_bytes`` each. This is the
+      half that shrinks as the SP mesh grows (∝ kv_len / n).
+    - ``attn_fold_wait_us``: the fixed-order fold's wait on remote
+      partial slabs — (n−1) slabs of ``slots·steps·slab_row_bytes``
+      behind one launch overhead per layer. Grows with n; sublinearity
+      of the TOTAL therefore holds exactly when a page's KV bytes
+      outweigh its partial-slab row (true for real page sizes — bench.py
+      asserts it at {8k, 32k, 64k}-token contexts).
+
+    MODELED, not wall clock: CPU runs serialize ranks and cannot exhibit
+    the overlap (docs/serving.md labels every consumer)."""
+    fit = _DEFAULT_WIRE_FIT["bf16"]
+    bw = fit["gb_per_s"] * 1e3          # bytes per microsecond
+    local = n_layers * slots * (-(-steps // n_sp)) * page_kv_bytes / bw
+    if n_sp == 1:
+        return local, 0.0
+    fold = n_layers * (fit["t0_us"]
+                       + (n_sp - 1) * slots * steps * slab_row_bytes / bw)
+    return local, fold
 
 
 class ShardedServingEngine(ServingEngine):
@@ -103,6 +135,12 @@ class ShardedServingEngine(ServingEngine):
     the Pallas overlap kernel (allclose-only — excluded from the bitwise
     contract; see ``tp_column_linear``). ``digest_every=k`` runs the
     replicated-decision guard every k-th step (0 disables).
+    ``long_context=True`` (ISSUE 19) serves 64k–100k-token prompts: the
+    SP attention leg becomes ``flash_decode_dist`` over an interleaved
+    pool layout (one request's pages round-robined across the SP
+    shards), so per-rank attention compute shrinks ∝ 1/|sp| instead of
+    replicating — same two compiled programs, same bitwise contract
+    (the long-context n=1 run is the golden for every mesh size).
 
     Disaggregation COMPOSES with this engine (ISSUE 12): the pool carries
     the unified contract — ``sp_ranks``-aware ledger (padding pages are
@@ -132,7 +170,8 @@ class ShardedServingEngine(ServingEngine):
                  fault_plan=None,
                  prefix_cache: bool = False,
                  slo=None,
-                 artifact=None, artifact_key: str | None = None):
+                 artifact=None, artifact_key: str | None = None,
+                 long_context: bool = False):
         for ax in MESH_AXES:
             assert ax in ctx.axis_names, (
                 f"mesh is missing axis {ax!r} — build it with "
@@ -225,10 +264,30 @@ class ShardedServingEngine(ServingEngine):
 
         sp_overlap = overlap == "ep+sp"
 
-        def attn_io(q, k, v, kp, vp, bt, pos, kv_len, active):
-            return sp_paged_attend_write(ctx, q, k, v, kp, vp, bt, pos,
-                                         kv_len, axis="sp", active=active,
-                                         overlap=sp_overlap)
+        # long-context mode (ISSUE 19): swap the SP attention leg from
+        # the across-REQUESTS pool-allgather walk (every rank attends
+        # over the full pool — per-rank cost ∝ full kv_len) to
+        # ``flash_decode_dist`` (each rank walks only its own slice of
+        # one request's pages and ships a partial slab — per-rank cost
+        # ∝ kv_len/n). The pool layout flips to "interleaved" so one
+        # sequence's pages round-robin across the SP shards; the fixed-
+        # order page fold makes the attention result placement-
+        # invariant, so tokens stay bitwise identical at every mesh size
+        # AND across the two layouts' n=1 forms. Same hook surface, same
+        # two compiled programs.
+        self.long_context = long_context
+        if long_context:
+            self._pool_layout = "interleaved"
+
+            def attn_io(q, k, v, kp, vp, bt, pos, kv_len, active):
+                return flash_decode_dist(ctx, q, k, v, kp, vp, bt, pos,
+                                         kv_len, axis="sp", active=active)
+        else:
+            def attn_io(q, k, v, kp, vp, bt, pos, kv_len, active):
+                return sp_paged_attend_write(ctx, q, k, v, kp, vp, bt,
+                                             pos, kv_len, axis="sp",
+                                             active=active,
+                                             overlap=sp_overlap)
 
         def linear(h, w, name):
             return tp_column_linear(ctx, h, w, axis="tp", impl=tp_impl,
@@ -243,6 +302,17 @@ class ShardedServingEngine(ServingEngine):
         # number (docs/serving.md), observed per step into the metrics.
         self._exposed_comm_us, self._overlapped_comm_us = \
             self._comm_split_us(cfg.base.n_layers, mb)
+        # modeled long-context attention split (ISSUE 19): zeros unless
+        # long_context — the pool-allgather path's wire cost is already
+        # priced by the overlap split above
+        base = cfg.base
+        self._attn_local_us, self._attn_fold_wait_us = (
+            fd_attn_split_us(
+                n_sp, base.n_layers, num_slots, pages_per_seq,
+                2 * base.n_kv_heads * page_size * base.head_dim
+                * jnp.dtype(base.dtype).itemsize,
+                base.n_heads * (base.head_dim + 128) * 4)
+            if long_context else (0.0, 0.0))
 
         # pool-output sharding pin: must exist BEFORE super().__init__
         # builds the jitted programs (it becomes their out_shardings for
@@ -391,6 +461,8 @@ class ShardedServingEngine(ServingEngine):
         self.metrics.observe("exposed_comm_us", self._exposed_comm_us)
         self.metrics.observe("overlapped_comm_us",
                              self._overlapped_comm_us)
+        self.metrics.observe("attn_local_us", self._attn_local_us)
+        self.metrics.observe("attn_fold_wait_us", self._attn_fold_wait_us)
         if self.digest_every and self._steps % self.digest_every == 0:
             try:
                 self.check_replicated_decisions()
@@ -429,4 +501,4 @@ class ShardedServingEngine(ServingEngine):
 
 
 __all__ = ["ShardedServingEngine", "ReplicatedDecisionError",
-           "serving_mesh", "MESH_AXES"]
+           "serving_mesh", "fd_attn_split_us", "MESH_AXES"]

@@ -46,7 +46,8 @@ class WorkloadSpec:
 
     n: int = 100                # total requests
     seed: int = 0
-    chat: float = 0.7           # P(class == "chat"); rest is "batch"
+    chat: float = 0.7           # P(class == "chat")
+    long: float = 0.0           # P(class == "long"); rest is "batch"
     rate: float = 0.5           # base arrivals per engine step
     burst_every: int = 64       # diurnal period (steps)
     burst_len: int = 16         # burst window within each period (steps)
@@ -56,6 +57,7 @@ class WorkloadSpec:
     tenants: int = 3            # tenant pool size PER class
     plen: tuple[int, int] = (4, 20)   # inclusive prompt-length range
     mnt: tuple[int, int] = (2, 10)    # inclusive decode-budget range
+    lplen: tuple[int, int] = (64, 128)   # long-class prompt-length range
 
     def validate(self) -> "WorkloadSpec":
         def bad(field: str, why: str):
@@ -68,6 +70,10 @@ class WorkloadSpec:
             bad("seed", "must be >= 0")
         if not 0.0 <= self.chat <= 1.0:
             bad("chat", "must be in [0, 1]")
+        if not 0.0 <= self.long <= 1.0:
+            bad("long", "must be in [0, 1]")
+        if self.chat + self.long > 1.0:
+            bad("long", "chat + long must be <= 1")
         if self.rate <= 0:
             bad("rate", "must be > 0")
         if self.burst_every < 1:
@@ -86,13 +92,18 @@ class WorkloadSpec:
             bad("plen", "must be LO:HI with 1 <= LO <= HI")
         if not (1 <= self.mnt[0] <= self.mnt[1]):
             bad("mnt", "must be LO:HI with 1 <= LO <= HI")
+        if not (1 <= self.lplen[0] <= self.lplen[1]):
+            bad("lplen", "must be LO:HI with 1 <= LO <= HI")
+        if self.long > 0 and self.lplen[0] <= self.plen[1]:
+            bad("lplen", "long prompts must be LONGER than plen's HI — "
+                "the class exists to stress the long-context path")
         return self
 
 
 _INT_FIELDS = ("n", "seed", "burst_every", "burst_len", "prefixes",
                "tenants")
-_FLOAT_FIELDS = ("chat", "rate", "burst_x", "zipf")
-_RANGE_FIELDS = ("plen", "mnt")
+_FLOAT_FIELDS = ("chat", "long", "rate", "burst_x", "zipf")
+_RANGE_FIELDS = ("plen", "mnt", "lplen")
 
 
 def parse_workload(spec: str) -> WorkloadSpec:
@@ -158,6 +169,14 @@ def generate_arrivals(spec: WorkloadSpec, vocab: int = 32000,
     ranges, batch from the upper half — the heterogeneity (short
     interactive vs long throughput work) the deadline-aware chunk sizing
     and per-class shedding are tested against.
+
+    ``long > 0`` (ISSUE 19) adds a third population: prompts drawn from
+    the ``lplen`` range (strictly above ``plen``) with chat-sized decode
+    budgets — the "summarize this 64k document" shape the sharded
+    long-context engine serves. Long prompts never ride the shared-
+    prefix pool (their cost IS the unique prompt). The class draw
+    partitions the SAME uniform the two-class generator consumed, so a
+    ``long=0`` spec replays the pre-ISSUE-19 trace bitwise.
     """
     rng = np.random.RandomState(spec.seed)
     # shared page-aligned prefixes with Zipf popularity (ISSUE 13 shape)
@@ -183,9 +202,18 @@ def generate_arrivals(spec: WorkloadSpec, vocab: int = 32000,
         # happens unconditionally so the stream of RNG consumption — and
         # with it every downstream prompt — is fixed by (seed, n) alone
         t += float(rng.exponential(1.0 / _rate_at(spec, step)))
-        is_batch = float(rng.uniform()) >= spec.chat
-        cls = "batch" if is_batch else "chat"
+        u = float(rng.uniform())
+        cls = ("chat" if u < spec.chat
+               else "long" if u < spec.chat + spec.long else "batch")
         tenant = f"{cls[0]}{int(rng.randint(spec.tenants))}"
+        if cls == "long":
+            plen = int(rng.randint(spec.lplen[0], spec.lplen[1] + 1))
+            mlo, mhi = _half_range(*spec.mnt, upper=False)
+            mnt = int(rng.randint(mlo, mhi + 1))
+            prompt = rng.randint(1, vocab, size=plen).tolist()
+            out.append((step, prompt, mnt, tenant, cls))
+            continue
+        is_batch = cls == "batch"
         plo, phi = _half_range(*spec.plen, upper=is_batch)
         mlo, mhi = _half_range(*spec.mnt, upper=is_batch)
         plen = int(rng.randint(plo, phi + 1))
@@ -210,14 +238,22 @@ def parse_slo(spec: str) -> SLOPolicy:
         chat_weight=4,batch_weight=1,batch_cap=8,batch_ttl=40,
         chat_stall=4,quota=b0:1:4|b1:2:8
 
-    ``quota`` is ``tenant:rate:burst`` triples joined by ``|``.
+    ``quota`` is ``tenant:rate:burst`` triples joined by ``|``. Any
+    ``long_*`` field (ISSUE 19: ``long_weight``, ``long_chunk``,
+    ``long_stall``, ``long_cap``, ``long_ttl``) inserts the long-context
+    tier — see :meth:`SLOPolicy.chat_batch`.
     """
     kw: dict = {}
     quotas: dict[str, tuple[int, int]] = {}
     int_fields = {"chat_weight": "chat_weight", "batch_weight":
                   "batch_weight", "batch_cap": "batch_queue_cap",
                   "batch_ttl": "batch_ttl_steps",
-                  "chat_stall": "chat_stall_budget"}
+                  "chat_stall": "chat_stall_budget",
+                  "long_weight": "long_weight",
+                  "long_chunk": "long_chunk_budget",
+                  "long_stall": "long_stall_budget",
+                  "long_cap": "long_queue_cap",
+                  "long_ttl": "long_ttl_steps"}
     for part in filter(None, (p.strip() for p in spec.split(","))):
         if "=" not in part:
             raise ValueError(
