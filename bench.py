@@ -2234,6 +2234,107 @@ def bench_autoscale(ctx, n: int = 1500, num_slots: int = 8,
     return out
 
 
+def bench_speculate(ctx, num_requests: int = 16, templates: int = 4,
+                    zipf: float = 1.5, num_slots: int = 4,
+                    page_size: int = 8, num_pages: int = 40,
+                    pages_per_seq: int = 8, spec_k: int = 4,
+                    max_new: int = 32) -> dict:
+    """Speculative-decoding rows (ISSUE 20): a high-Zipf shared-prefix
+    workload run through ``ServingEngine`` twice — speculate OFF (the
+    golden) and speculate ON at K — with every token asserted
+    bit-identical, the compiled-program counts asserted EQUAL (the
+    verify dispatch IS the one decode program; drafting adds zero), and
+    the draft economics asserted to actually pay:
+
+    - ``serving_spec_accepted_per_dispatch`` asserted > 1: every point
+      above 1.0 is a decode dispatch the host never launched. This is
+      the deterministic uplift row — on launch-latency-bound serving
+      each saved dispatch is a saved host round trip, while the CPU
+      interpret wall clock pays real compute for all K verify rows and
+      so UNDERSTATES the win (same caveat as the overlap rows).
+    - ``serving_spec_dispatch_uplift``: dispatches-off over
+      dispatches-on on the identical trace, asserted > 1.
+    - ``serving_spec_tok_per_s`` / ``serving_spec_tok_per_s_off``:
+      interpret-mode wall clock, reported for trend, not asserted.
+
+    The tiny-vocab config (greedy decode on a small model revisits
+    states, so the bigram prompt-lookup drafter lands real hits) plays
+    the role the paper's repetition-heavy serving traces play at scale.
+    """
+    import numpy as _np
+
+    from triton_dist_tpu.models.llama import LlamaConfig, init_params
+    from triton_dist_tpu.serving import ServingEngine
+
+    cfg = LlamaConfig(vocab_size=128, d_model=128, n_layers=1, n_heads=4,
+                      n_kv_heads=2, d_ff=128, max_seq_len=256,
+                      dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    rng0 = _np.random.RandomState(0)
+    tpls = [rng0.randint(1, cfg.vocab_size, size=2 * page_size).tolist()
+            for _ in range(templates)]
+    ranks = _np.arange(1, templates + 1, dtype=_np.float64)
+    zp = ranks ** -zipf
+    zp /= zp.sum()
+    rng = _np.random.RandomState(1)
+    work = []
+    for _ in range(num_requests):
+        t = int(rng.choice(templates, p=zp))
+        tail = rng.randint(1, cfg.vocab_size,
+                           size=int(rng.randint(1, 4))).tolist()
+        work.append((tpls[t] + tail,
+                     int(rng.randint(max_new // 2, max_new + 1))))
+
+    def _run(speculate):
+        eng = ServingEngine(params, cfg, num_slots=num_slots,
+                            page_size=page_size, num_pages=num_pages,
+                            pages_per_seq=pages_per_seq,
+                            prefill_chunk=2 * page_size,
+                            speculate=speculate)
+        for prompt, mnt in work:
+            eng.submit(list(prompt), mnt)
+        t0 = time.perf_counter()
+        res = eng.run(max_steps=200_000)
+        wall = time.perf_counter() - t0
+        assert len(res) == num_requests
+        return eng, res, eng.metrics.snapshot(), wall
+
+    eng_off, res_off, snap_off, wall_off = _run(None)
+    eng_on, res_on, snap_on, wall_on = _run(spec_k)
+    assert res_on == res_off, (
+        "speculation changed tokens — the exact-match-greedy accept rule "
+        "broke bit-identity")
+    assert eng_on.compile_stats == eng_off.compile_stats, (
+        f"speculation compiled extra programs: {eng_on.compile_stats} "
+        f"vs {eng_off.compile_stats}")
+    acc = snap_on["accepted_per_dispatch"]["mean"]
+    assert acc is not None and acc > 1.0, (
+        f"speculation accepted nothing beyond the mandatory token "
+        f"(accepted_per_dispatch mean = {acc}) — drafting never paid")
+    d_on, d_off = snap_on["dispatches"], snap_off["dispatches"]
+    assert d_on < d_off, (
+        f"speculation saved no dispatches ({d_off} -> {d_on})")
+    return {
+        "serving_spec_accepted_per_dispatch": round(acc, 3),
+        "serving_spec_dispatch_uplift": round(d_off / d_on, 3),
+        "serving_spec_dispatches": d_on,
+        "serving_spec_dispatches_off": d_off,
+        "serving_spec_draft_hit_rate": snap_on["draft_hit_rate"],
+        "serving_spec_rewinds": snap_on["spec_rewinds"],
+        "serving_spec_tok_per_s": round(
+            snap_on["tokens_generated"] / wall_on, 1),
+        "serving_spec_tok_per_s_off": round(
+            snap_off["tokens_generated"] / wall_off, 1),
+        "serving_spec_bit_identical": len(res_on),
+        "serving_spec_knobs": {
+            "num_requests": num_requests, "templates": templates,
+            "zipf": zipf, "num_slots": num_slots, "page_size": page_size,
+            "spec_k": spec_k, "max_new": max_new,
+            "vocab": cfg.vocab_size},
+    }
+
+
 # The reference's perf-shape table (test_ag_gemm_intra_node.py:153-160):
 # AG-GEMM M/N/K per model family, M = 8192 token rows.
 MODEL_SHAPES = {
@@ -2650,6 +2751,15 @@ def main(a2a_primary: bool = False):
         extras.update(bench_autoscale(ctx))
 
     attempt("autoscale", _autoscale)
+
+    def _speculate():
+        # model-free draft-verify decoding vs the speculate-off golden on
+        # a high-Zipf workload: accepted-per-dispatch asserted > 1,
+        # dispatch-count uplift asserted, tokens asserted bit-identical,
+        # compiled-program counts asserted equal (ISSUE 20)
+        extras.update(bench_speculate(ctx))
+
+    attempt("speculate", _speculate)
 
     def _aot():
         # persisted-artifact cold start vs fresh traces (>=10x on CPU,

@@ -172,6 +172,14 @@ p.add_argument("--overlap", choices=("off", "ep", "ep+sp"), default="off",
                     "The single-replica golden reference always runs "
                     "overlap=off, so the per-request trace verification "
                     "IS the overlap bit-identity check at cluster scale")
+p.add_argument("--speculate", default=None, metavar="K",
+               help="model-free speculative decoding inside each replica "
+                    "(ISSUE 20; --engine colocated only — SimEngine has "
+                    "no decode dispatch to draft through): an integer K "
+                    "or 'auto'. The single-replica golden reference "
+                    "always runs speculate=off, so the per-request trace "
+                    "verification IS the spec bit-identity check at "
+                    "cluster scale. Prints a fleet spec panel to stderr")
 p.add_argument("--artifact", default=None, metavar="DIR",
                help="persisted AOT artifact (ISSUE 15; --engine colocated "
                     "only — SimEngine has nothing to compile). EVERY "
@@ -185,6 +193,16 @@ if args.lend and not args.prefix_cache:
             "pages; without a cache there is nothing to lend or adopt)")
 if args.artifact is not None and args.engine != "colocated":
     p.error("--artifact needs --engine colocated")
+if args.speculate is not None:
+    if args.speculate != "auto":
+        try:
+            args.speculate = int(args.speculate)
+        except ValueError:
+            p.error("--speculate wants an integer K or 'auto'")
+    if args.engine != "colocated":
+        p.error("--speculate needs --engine colocated (SimEngine's token "
+                "function is closed-form — there is no decode dispatch "
+                "to draft through)")
 if ((args.overlap != "off" or args.mesh is not None)
         and args.engine != "colocated"):
     p.error("--overlap/--mesh need --engine colocated (SimEngine has no "
@@ -303,7 +321,7 @@ else:
                 prefill_chunk=args.page_size, overlap=args.overlap,
                 journal=journal, checkpoint_every=ckpt_every,
                 prefix_cache=args.prefix_cache, slo=slo_policy,
-                artifact=artifact)
+                speculate=args.speculate, artifact=artifact)
 
         _ref = ShardedServingEngine(
             params, cfg, serving_mesh(tp, sp, ep), num_slots=args.slots,
@@ -331,7 +349,9 @@ else:
                                  journal=journal,
                                  checkpoint_every=ckpt_every,
                                  prefix_cache=args.prefix_cache,
-                                 slo=slo_policy, artifact=artifact)
+                                 slo=slo_policy,
+                                 speculate=args.speculate,
+                                 artifact=artifact)
 
         _ref = ServingEngine(params, cfg, num_slots=args.slots,
                              page_size=args.page_size, num_pages=args.pages,
@@ -733,6 +753,33 @@ if args.mesh is not None:
         "overlap_microbatches": _mb,
         "exposed_comm_us_mean": round(_exp / max(_cnt, 1), 2),
         "overlapped_comm_us_mean": round(_ovl / max(_cnt, 1), 2),
+    }), file=sys.stderr)
+
+if args.speculate is not None:
+    # spec panel (ISSUE 20): fleet-aggregated draft economics. The
+    # golden reference is speculate-OFF, so the verified_bit_identical
+    # count in the summary below is the spec-transparency witness.
+    from triton_dist_tpu.serving.metrics import Histogram  # noqa: E402
+    _acc = Histogram()
+    _drafted = _accepted = _rewinds = _sdisp = 0
+    for rep in cluster.replicas:
+        if rep.engine is None:
+            continue
+        _c = rep.engine.metrics.counters
+        _drafted += _c["draft_tokens"]
+        _accepted += _c["draft_accepted"]
+        _rewinds += _c["spec_rewinds"]
+        _sdisp += _c["spec_dispatches"]
+        for v in rep.engine.metrics.hist["accepted_per_dispatch"]._samples:
+            _acc.observe(v)
+    print(json.dumps({
+        "speculate": args.speculate,
+        "spec_dispatches": _sdisp,
+        "accepted_per_dispatch_mean": None if _acc.mean is None
+        else round(_acc.mean, 3),
+        "draft_hit_rate": round(_accepted / _drafted, 4)
+        if _drafted else None,
+        "spec_rewinds": _rewinds,
     }), file=sys.stderr)
 
 toks_total = sum(len(t) for t in results.values())

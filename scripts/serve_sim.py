@@ -53,6 +53,17 @@ p.add_argument("--tokens", action="store_true",
                help="also print one JSON line per finished request")
 p.add_argument("--decode-horizon", type=int, default=1,
                help="K: scanned decode steps per host dispatch")
+p.add_argument("--speculate", default=None, metavar="K",
+               help="model-free speculative decoding (ISSUE 20): draft up "
+                    "to K-1 tokens per slot from the bigram prompt-lookup "
+                    "drafter and verify ALL K positions in the one decode "
+                    "dispatch (exact-match-greedy accept) — an integer K "
+                    "or 'auto' (tuned registry, workload-bucketed). "
+                    "Tokens stay bit-identical to greedy; only the "
+                    "dispatch count moves. Prints a spec panel "
+                    "(accepted/dispatch, draft hit rate, rewinds) to "
+                    "stderr. Owns the horizon (needs --decode-horizon 1); "
+                    "not plumbed through --disagg")
 p.add_argument("--prefill-buckets", default="pow2",
                help='"pow2" (default), "exact", or a comma-separated '
                     "ascending list of bucket lengths, e.g. 8,16,32")
@@ -194,6 +205,19 @@ if args.overlap != "off" and (args.mesh is None or args.disagg):
 if args.long_context and (args.mesh is None or args.disagg):
     p.error("--long-context rides the sharded engine: needs --mesh (or "
             "--model moe) and is not plumbed through --disagg")
+if args.speculate is not None:
+    if args.speculate != "auto":
+        try:
+            args.speculate = int(args.speculate)
+        except ValueError:
+            p.error("--speculate wants an integer K or 'auto'")
+    if args.disagg:
+        p.error("--speculate is not plumbed through --disagg (the verify "
+                "dispatch is the colocated/sharded ONE-decode program)")
+    if args.decode_horizon != 1:
+        p.error("--speculate owns the decode horizon (the verify row "
+                "block IS the multistep machinery): needs "
+                "--decode-horizon 1")
 if (args.prefix_cache and args.prefill_chunk is None
         and not args.disagg and args.mesh is None):
     # the cache rides the chunked path (adoption = cursor jump)
@@ -250,6 +274,18 @@ if args.workload is not None:
     except ValueError as e:
         p.error(str(e))
     args.sim = workload_spec.n
+
+# speculative decoding (ISSUE 20): the kwargs ride beside `common`
+# instead of inside it so the disagg branches (already p.error-fenced
+# above) never see the knob; 'auto' resolution is bucketed by the
+# workload shape when a --workload spec is in play
+spec_kwargs = {}
+if args.speculate is not None:
+    bucket = 0
+    if workload_spec is not None:
+        from triton_dist_tpu.serving import spec_bucket_of  # noqa: E402
+        bucket = spec_bucket_of(workload_spec)
+    spec_kwargs = dict(speculate=args.speculate, spec_bucket=bucket)
 
 # crash-consistency plumbing: journaled runs get a WAL + periodic
 # checkpoints; --crash-at adds an engine-tier fault plan on top of any
@@ -328,7 +364,7 @@ def mk_engine(fresh=False):
                                    prefill_chunk=args.prefill_chunk or 8,
                                    wire_dtype=wire, overlap=args.overlap,
                                    long_context=args.long_context,
-                                   **common)
+                                   **spec_kwargs, **common)
         if not fresh:
             # wire=auto resolves PER DISPATCH SIZE and rank count (PR 8
             # caveat), so decode and chunk can land on different wire
@@ -352,7 +388,8 @@ def mk_engine(fresh=False):
                   file=sys.stderr)
     else:
         eng = ServingEngine(params, cfg, prefill_buckets=buckets,
-                            prefill_chunk=args.prefill_chunk, **common)
+                            prefill_chunk=args.prefill_chunk,
+                            **spec_kwargs, **common)
     return eng
 
 
@@ -651,6 +688,18 @@ else:
                 "attn_fold_wait_us_mean": round(
                     snap["attn_fold_wait_us"]["mean"] or 0.0, 3),
             }), file=sys.stderr)
+    if args.speculate is not None:
+        # spec panel (ISSUE 20): accepted/dispatch > 1 is the whole
+        # point — every accepted draft token is a decode dispatch the
+        # host never paid for, at bit-identical tokens
+        print(json.dumps({
+            "speculate": eng.spec_k,
+            "spec_dispatches": snap["spec_dispatches"],
+            "accepted_per_dispatch_mean": round(
+                snap["accepted_per_dispatch"]["mean"] or 0.0, 3),
+            "draft_hit_rate": snap["draft_hit_rate"],
+            "spec_rewinds": snap["spec_rewinds"],
+        }), file=sys.stderr)
     print(json.dumps({
         "prefill_chunk": args.prefill_chunk,
         "prefill_chunks": snap["prefill_chunks"],

@@ -230,6 +230,24 @@ def _gate_overlap_microbatch(m) -> Callable:
     return run
 
 
+def _gate_spec_k(k) -> Callable:
+    # the speculative draft length (ISSUE 20): K multiplies the decode
+    # program's row count — every verify dispatch pushes num_slots * K
+    # rows through the EP a2a instead of num_slots — so the gate replays
+    # the segmented a2a at the K-scaled row count the tuned value would
+    # actually run. The drafter/accept logic itself is pure jnp (no
+    # signals to lint); the wire protocol under the fatter payload is
+    # what admission must prove out.
+    def run(ctx):
+        import jax.numpy as jnp
+        from triton_dist_tpu.ops import all_to_all_push_seg
+        n = ctx.num_ranks
+        rows = n * n * max(1, int(k))
+        all_to_all_push_seg(ctx, jnp.zeros((rows, 16, 128), jnp.float32),
+                            axis="x", segments=2)
+    return run
+
+
 GATE_RUNNERS: Dict[str, Callable[[Any], Callable]] = {
     "ag_gemm": _gate_ag_gemm,
     "gemm_rs": _gate_gemm_rs,
@@ -237,6 +255,7 @@ GATE_RUNNERS: Dict[str, Callable[[Any], Callable]] = {
     "moe_reduce_rs": _gate_moe_reduce_rs,
     "ring_attention": _gate_ring_attention,
     "serving_overlap_mb": _gate_overlap_microbatch,
+    "serving_spec_k": _gate_spec_k,
 }
 
 

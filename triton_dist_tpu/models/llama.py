@@ -683,6 +683,93 @@ def decode_multistep_paged(params: dict, token: jax.Array, pos: jax.Array,
     return toks, token, pos, pages
 
 
+def decode_speculate_paged(params: dict, token: jax.Array, pos: jax.Array,
+                           cfg: LlamaConfig, pages: dict,
+                           block_table: jax.Array, limit: jax.Array,
+                           horizon: int, hist: jax.Array,
+                           hist_len: jax.Array, eos_id: int | None = None,
+                           ffn=None, attn_io=None, linear=None
+                           ) -> tuple[jax.Array, jax.Array, jax.Array,
+                                      jax.Array, jax.Array, jax.Array, dict]:
+    """Draft-verify speculative decode: ONE dispatch commits up to
+    ``horizon`` tokens per slot, bit-identical to ``horizon`` sequential
+    greedy steps (ISSUE 20 tentpole). The spec twin of
+    ``decode_multistep_paged`` — same signature family, same per-slot
+    ``limit`` clamp / EOS freeze / scratch-page parking — but where the
+    multistep scan runs K *sequential* fused steps, this runs K
+    *positions in parallel* as K batch rows and accepts a prefix:
+
+    - **draft**: ``serving.speculate.ngram_draft`` proposes K-1 tokens
+      per slot from ``hist`` [B, H] (the device-resident recent-token
+      window, newest at column H-1) — no host sync, no draft model.
+    - **verify**: one ``decode_step_paged`` call over ``B*K`` rows —
+      row (b, i) consumes token i of (last_token ‖ drafts) at position
+      ``pos_b + i``. Per layer, ``paged_kv_write`` scatters ALL rows'
+      KV before ``gqa_decode_paged`` reads, and row (b, i)'s
+      ``kv_len = pos_b + i + 1`` masks everything deeper — exactly
+      ``prefill_chunk_paged``'s C-rows-of-decode intra-call causality,
+      so row i attends the KV rows 0..i-1 wrote THIS call. Rows past
+      ``limit`` park on the scratch page (``active`` mask), same as a
+      frozen multistep row.
+    - **accept**: ``serving.speculate.spec_accept`` keeps the longest
+      prefix where each row consumed the token the previous row
+      argmaxed (exact-match greedy — a committed token is committed
+      because a row fed the identical committed prefix produced it,
+      which is the whole bitwise-trace argument), clamped by ``limit``
+      and frozen after EOS so EOS is always the LAST committed token.
+
+    Rejected rows' KV lands at positions ``>= pos'`` and is dead: the
+    next dispatch re-writes those positions before any row's ``kv_len``
+    admits them (writes precede reads per layer), and whole rejected
+    pages are returned to the pool host-side via ``free_tail`` — no
+    device-side unwind needed, which is why the accept path has no host
+    sync.
+
+    Returns ``(toks [K, B], accepted [B], token' [B], pos' [B],
+    hist' [B, H], hist_len' [B], pages)``. ``toks[i, b]`` is row (b,i)'s
+    verified argmax — the committed tokens are exactly
+    ``toks[:accepted[b], b]``; ``token'``/``pos'`` advance by
+    ``accepted`` (``accepted >= 1`` for every live row, since position 0
+    consumes the authentic last token); ``hist'`` is ``hist`` rolled
+    left by ``accepted`` with the committed tokens appended — the host
+    mirrors the same roll, so history never re-uploads on the hot path.
+    ``horizon=1`` drafts nothing and degenerates to one greedy step."""
+    from triton_dist_tpu.serving.speculate import ngram_draft, spec_accept
+
+    K = int(horizon)
+    assert K >= 1
+    B = token.shape[0]
+    limit = limit.astype(jnp.int32)
+    drafts = ngram_draft(hist, hist_len, K - 1)                # [B, K-1]
+    inp = jnp.concatenate([token[:, None].astype(jnp.int32), drafts],
+                          axis=1)                              # [B, K]
+    offs = jnp.arange(K, dtype=jnp.int32)[None, :]             # [1, K]
+    ract = offs < limit[:, None]                               # [B, K]
+    rpos = jnp.where(ract, pos[:, None] + offs, 0).astype(jnp.int32)
+    fl = lambda a: a.reshape((B * K,) + a.shape[2:])           # row-major
+    fbt = jnp.repeat(block_table, K, axis=0)                   # [B*K, S]
+    nxt_fl, pages = decode_step_paged(params, fl(inp), fl(rpos), cfg,
+                                      pages, fbt, ffn=ffn,
+                                      active=fl(ract), sample=True,
+                                      attn_io=attn_io, linear=linear)
+    nxt = nxt_fl.reshape(B, K)
+    m = spec_accept(inp, nxt, ract, eos_id)                    # [B]
+    tok2 = jnp.take_along_axis(nxt, jnp.maximum(m - 1, 0)[:, None],
+                               axis=1)[:, 0]
+    token2 = jnp.where(m > 0, tok2, token)
+    pos2 = pos + m
+    # roll history left by m and append the committed tokens — the last
+    # H entries of (hist ‖ nxt[:, :m]); the zero-masked tail past m
+    # never enters the gather window
+    H = hist.shape[1]
+    commit = offs < m[:, None]
+    ext = jnp.concatenate([hist, jnp.where(commit, nxt, 0)], axis=1)
+    cols = m[:, None] + jnp.arange(H, dtype=jnp.int32)[None, :]
+    hist2 = jnp.take_along_axis(ext, cols, axis=1)
+    hlen2 = jnp.minimum(hist_len + m, H).astype(jnp.int32)
+    return nxt.T, m, token2, pos2, hist2, hlen2, pages
+
+
 def decode_step_sp(ctx, params: dict, token: jax.Array, pos: jax.Array,
                    cfg: LlamaConfig, cache: dict,
                    axis: str | None = None,
@@ -847,4 +934,4 @@ __all__ = ["LlamaConfig", "init_params", "param_specs", "forward",
            "forward_tp_overlap", "mlp_tp_overlap", "rmsnorm", "rope",
            "block_apply", "init_kv_cache", "init_page_pool", "prefill",
            "decode_step", "decode_step_paged", "decode_multistep_paged",
-           "prefill_chunk_paged", "generate"]
+           "decode_speculate_paged", "prefill_chunk_paged", "generate"]

@@ -201,6 +201,13 @@ def gqa_decode_paged(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     trick, docs/benchmarks.md) and their compute is skipped by the
     ``s * page_size < kv_len`` mask, so a short sequence in a long
     ``pages_per_seq`` batch costs its own length, not the batch max.
+
+    Nothing here assumes distinct batch rows mean distinct sequences:
+    rows are (block_table, kv_len) pairs, so several rows may walk the
+    SAME pages at staggered ``kv_len`` — the speculative verify dispatch
+    (ISSUE 20) runs B*K rows this way, row (b, i) attending its slot's
+    pages at ``kv_len = pos_b + i + 1``, exactly like the chunked-prefill
+    C-rows-of-decode idiom.
     """
     B, Hq, D = q.shape
     P_pool, Hkv, page_size, _ = k_pages.shape
@@ -270,6 +277,13 @@ def paged_kv_write(k_pages: jax.Array, v_pages: jax.Array,
     land on a live sequence's page — the device-side twin of the engine's
     host-side slot parking. Rows whose block-table lookup walks past the
     owned pages hit the row's fill id (0, same scratch page) either way.
+
+    The speculative verify dispatch (ISSUE 20) reuses both behaviors
+    with B*K rows per slot: row (b, i) writes its draft's KV at
+    ``pos_b + i`` (beyond-limit rows park on the scratch page), and a
+    rejected suffix's rows simply become garbage past the accepted
+    cursor — overwritten by the next dispatch's writes before any read,
+    the same argument that makes in-page padding tails safe.
     """
     B = pos.shape[0]
     page_size = k_pages.shape[2]

@@ -46,6 +46,10 @@ EP/SP overlap ops (see docs/serving.md).
                the graceful drain ladder (requeue, lend-ahead, retire),
                journaling every decision so a controller restart resumes
                the fleet from the journal
+- speculate  — model-free speculative decoding primitives (ISSUE 20):
+               the bigram prompt-lookup drafter, the exact-match-greedy
+               accept rule (EOS/limit composed), and the draft-length
+               resolution ladder (explicit → tuned registry → default)
 """
 
 from triton_dist_tpu.serving.autoscaler import Autoscaler, parse_budgets
@@ -83,9 +87,12 @@ from triton_dist_tpu.serving.sharded import (MESH_AXES,
                                              ReplicatedDecisionError,
                                              ShardedServingEngine,
                                              serving_mesh)
+from triton_dist_tpu.serving.speculate import (ngram_draft, resolve_spec_k,
+                                               spec_accept)
 from triton_dist_tpu.serving.workload import (WorkloadSpec,
                                               generate_arrivals, parse_slo,
-                                              parse_workload, rate_at)
+                                              parse_workload, rate_at,
+                                              spec_bucket_of)
 
 __all__ = [
     "ServingEngine",
@@ -142,4 +149,8 @@ __all__ = [
     "ServingMetrics",
     "Histogram",
     "AttainmentWindow",
+    "ngram_draft",
+    "spec_accept",
+    "resolve_spec_k",
+    "spec_bucket_of",
 ]

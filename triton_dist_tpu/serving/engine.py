@@ -60,6 +60,7 @@ import numpy as np
 
 from triton_dist_tpu.models.llama import (LlamaConfig,
                                           decode_multistep_paged,
+                                          decode_speculate_paged,
                                           init_kv_cache, init_page_pool,
                                           prefill, prefill_chunk_paged)
 from triton_dist_tpu.serving import checkpoint as ckpt_mod
@@ -165,7 +166,9 @@ class ServingEngine:
                  fault_plan=None,
                  prefix_cache: bool = False,
                  slo: SLOPolicy | None = None,
-                 artifact=None, artifact_key: str | None = None):
+                 artifact=None, artifact_key: str | None = None,
+                 speculate: int | str | None = None,
+                 spec_hist: int = 64, spec_bucket: int = 0):
         assert decode_horizon >= 1
         assert prefill_chunk is None or prefill_chunk >= 1
         assert not prefix_cache or prefill_chunk is not None, (
@@ -186,6 +189,29 @@ class ServingEngine:
         self.decode_horizon = decode_horizon
         self.eos_id = eos_id
         self._stall_steps = stall_deadline_steps
+        # speculative decoding (ISSUE 20): speculate = draft length K
+        # (int), "auto" (PR 15 registry → default), or None/0/"off".
+        # When on, the decode program is decode_speculate_paged — ONE
+        # dispatch drafts K-1 tokens, verifies all K positions in one
+        # paged-attention pass, and commits the longest draft==argmax
+        # prefix; decode_horizon doubles as K so the limits clamp
+        # (min(horizon, remaining, page headroom)) bounds the accept
+        # burst exactly as it bounds the multistep scan.
+        self.spec_k = 0
+        self.spec_hist = int(spec_hist)
+        if speculate not in (None, 0, "off"):
+            assert decode_horizon == 1, (
+                "speculate replaces the multistep scan — the verify pass "
+                "scores K positions per dispatch, so decode_horizon must "
+                "stay 1 when speculation is on")
+            assert self.spec_hist >= 8, (
+                "spec_hist must be >= 8 — a shorter drafter window cannot "
+                "hold a bigram plus its continuation")
+            from triton_dist_tpu.serving.speculate import resolve_spec_k
+            self.spec_k = resolve_spec_k(
+                speculate, getattr(self, "_spec_mesh_shape", ()),
+                str(jnp.dtype(cfg.dtype)), spec_bucket)
+            self.decode_horizon = self.spec_k
         if prefill_buckets is not None and prefill_buckets != "pow2":
             prefill_buckets = tuple(sorted(int(b) for b in prefill_buckets))
             assert prefill_buckets, "bucket list must be non-empty"
@@ -250,6 +276,12 @@ class ServingEngine:
         self._token = np.zeros(num_slots, np.int32)
         self._pos = np.zeros(num_slots, np.int32)
         self._bt = np.zeros((num_slots, pages_per_seq), np.int32)
+        # drafter history window [B, H] (newest token at column H-1) +
+        # valid-suffix lengths. Device-carried between dispatches when
+        # speculation is on; the host mirrors the device's roll bitwise
+        # so the hot path never re-uploads it (host_syncs stays flat).
+        self._hist = np.zeros((num_slots, self.spec_hist), np.int32)
+        self._hist_len = np.zeros(num_slots, np.int32)
         self._sync_mirrors()
         self._dirty = False                 # mirrors diverged from device
 
@@ -262,12 +294,18 @@ class ServingEngine:
             prefill_chunk is not None, (
             "attn_io/linear hooks need prefill_chunk set — the bucketed "
             "inline prefill path does not thread them")
-        K = decode_horizon
-
-        def step(p, t, pos, pages, bt, lim):
-            return decode_multistep_paged(
-                p, t, pos, cfg, pages, bt, lim, horizon=K, eos_id=eos_id,
-                ffn=ffn, attn_io=attn_io, linear=linear)
+        K = self.decode_horizon
+        if self.spec_k:
+            def step(p, t, pos, pages, bt, lim, hist, hlen):
+                return decode_speculate_paged(
+                    p, t, pos, cfg, pages, bt, lim, horizon=K, hist=hist,
+                    hist_len=hlen, eos_id=eos_id, ffn=ffn, attn_io=attn_io,
+                    linear=linear)
+        else:
+            def step(p, t, pos, pages, bt, lim):
+                return decode_multistep_paged(
+                    p, t, pos, cfg, pages, bt, lim, horizon=K,
+                    eos_id=eos_id, ffn=ffn, attn_io=attn_io, linear=linear)
         # pool-output sharding pin (sharded engine sets _pool_out_sharding
         # BEFORE calling super().__init__): without it, GSPMD may choose a
         # different output sharding for the pool than the committed SP
@@ -282,8 +320,9 @@ class ServingEngine:
         # matching sharding by the sharded engine)
         rep = None if ps is None else \
             jax.sharding.NamedSharding(ps.mesh, jax.sharding.PartitionSpec())
-        step_kw = {} if ps is None else {
-            "out_shardings": (None, rep, rep, {"k": ps, "v": ps})}
+        step_kw = {} if ps is None else {"out_shardings": (
+            (None, None, rep, rep, rep, rep, {"k": ps, "v": ps})
+            if self.spec_k else (None, rep, rep, {"k": ps, "v": ps}))}
         if jax.default_backend() == "cpu":
             self._step = jax.jit(step, **step_kw)  # CPU: no donation
         else:
@@ -328,10 +367,17 @@ class ServingEngine:
                     v.shape[:1] + (v.shape[1] + (-v.shape[1]) % sp,)
                     + v.shape[2:], v.dtype)
                 for k, v in self.pool.items()}
-            programs = {"decode_multistep_paged": (step, (
-                abstract(self.params), i32(num_slots), i32(num_slots),
-                pool_abs, i32(num_slots, pages_per_seq),
-                i32(num_slots)))}
+            if self.spec_k:
+                programs = {"decode_speculate_paged": (step, (
+                    abstract(self.params), i32(num_slots), i32(num_slots),
+                    pool_abs, i32(num_slots, pages_per_seq),
+                    i32(num_slots), i32(num_slots, self.spec_hist),
+                    i32(num_slots)))}
+            else:
+                programs = {"decode_multistep_paged": (step, (
+                    abstract(self.params), i32(num_slots), i32(num_slots),
+                    pool_abs, i32(num_slots, pages_per_seq),
+                    i32(num_slots)))}
             if prefill_chunk is not None:
                 programs["prefill_chunk_paged"] = (chunk, (
                     abstract(self.params), i32(prefill_chunk), i32(), i32(),
@@ -372,6 +418,9 @@ class ServingEngine:
         self._token_dev = jnp.asarray(self._token)
         self._pos_dev = jnp.asarray(self._pos)
         self._bt_dev = jnp.asarray(self._bt)
+        if self.spec_k:
+            self._hist_dev = jnp.asarray(self._hist)
+            self._hlen_dev = jnp.asarray(self._hist_len)
 
     # -- ledger id → device row (ISSUE 19) --------------------------------
     # The ledger allocates in ID space; the device arrays are indexed in
@@ -526,6 +575,7 @@ class ServingEngine:
         self._token[slot] = tok0
         self._pos[slot] = sp
         self._bt[slot] = self._device_bt_row(req.rid)
+        self._seed_hist(slot, req)
         self._dirty = True
         if req.done:            # max_new_tokens == 1 or tok0 == eos_id
             self._finish(slot)
@@ -814,6 +864,7 @@ class ServingEngine:
         self._token[slot] = tok0
         self._pos[slot] = sp
         self._bt[slot] = row
+        self._seed_hist(slot, req)
         self._dirty = True
         if req.done:            # max_new_tokens == 1 or tok0 == eos_id
             self._finish(slot)
@@ -887,7 +938,63 @@ class ServingEngine:
         self._token[slot] = 0
         self._pos[slot] = 0
         self._bt[slot] = 0
+        self._hist[slot] = 0
+        self._hist_len[slot] = 0
         self._dirty = True
+
+    def _seed_hist(self, slot: int, req: Request) -> None:
+        """Seed the drafter window with the slot's token story (prompt +
+        generated suffix, right-aligned, newest last) at admission — the
+        only host→history upload; thereafter the device rolls the window
+        inside the decode program and the host mirrors the same roll
+        bitwise (re-prefill after preemption just re-seeds here)."""
+        if not self.spec_k:
+            return
+        H = self.spec_hist
+        seq = (list(req.prompt) + list(req.generated))[-H:]
+        row = np.zeros(H, np.int32)
+        row[H - len(seq):] = seq
+        self._hist[slot] = row
+        self._hist_len[slot] = len(seq)
+
+    def _spec_account(self, slot: int, req, lim: int,
+                      emitted: int) -> None:
+        """Per-slot speculation bookkeeping after a dispatch: roll the
+        host history window exactly as the device rolled its carry
+        (shift left by ``emitted``, append the committed tokens — bitwise
+        the same values, so the mirrors stay equal to the device arrays
+        and no re-upload happens), and account draft hit/miss metrics.
+        Position 0 of a dispatch is the authentic last token, so only
+        the ``lim - 1`` draft positions count as drafted."""
+        H = self.spec_hist
+        committed = np.asarray(req.generated[-emitted:] if emitted
+                               else [], np.int32)
+        self._hist[slot] = np.concatenate(
+            [self._hist[slot], committed])[-H:]
+        self._hist_len[slot] = min(
+            int(self._hist_len[slot]) + emitted, H)
+        req.spec_drafted += max(0, lim - 1)
+        req.spec_accepted += max(0, emitted - 1)
+        self.metrics.inc("draft_tokens", max(0, lim - 1))
+        self.metrics.inc("draft_accepted", max(0, emitted - 1))
+        self.metrics.observe("accepted_per_dispatch", emitted)
+
+    def _spec_rewind(self, slot: int, req) -> None:
+        """Unwind a rejected draft suffix's KV. The rejected rows wrote
+        positions ``>= pos'`` — dead weight the next dispatch overwrites
+        before any read (per-layer writes precede reads and every row's
+        ``kv_len`` masks deeper positions), so in-page remainders need no
+        scrub; only WHOLE pages past the accepted cursor go back to the
+        pool via ``free_tail`` (the mid-prefill preemption mechanics).
+        The freed-page journal event is observability-only — replay
+        ignores it, keeping crash-recovery sweeps bitwise (ISSUE 9)."""
+        keep = int(self._pos[slot]) // self.page_size + 1
+        freed = 0
+        if len(self.alloc.pages_of(req.rid)) > keep:
+            freed = self.alloc.free_tail(req.rid, keep=keep)
+        self.metrics.inc("spec_rewinds")
+        self._jlog("spec_rewind", rid=req.rid, freed=freed,
+                   pos=int(self._pos[slot]))
 
     # -- one engine iteration ---------------------------------------------
     def step(self) -> bool:
@@ -1028,15 +1135,30 @@ class ServingEngine:
             self.metrics.inc("host_syncs")
 
         t_disp = time.perf_counter()
-        toks, self._token_dev, self._pos_dev, self.pool = self._step(
-            self.params, self._token_dev, self._pos_dev, self.pool,
-            self._bt_dev, jnp.asarray(limits))
+        if self.spec_k:
+            (toks, acc, self._token_dev, self._pos_dev, self._hist_dev,
+             self._hlen_dev, self.pool) = self._step(
+                self.params, self._token_dev, self._pos_dev, self.pool,
+                self._bt_dev, jnp.asarray(limits), self._hist_dev,
+                self._hlen_dev)
+            accepted = np.asarray(acc)     # [B] committed-count vector
+        else:
+            toks, self._token_dev, self._pos_dev, self.pool = self._step(
+                self.params, self._token_dev, self._pos_dev, self.pool,
+                self._bt_dev, jnp.asarray(limits))
+            accepted = None
         slab = np.asarray(toks)            # [horizon, B] — blocks on device
         t_done = time.perf_counter()
 
         self._steps += 1
         self.metrics.inc("dispatches")
-        self.metrics.inc("decode_steps", int(limits.max()))
+        if self.spec_k:
+            # the verify pass IS one device step — the whole point is
+            # that decode_steps stops tracking tokens
+            self.metrics.inc("decode_steps")
+            self.metrics.inc("spec_dispatches")
+        else:
+            self.metrics.inc("decode_steps", int(limits.max()))
         self.metrics.observe("queue_depth", self.sched.queue_depth)
         self.metrics.observe("pool_occupancy", self.alloc.occupancy())
         self.metrics.observe("active_slots", len(active))
@@ -1044,22 +1166,29 @@ class ServingEngine:
         n_tokens = 0
         emitted_by_slot = {}
         for slot, req in active:
+            n_commit = int(limits[slot]) if accepted is None \
+                else int(accepted[slot])
             emitted = 0
-            for i in range(int(limits[slot])):
+            for i in range(n_commit):
                 req.generated.append(int(slab[i, slot]))
                 emitted += 1
                 self.metrics.inc("tokens_generated")
                 if req.done:               # budget exhausted or EOS
                     break
             # the device froze this row after the same ``emitted`` steps
-            # (limit clamp / EOS mask), so the mirrors stay equal to the
-            # device carry — a continuing slot costs no re-upload
+            # (limit clamp / EOS mask / accept prefix), so the mirrors
+            # stay equal to the device carry — a continuing slot costs no
+            # re-upload
             self._token[slot] = slab[emitted - 1, slot]
             self._pos[slot] += emitted
+            if self.spec_k:
+                self._spec_account(slot, req, int(limits[slot]), emitted)
             n_tokens += emitted
             emitted_by_slot[slot] = emitted
             if req.done:
                 self._finish(slot)
+            elif self.spec_k and emitted < int(limits[slot]):
+                self._spec_rewind(slot, req)
 
         dev_dt = t_done - t_disp
         host_dt = (t_disp - t_begin) + (time.perf_counter() - t_done)

@@ -40,6 +40,9 @@ Event kinds written by the engines:
 ``drain_done``     controller: a drain reached quiescence — in-flight work
                    finished or requeued, lend-ahead ran (replica)
 ``retire``         controller: the drained replica left the fleet (replica)
+``spec_rewind``    speculative decoding (ISSUE 20): a verify dispatch
+                   rejected a draft suffix and returned its whole pages to
+                   the pool (rid, freed, pos) — replay ignores it
 =================  ============================================================
 
 Entries are plain JSON-able dicts ``{"seq", "step", "kind", "digest", ...}``
@@ -94,6 +97,13 @@ EVENT_KINDS = (
     "drain_begin",
     "drain_done",
     "retire",
+    # speculative decoding (ISSUE 20): a rejected draft suffix's pages
+    # went back to the pool. Observability only — replay ignores it (the
+    # token trace is bit-identical spec-on/off, so recovery re-derives
+    # page state from the replayed control events exactly as before;
+    # folding accept/reject accounting into replay would make recovery
+    # depend on a knob that must never change outputs)
+    "spec_rewind",
 )
 
 # Payload keys elided from one-line renderings (bulky checkpoint state).

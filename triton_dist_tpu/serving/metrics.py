@@ -223,6 +223,18 @@ class ServingMetrics:
             "lend_aheads": 0,
             "lend_ahead_pages": 0,
             "lend_ahead_noops": 0,
+            # speculative decoding (ISSUE 20): verify dispatches run with
+            # speculation on, draft positions those dispatches scored
+            # (position 0 consumes the authentic last token, so a
+            # K-horizon dispatch drafts K-1), drafts that committed
+            # (draft == verified argmax — ``draft_hit_rate`` in
+            # ``snapshot()`` is accepted/drafted), and dispatches that
+            # rejected a suffix and rewound its KV past the accepted
+            # cursor
+            "spec_dispatches": 0,
+            "draft_tokens": 0,
+            "draft_accepted": 0,
+            "spec_rewinds": 0,
         }
         self.hist = {
             "ttft_s": Histogram(),
@@ -324,6 +336,11 @@ class ServingMetrics:
             "itl_steps": Histogram(),
             "fleet_size": Histogram(),
             "scale_up_build_s": Histogram(),
+            # speculative decoding (ISSUE 20): tokens COMMITTED per slot
+            # per verify dispatch (1 = speculation earned nothing over
+            # greedy that dispatch; mean > 1 is the whole win — the bench
+            # gate asserts it on the repetitive workload)
+            "accepted_per_dispatch": Histogram(),
         }
         self._t0 = time.perf_counter()
 
@@ -390,9 +407,15 @@ class ServingMetrics:
     def snapshot(self) -> dict:
         wall = time.perf_counter() - self._t0
         toks = self.counters["tokens_generated"]
+        drafted = self.counters["draft_tokens"]
         return {
             "wall_s": round(wall, 4),
             "tok_per_s": round(toks / wall, 2) if wall > 0 else None,
+            # derived: fraction of draft positions whose token committed
+            # (ISSUE 20); None when speculation never drafted
+            "draft_hit_rate": round(
+                self.counters["draft_accepted"] / drafted, 4)
+                if drafted else None,
             **self.counters,
             **{k: v.summary() for k, v in self.hist.items()},
         }

@@ -284,6 +284,13 @@ class Request:
     tenant: str = "default"
     cls: str = "default"
     shed_level: int = 0
+    # speculative decoding (ISSUE 20): draft positions this request's
+    # verify rows consumed and how many of them committed — per-request
+    # observability only (NOT folded into the control digest, NOT
+    # checkpointed: the token trace is bit-identical spec-on/off, so
+    # acceptance bookkeeping must never perturb recovery or replay).
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
     @property
     def cost(self) -> int:

@@ -59,6 +59,7 @@ from triton_dist_tpu.serving.engine import ServingEngine
 from triton_dist_tpu.serving.journal import ControlJournal
 from triton_dist_tpu.serving.kv_pool import page_pool_pspec, shard_pool_arrays
 from triton_dist_tpu.serving.metrics import ServingMetrics
+from triton_dist_tpu.serving.speculate import resolve_spec_k
 from triton_dist_tpu.shmem import faults as faults_mod
 from triton_dist_tpu.shmem.context import ShmemContext, initialize_distributed
 
@@ -171,7 +172,9 @@ class ShardedServingEngine(ServingEngine):
                  prefix_cache: bool = False,
                  slo=None,
                  artifact=None, artifact_key: str | None = None,
-                 long_context: bool = False):
+                 long_context: bool = False,
+                 speculate: int | str | None = None,
+                 spec_hist: int = 64, spec_bucket: int = 0):
         for ax in MESH_AXES:
             assert ax in ctx.axis_names, (
                 f"mesh is missing axis {ax!r} — build it with "
@@ -191,15 +194,33 @@ class ShardedServingEngine(ServingEngine):
             f"prefill_chunk {prefill_chunk} must split evenly over "
             f"ep={n_ep}")
 
+        # speculative decoding (ISSUE 20): resolve the draft length K
+        # BEFORE the A2A layers — a verify dispatch runs num_slots * K
+        # token rows through the row-count-specialized EP dispatch, so K
+        # must be known when the decode layer is sized. Resolution ladder
+        # = explicit int → tuned registry (keyed on this mesh + the model
+        # dtype + the workload bucket, sigcheck-gated like
+        # serving_overlap_mb) → default; the resolved int is handed to
+        # the base ctor so it never re-consults the registry.
+        self._spec_mesh_shape = (n_tp, n_sp, n_ep)
+        spec_k = 0
+        if speculate not in (None, 0, "off"):
+            spec_k = resolve_spec_k(speculate, self._spec_mesh_shape,
+                                    str(jnp.dtype(cfg.base.dtype)),
+                                    spec_bucket)
+        decode_rows = num_slots * max(1, spec_k)
+        assert decode_rows % n_ep == 0
+
         # TWO A2A layers: the EP dispatch is row-count-specialized, and the
         # engine's two programs run different row counts (decode: the
-        # num_slots batch; chunk: the prefill_chunk rows)
+        # num_slots batch — times K verify rows under speculation; chunk:
+        # the prefill_chunk rows)
         mk = lambda rows: EPAll2AllLayer.create(  # noqa: E731
             ctx, max_tokens=rows // n_ep, hidden=cfg.base.d_model,
             topk=cfg.topk, num_experts=cfg.num_experts, axis="ep",
             dtype=cfg.base.dtype, wire_dtype=wire_dtype)
-        self.a2a_decode = mk(num_slots)
-        self.a2a_chunk = (self.a2a_decode if prefill_chunk == num_slots
+        self.a2a_decode = mk(decode_rows)
+        self.a2a_chunk = (self.a2a_decode if prefill_chunk == decode_rows
                           else mk(prefill_chunk))
         self.wire_dtype = str(jnp.dtype(self.a2a_decode.a2a.wire_dtype)) \
             if self.a2a_decode.a2a.wire_dtype is not None else None
@@ -236,8 +257,8 @@ class ShardedServingEngine(ServingEngine):
                 mb = 2 if mb is None else int(mb)
             mb = int(mb)
             assert mb >= 1, f"overlap_microbatches must be >= 1, got {mb}"
-            assert (num_slots // n_ep) % mb == 0, (
-                f"decode rows per rank {num_slots // n_ep} must split "
+            assert (decode_rows // n_ep) % mb == 0, (
+                f"decode rows per rank {decode_rows // n_ep} must split "
                 f"evenly into {mb} overlap microbatches")
             assert (prefill_chunk // n_ep) % mb == 0, (
                 f"chunk rows per rank {prefill_chunk // n_ep} must split "
@@ -344,7 +365,9 @@ class ShardedServingEngine(ServingEngine):
                          queue_cap=queue_cap, ttl_steps=ttl_steps,
                          fault_plan=fault_plan, prefix_cache=prefix_cache,
                          slo=slo, artifact=artifact,
-                         artifact_key=artifact_key)
+                         artifact_key=artifact_key,
+                         speculate=(spec_k or None), spec_hist=spec_hist,
+                         spec_bucket=spec_bucket)
 
         # shard the pool arrays over SP on the page dim, padding the page
         # count up to a multiple of |sp|. The ALLOCATOR never learns about
@@ -413,6 +436,11 @@ class ShardedServingEngine(ServingEngine):
                                        self._rep_sharding)
         self._bt_dev = jax.device_put(jnp.asarray(self._bt),
                                       self._rep_sharding)
+        if self.spec_k:
+            self._hist_dev = jax.device_put(jnp.asarray(self._hist),
+                                            self._rep_sharding)
+            self._hlen_dev = jax.device_put(jnp.asarray(self._hist_len),
+                                            self._rep_sharding)
 
     # -- replicated-decision guard ----------------------------------------
     # ``control_digest`` lives on the base engine now (ISSUE 9: journal

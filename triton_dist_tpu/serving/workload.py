@@ -230,6 +230,20 @@ def generate_arrivals(spec: WorkloadSpec, vocab: int = 32000,
     return out
 
 
+def spec_bucket_of(spec: WorkloadSpec) -> int:
+    """Workload-repetitiveness bucket for the ``serving_spec_k`` tuned
+    key (ISSUE 20): 0 = no shared structure (empty prefix pool — the
+    prompt-lookup drafter has nothing to replay, speculation mostly pays
+    for wasted verify rows), 1 = moderate sharing, 2 = heavy sharing (a
+    small hot pool under a steep Zipf — the regime where drafts hit and
+    K should be large). Pure arithmetic on the spec: calling it draws no
+    RNG, so threading it through a sim/bench NEVER perturbs the arrival
+    trace the bitwise goldens replay."""
+    if spec.prefixes == 0:
+        return 0
+    return 2 if spec.zipf >= 1.5 or spec.prefixes <= 4 else 1
+
+
 def parse_slo(spec: str) -> SLOPolicy:
     """Parse an SLO-policy CLI spec into :meth:`SLOPolicy.chat_batch`.
 
@@ -284,4 +298,4 @@ def parse_slo(spec: str) -> SLOPolicy:
 
 
 __all__ = ["WorkloadSpec", "parse_workload", "generate_arrivals",
-           "parse_slo", "rate_at"]
+           "parse_slo", "rate_at", "spec_bucket_of"]
